@@ -1,0 +1,36 @@
+"""Test configuration.
+
+All tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic
+is exercised without TPU hardware (mirrors the reference's strategy of
+CPU/gloo multiprocess tests, SURVEY.md §4).  Env must be set before jax
+import — conftest runs first, and worker subprocesses inherit it.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def store_server():
+    from tpu_resiliency.store import StoreServer
+
+    server = StoreServer(host="127.0.0.1", port=0).start_in_thread()
+    yield server
+    server.stop()
+
+
+@pytest.fixture
+def store(store_server):
+    from tpu_resiliency.store import StoreClient
+
+    client = StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+    yield client
+    client.close()

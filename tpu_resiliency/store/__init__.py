@@ -1,0 +1,30 @@
+"""DCN key-value store control plane (L0 substrate).
+
+The reference builds every coordination protocol (rendezvous, barriers,
+heartbeats, interruption records) on ``torch.distributed.TCPStore`` wrapped by
+``inprocess/store.py:50-381``.  This package is the TPU-native equivalent: a
+standalone KV store over DCN with the same primitive surface
+(get/set/add/append/compare_set/wait/check/delete) plus counting and
+reentrant barriers, with no torch dependency.
+
+The wire protocol (``protocol.py``) is a fixed binary framing so the server
+can be implemented natively; ``server.py`` is the asyncio implementation,
+``native.py`` loads the C++ server when built.
+"""
+
+from .client import StoreClient, PrefixStore, StoreTimeout, StoreError
+from .server import StoreServer, serve_forever
+from .barrier import barrier, reentrant_barrier, BarrierOverflow, BarrierTimeout
+
+__all__ = [
+    "StoreClient",
+    "PrefixStore",
+    "StoreTimeout",
+    "StoreError",
+    "StoreServer",
+    "serve_forever",
+    "barrier",
+    "reentrant_barrier",
+    "BarrierOverflow",
+    "BarrierTimeout",
+]

@@ -1,0 +1,93 @@
+"""Store-based distributed barriers.
+
+Semantics follow the reference's ``inprocess/store.py:186-321``: a counting
+barrier with overflow detection (more arrivals than world_size means two
+incarnations raced into the same barrier — a protocol bug worth failing
+loudly on, reference ``store.py:46,206-211``) and a reentrant barrier that a
+rank may safely re-execute after being interrupted mid-barrier (used by the
+in-process restart loop).
+
+Both poll in timeout chunks so a hung peer is reported as BarrierTimeout with
+the set of missing ranks rather than a bare socket timeout.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+from .client import StoreTimeout
+
+
+class BarrierOverflow(RuntimeError):
+    """More ranks arrived at a barrier than world_size."""
+
+
+class BarrierTimeout(TimeoutError):
+    def __init__(self, name: str, arrived: int, world_size: int):
+        self.arrived = arrived
+        self.world_size = world_size
+        super().__init__(
+            f"barrier {name!r} timed out: {arrived}/{world_size} ranks arrived"
+        )
+
+
+def barrier(
+    store,
+    name: str,
+    world_size: int,
+    timeout: float = 300.0,
+    poll_interval: float = 0.05,
+) -> None:
+    """Counting barrier.  Each participant calls exactly once per `name`."""
+    count_key = f"barrier/{name}/count"
+    done_key = f"barrier/{name}/done"
+    arrived = store.add(count_key, 1)
+    if arrived > world_size:
+        raise BarrierOverflow(
+            f"barrier {name!r} overflow: arrival #{arrived} > world_size {world_size}"
+        )
+    if arrived == world_size:
+        store.set(done_key, b"1")
+        return
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            count = int(store.try_get(count_key) or b"0")
+            raise BarrierTimeout(name, count, world_size)
+        try:
+            store.wait([done_key], timeout=min(remaining, max(poll_interval, 1.0)))
+            return
+        except StoreTimeout:
+            continue
+
+
+def reentrant_barrier(
+    store,
+    name: str,
+    rank: int,
+    world_size: int,
+    timeout: float = 300.0,
+    ranks: Optional[Sequence[int]] = None,
+) -> None:
+    """Barrier safe to re-execute: arrival is an idempotent per-rank key.
+
+    A rank interrupted mid-barrier can call again with the same `name` and
+    will not double-count (reference ``store.py:254-321``).  `ranks` narrows
+    the participant set (used when terminated ranks are excluded).
+    """
+    participants = list(ranks) if ranks is not None else list(range(world_size))
+    store.set(f"barrier/{name}/arrived/{rank}", b"1")
+    keys = [f"barrier/{name}/arrived/{r}" for r in participants]
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            present = sum(1 for k in keys if store.check([k]))
+            raise BarrierTimeout(name, present, len(participants))
+        try:
+            store.wait(keys, timeout=min(remaining, 1.0))
+            return
+        except StoreTimeout:
+            continue

@@ -1,0 +1,337 @@
+"""Blocking KV store client + PrefixStore namespace wrapper.
+
+Primitive surface mirrors what every reference coordination protocol needs
+(``inprocess/store.py:50-381`` StoreMixin over TCPStore):
+get/set/add/append/compare_set/wait/check/delete, plus list_keys and
+multi ops.  Values are ``bytes``; helpers convert ints/strings.
+
+Thread-safety: a client holds one socket guarded by a lock; ``clone()``
+returns an independent connection for use from another thread (monitor
+threads keep their own clone so a blocked GET can't starve heartbeats).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from .protocol import Op, Status, itob
+
+_U32 = struct.Struct("<I")
+
+_DEFAULT_TIMEOUT = 300.0
+
+
+class StoreError(RuntimeError):
+    pass
+
+
+class StoreTimeout(StoreError, TimeoutError):
+    pass
+
+
+class StoreClient:
+    """Client for :class:`tpu_resiliency.store.server.StoreServer`."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = _DEFAULT_TIMEOUT,
+        connect_timeout: float = 60.0,
+        retries: int = 3,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._lock = threading.RLock()
+        self._sock: Optional[socket.socket] = None
+        self._retries = retries
+        self._connect(connect_timeout)
+
+    # -- connection --------------------------------------------------------
+
+    def _connect(self, connect_timeout: float) -> None:
+        deadline = time.monotonic() + connect_timeout
+        last_exc: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((self.host, self.port), timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = sock
+                return
+            except OSError as exc:
+                last_exc = exc
+                time.sleep(min(0.25, max(0.0, deadline - time.monotonic())))
+        raise StoreError(
+            f"could not connect to store at {self.host}:{self.port}: {last_exc}"
+        )
+
+    def clone(self) -> "StoreClient":
+        return StoreClient(self.host, self.port, timeout=self.timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    # -- request plumbing --------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        assert self._sock is not None
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            buf += chunk
+        return buf
+
+    def _roundtrip(
+        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
+    ) -> tuple[Status, List[bytes]]:
+        with self._lock:
+            if self._sock is None:
+                self._connect(10.0)
+            payload = [bytes([int(op)]), _U32.pack(len(args))]
+            for a in args:
+                payload.append(_U32.pack(len(a)))
+                payload.append(a)
+            attempt = 0
+            while True:
+                try:
+                    self._sock.settimeout(io_timeout)
+                    self._sock.sendall(b"".join(payload))
+                    status = Status(self._read_exact(1)[0])
+                    (nargs,) = _U32.unpack(self._read_exact(4))
+                    out = []
+                    for _ in range(nargs):
+                        (ln,) = _U32.unpack(self._read_exact(4))
+                        out.append(self._read_exact(ln) if ln else b"")
+                    return status, out
+                except socket.timeout as exc:
+                    # Desync risk after a mid-frame timeout: drop the socket.
+                    self._drop_socket()
+                    raise StoreTimeout(f"store op {op.name} timed out") from exc
+                except (ConnectionError, BrokenPipeError, OSError) as exc:
+                    self._drop_socket()
+                    attempt += 1
+                    if attempt > self._retries:
+                        raise StoreError(f"store op {op.name} failed: {exc}") from exc
+                    time.sleep(0.2 * attempt)
+                    self._connect(10.0)
+
+    def _drop_socket(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    @staticmethod
+    def _k(key) -> bytes:
+        return key.encode() if isinstance(key, str) else bytes(key)
+
+    @staticmethod
+    def _v(value) -> bytes:
+        if isinstance(value, bytes):
+            return value
+        if isinstance(value, str):
+            return value.encode()
+        if isinstance(value, int):
+            return itob(value)
+        raise TypeError(f"unsupported store value type: {type(value)}")
+
+    # -- public API --------------------------------------------------------
+
+    def ping(self) -> bool:
+        status, _ = self._roundtrip(Op.PING, [], io_timeout=5.0)
+        return status == Status.OK
+
+    def set(self, key, value) -> None:
+        status, _ = self._roundtrip(Op.SET, [self._k(key), self._v(value)], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"set({key}) -> {status.name}")
+
+    def get(self, key, timeout: Optional[float] = None) -> bytes:
+        """Blocking get: waits for the key up to `timeout` (like TCPStore.get)."""
+        t = self.timeout if timeout is None else timeout
+        status, out = self._roundtrip(
+            Op.GET, [self._k(key), itob(int(t * 1000))], io_timeout=t + 10.0
+        )
+        if status == Status.TIMEOUT:
+            raise StoreTimeout(f"get({key}) timed out after {t}s")
+        if status != Status.OK:
+            raise StoreError(f"get({key}) -> {status.name}")
+        return out[0]
+
+    def try_get(self, key) -> Optional[bytes]:
+        status, out = self._roundtrip(Op.TRY_GET, [self._k(key)], self.timeout)
+        if status == Status.KEY_MISS:
+            return None
+        if status != Status.OK:
+            raise StoreError(f"try_get({key}) -> {status.name}")
+        return out[0]
+
+    def add(self, key, amount: int = 1) -> int:
+        status, out = self._roundtrip(Op.ADD, [self._k(key), itob(amount)], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"add({key}) -> {status.name}")
+        return int(out[0])
+
+    def append(self, key, value) -> int:
+        status, out = self._roundtrip(Op.APPEND, [self._k(key), self._v(value)], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"append({key}) -> {status.name}")
+        return int(out[0])
+
+    def compare_set(self, key, expected, desired) -> bytes:
+        """CAS. expected=b'' means set-if-absent. Returns value after the op."""
+        status, out = self._roundtrip(
+            Op.COMPARE_SET,
+            [self._k(key), self._v(expected), self._v(desired)],
+            self.timeout,
+        )
+        if status == Status.OK:
+            return out[0]
+        if status == Status.CAS_FAIL:
+            return out[0]  # current value (b"" if key absent and expected != "")
+        raise StoreError(f"compare_set({key}) -> {status.name}")
+
+    def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        t = self.timeout if timeout is None else timeout
+        args = [itob(int(t * 1000))] + [self._k(k) for k in keys]
+        status, _ = self._roundtrip(Op.WAIT, args, io_timeout=t + 10.0)
+        if status == Status.TIMEOUT:
+            raise StoreTimeout(f"wait({list(keys)}) timed out after {t}s")
+        if status != Status.OK:
+            raise StoreError(f"wait -> {status.name}")
+
+    def check(self, keys: Sequence) -> bool:
+        status, out = self._roundtrip(Op.CHECK, [self._k(k) for k in keys], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"check -> {status.name}")
+        return out[0] == b"1"
+
+    def delete(self, key) -> bool:
+        status, out = self._roundtrip(Op.DELETE, [self._k(key)], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"delete({key}) -> {status.name}")
+        return out[0] == b"1"
+
+    def num_keys(self) -> int:
+        status, out = self._roundtrip(Op.NUM_KEYS, [], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"num_keys -> {status.name}")
+        return int(out[0])
+
+    def list_keys(self, prefix="") -> List[bytes]:
+        status, out = self._roundtrip(Op.LIST_KEYS, [self._k(prefix)], self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"list_keys -> {status.name}")
+        return out
+
+    def multi_set(self, items: dict) -> None:
+        args: List[bytes] = []
+        for k, v in items.items():
+            args += [self._k(k), self._v(v)]
+        status, _ = self._roundtrip(Op.MULTI_SET, args, self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"multi_set -> {status.name}")
+
+    def multi_get(self, keys: Sequence) -> Optional[List[bytes]]:
+        status, out = self._roundtrip(Op.MULTI_GET, [self._k(k) for k in keys], self.timeout)
+        if status == Status.KEY_MISS:
+            return None
+        if status != Status.OK:
+            raise StoreError(f"multi_get -> {status.name}")
+        return out
+
+
+class PrefixStore:
+    """Key-namespace wrapper (equivalent of torch's PrefixStore, used for the
+    per-iteration namespaces in ``inprocess/wrap.py:512``)."""
+
+    def __init__(self, prefix: str, store):
+        self._prefix = prefix.rstrip("/") + "/"
+        self._store = store
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def base(self):
+        return self._store
+
+    def _p(self, key) -> str:
+        key = key.decode() if isinstance(key, bytes) else key
+        return self._prefix + key
+
+    def clone(self) -> "PrefixStore":
+        return PrefixStore(self._prefix, self._store.clone())
+
+    def close(self) -> None:
+        self._store.close()
+
+    @property
+    def timeout(self) -> float:
+        return self._store.timeout
+
+    def ping(self) -> bool:
+        return self._store.ping()
+
+    def set(self, key, value) -> None:
+        return self._store.set(self._p(key), value)
+
+    def get(self, key, timeout: Optional[float] = None) -> bytes:
+        return self._store.get(self._p(key), timeout)
+
+    def try_get(self, key) -> Optional[bytes]:
+        return self._store.try_get(self._p(key))
+
+    def add(self, key, amount: int = 1) -> int:
+        return self._store.add(self._p(key), amount)
+
+    def append(self, key, value) -> int:
+        return self._store.append(self._p(key), value)
+
+    def compare_set(self, key, expected, desired) -> bytes:
+        return self._store.compare_set(self._p(key), expected, desired)
+
+    def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        return self._store.wait([self._p(k) for k in keys], timeout)
+
+    def check(self, keys: Sequence) -> bool:
+        return self._store.check([self._p(k) for k in keys])
+
+    def delete(self, key) -> bool:
+        return self._store.delete(self._p(key))
+
+    def num_keys(self) -> int:
+        return self._store.num_keys()
+
+    def list_keys(self, prefix="") -> List[bytes]:
+        p = prefix.decode() if isinstance(prefix, bytes) else prefix
+        return self._store.list_keys(self._prefix + p)
+
+    def multi_set(self, items: dict) -> None:
+        return self._store.multi_set({self._p(k): v for k, v in items.items()})
+
+    def multi_get(self, keys: Sequence):
+        return self._store.multi_get([self._p(k) for k in keys])
+
+
+def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
+    """Connect using TPURX_STORE_ADDR / TPURX_STORE_PORT env (set by launcher)."""
+    host = os.environ.get("TPURX_STORE_ADDR", "127.0.0.1")
+    port = int(os.environ.get("TPURX_STORE_PORT", "29500"))
+    return StoreClient(host, port, timeout=timeout)

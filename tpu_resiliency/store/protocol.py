@@ -1,0 +1,77 @@
+"""Wire protocol for the tpurx KV store.
+
+Fixed binary framing, designed to be trivially implementable in C++:
+
+Request frame:
+    u8  opcode
+    u32 nargs                (little-endian)
+    repeated nargs times:
+        u32 len
+        len bytes
+
+Response frame:
+    u8  status               (0=OK, 1=KEY_MISS, 2=TIMEOUT, 3=ERROR, 4=CAS_FAIL)
+    u32 nargs
+    repeated args as above
+
+All integers (ADD amounts/results) travel as ASCII decimal bytes so the
+store itself stays type-agnostic (same choice the reference's TCPStore makes).
+"""
+
+from __future__ import annotations
+
+import struct
+from enum import IntEnum
+
+
+class Op(IntEnum):
+    SET = 1
+    GET = 2          # blocking get: waits for key (args: key, timeout_ms)
+    TRY_GET = 3      # immediate get; KEY_MISS if absent
+    ADD = 4          # atomic add (args: key, amount) -> new value
+    APPEND = 5       # append bytes to key (creates if absent) -> new length
+    COMPARE_SET = 6  # args: key, expected, desired -> actual value after op.
+                     # expected=="" means "set only if absent" (TCPStore semantics)
+    WAIT = 7         # args: timeout_ms, key... ; blocks until all exist
+    CHECK = 8        # args: key... -> b"1"/b"0"
+    DELETE = 9       # args: key -> b"1" if removed
+    NUM_KEYS = 10
+    PING = 11
+    LIST_KEYS = 12   # args: prefix -> all keys with that prefix
+    MULTI_SET = 13   # args: k1, v1, k2, v2, ...
+    MULTI_GET = 14   # immediate; args: key... -> value per key (KEY_MISS if any absent)
+
+
+class Status(IntEnum):
+    OK = 0
+    KEY_MISS = 1
+    TIMEOUT = 2
+    ERROR = 3
+    CAS_FAIL = 4
+
+
+_U32 = struct.Struct("<I")
+
+
+def encode_frame(code: int, args: list[bytes]) -> bytes:
+    parts = [bytes([code]), _U32.pack(len(args))]
+    for a in args:
+        parts.append(_U32.pack(len(a)))
+        parts.append(a)
+    return b"".join(parts)
+
+
+def encode_request(op: Op, *args: bytes) -> bytes:
+    return encode_frame(int(op), list(args))
+
+
+def encode_response(status: Status, *args: bytes) -> bytes:
+    return encode_frame(int(status), list(args))
+
+
+def itob(value: int) -> bytes:
+    return str(int(value)).encode()
+
+
+def btoi(value: bytes) -> int:
+    return int(value.decode())

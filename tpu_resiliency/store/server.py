@@ -1,0 +1,231 @@
+"""Asyncio KV store server.
+
+TPU-native equivalent of hosting a ``TCPStore`` (reference:
+``fault_tolerance/c10d_monkey_patch.py:112`` creates it;
+``inprocess/store.py:324-366`` hosts it with failover).  Single-threaded
+asyncio: every mutation is atomic with respect to other requests, which gives
+us the compare_set / add atomicity the rendezvous protocol relies on without
+locks.  Blocking ops (GET/WAIT) park an ``asyncio.Event`` per key.
+
+Run standalone:  python -m tpu_resiliency.store.server --port 29500
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ..utils.logging import get_logger
+from .protocol import Op, Status, encode_response, itob
+
+log = get_logger("store.server")
+
+_U32 = struct.Struct("<I")
+
+
+class StoreServer:
+    """In-memory KV store with blocking waits, served over TCP."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port
+        self._data: Dict[bytes, bytes] = {}
+        self._waiters: Dict[bytes, Set[asyncio.Event]] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # -- storage ops (run on the event loop; atomic wrt each other) --------
+
+    def _notify(self, key: bytes) -> None:
+        for ev in self._waiters.pop(key, set()):
+            ev.set()
+
+    def _set(self, key: bytes, value: bytes) -> None:
+        self._data[key] = value
+        self._notify(key)
+
+    async def _wait_for_keys(self, keys: List[bytes], timeout_ms: int) -> Status:
+        deadline = time.monotonic() + timeout_ms / 1000.0
+        for key in keys:
+            while key not in self._data:
+                ev = asyncio.Event()
+                self._waiters.setdefault(key, set()).add(ev)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._waiters.get(key, set()).discard(ev)
+                    return Status.TIMEOUT
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    self._waiters.get(key, set()).discard(ev)
+                    return Status.TIMEOUT
+        return Status.OK
+
+    async def _handle_request(self, op: Op, args: List[bytes]) -> bytes:
+        data = self._data
+        if op == Op.SET:
+            self._set(args[0], args[1])
+            return encode_response(Status.OK)
+        if op == Op.TRY_GET:
+            val = data.get(args[0])
+            if val is None:
+                return encode_response(Status.KEY_MISS)
+            return encode_response(Status.OK, val)
+        if op == Op.GET:
+            key, timeout_ms = args[0], int(args[1])
+            status = await self._wait_for_keys([key], timeout_ms)
+            if status != Status.OK:
+                return encode_response(status)
+            return encode_response(Status.OK, data[key])
+        if op == Op.ADD:
+            key, amount = args[0], int(args[1])
+            new = int(data.get(key, b"0")) + amount
+            self._set(key, itob(new))
+            return encode_response(Status.OK, itob(new))
+        if op == Op.APPEND:
+            key = args[0]
+            new = data.get(key, b"") + args[1]
+            self._set(key, new)
+            return encode_response(Status.OK, itob(len(new)))
+        if op == Op.COMPARE_SET:
+            key, expected, desired = args
+            current = data.get(key)
+            if (current is None and expected == b"") or current == expected:
+                self._set(key, desired)
+                return encode_response(Status.OK, desired)
+            return encode_response(Status.CAS_FAIL, current if current is not None else b"")
+        if op == Op.WAIT:
+            timeout_ms = int(args[0])
+            status = await self._wait_for_keys(list(args[1:]), timeout_ms)
+            return encode_response(status)
+        if op == Op.CHECK:
+            ok = all(k in data for k in args)
+            return encode_response(Status.OK, b"1" if ok else b"0")
+        if op == Op.DELETE:
+            existed = args[0] in data
+            data.pop(args[0], None)
+            return encode_response(Status.OK, b"1" if existed else b"0")
+        if op == Op.NUM_KEYS:
+            return encode_response(Status.OK, itob(len(data)))
+        if op == Op.PING:
+            return encode_response(Status.OK, b"pong")
+        if op == Op.LIST_KEYS:
+            prefix = args[0]
+            keys = [k for k in data if k.startswith(prefix)]
+            return encode_response(Status.OK, *keys)
+        if op == Op.MULTI_SET:
+            for i in range(0, len(args), 2):
+                self._set(args[i], args[i + 1])
+            return encode_response(Status.OK)
+        if op == Op.MULTI_GET:
+            vals = []
+            for k in args:
+                v = data.get(k)
+                if v is None:
+                    return encode_response(Status.KEY_MISS, k)
+                vals.append(v)
+            return encode_response(Status.OK, *vals)
+        return encode_response(Status.ERROR, b"unknown op")
+
+    # -- connection handling ----------------------------------------------
+
+    async def _read_exact(self, reader: asyncio.StreamReader, n: int) -> bytes:
+        return await reader.readexactly(n)
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                header = await reader.read(1)
+                if not header:
+                    break
+                op = Op(header[0])
+                (nargs,) = _U32.unpack(await self._read_exact(reader, 4))
+                args = []
+                for _ in range(nargs):
+                    (ln,) = _U32.unpack(await self._read_exact(reader, 4))
+                    args.append(await self._read_exact(reader, ln) if ln else b"")
+                try:
+                    resp = await self._handle_request(op, args)
+                except Exception as exc:  # noqa: BLE001 - report to client
+                    log.exception("store op %s failed", op)
+                    resp = encode_response(Status.ERROR, str(exc).encode())
+                writer.write(resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start_async(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._started.set()
+        log.info("store server listening on %s:%s", self.host, self.port)
+
+    async def serve_async(self) -> None:
+        await self.start_async()
+        async with self._server:
+            await self._server.serve_forever()
+
+    def start_in_thread(self) -> "StoreServer":
+        """Host the store on a daemon thread (used by launchers and tests)."""
+
+        def _run():
+            try:
+                asyncio.run(self.serve_async())
+            except asyncio.CancelledError:
+                pass
+
+        self._thread = threading.Thread(target=_run, name="tpurx-store", daemon=True)
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("store server failed to start")
+        return self
+
+    def stop(self) -> None:
+        loop, server = self._loop, self._server
+        if loop and server:
+            def _close():
+                server.close()
+                for task in asyncio.all_tasks(loop):
+                    task.cancel()
+            try:
+                loop.call_soon_threadsafe(_close)
+            except RuntimeError:
+                pass
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def serve_forever(host: str, port: int) -> None:
+    asyncio.run(StoreServer(host, port).serve_async())
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="tpurx KV store server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=29500)
+    args = parser.parse_args()
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    serve_forever(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Shared horizontal substrate (reference: ``shared_utils/``)."""
+
+from .logging import LogConfig, setup_logger, get_logger
+from .profiling import ProfilingEvent, ProfilingRecorder, record_event
+from .inject_fault import Fault, inject_fault
+
+__all__ = [
+    "LogConfig",
+    "setup_logger",
+    "get_logger",
+    "ProfilingEvent",
+    "ProfilingRecorder",
+    "record_event",
+    "Fault",
+    "inject_fault",
+]

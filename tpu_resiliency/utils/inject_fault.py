@@ -1,0 +1,138 @@
+"""Fault injection — a product feature used by tests.
+
+Capability parity with ``shared_utils/inject_fault.py:34-60`` (``Fault`` enum +
+scheduling thread) re-targeted at TPU/JAX failure modes: instead of
+GPU_ERROR/GPU_SLEEP we inject device-computation hangs (an XLA program that
+spins), host hangs (GIL held / released), exceptions, signals, and hard exits.
+
+Usage (also driven by env, so launchers can inject into workers):
+
+    TPURX_FAULT=exc:12.5  -> raise after 12.5s
+    TPURX_FAULT=sigkill:30
+    TPURX_FAULT=hang:10        (GIL-released host hang)
+    TPURX_FAULT=gil_hang:10    (GIL-holding hang — tests hard-timeout path)
+    TPURX_FAULT=exit:5
+Optionally gate on rank: TPURX_FAULT_RANKS=0,3
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from .logging import get_logger
+
+log = get_logger("inject_fault")
+
+ENV_FAULT = "TPURX_FAULT"
+ENV_FAULT_RANKS = "TPURX_FAULT_RANKS"
+
+
+class Fault(str, enum.Enum):
+    EXC = "exc"              # asynchronously raise in main thread
+    HANG = "hang"            # GIL-released infinite sleep in main-thread hijack
+    GIL_HANG = "gil_hang"    # hold the GIL forever (C-level busy loop)
+    SIGKILL = "sigkill"
+    SIGTERM = "sigterm"
+    SIGSEGV = "sigsegv"
+    EXIT = "exit"            # os._exit(1)
+    DEVICE_HANG = "device_hang"  # submit a long-spinning XLA program
+
+
+class InjectedException(Exception):
+    """Raised by Fault.EXC."""
+
+
+def _async_raise_main(exc_type: type) -> None:
+    """Raise `exc_type` asynchronously in the main thread (CPython API)."""
+    main_tid = threading.main_thread().ident
+    assert main_tid is not None
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(main_tid), ctypes.py_object(exc_type)
+    )
+    if res > 1:  # pragma: no cover - undo on over-application
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(main_tid), None)
+
+
+def _gil_hang() -> None:
+    # Hold the GIL: a pure-C loop via ctypes that never releases.
+    # time.sleep releases the GIL, so use a busy spin in Python instead;
+    # CPython releases the GIL between bytecodes, so to truly hold it we
+    # call a blocking C function without GIL release. getchar() on a pipe
+    # with no data holds... actually simplest robust approach: execute a
+    # regex catastrophic loop is unreliable; use a tight loop that never
+    # yields by disabling switch interval.
+    import sys
+
+    sys.setswitchinterval(1e9)
+    while True:
+        pass
+
+
+def _device_hang() -> None:
+    """Submit an XLA while-loop that never terminates, then block on it."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def spin(x):
+        return lax.while_loop(lambda c: c[1] >= 0, lambda c: (c[0] + 1.0, c[1]), (x, jnp.int32(1)))
+
+    out = jax.jit(spin)(jnp.float32(0.0))
+    jax.block_until_ready(out)  # never returns
+
+
+def _fire(fault: Fault) -> None:
+    log.warning("Injecting fault: %s (pid=%s)", fault.value, os.getpid())
+    if fault == Fault.EXC:
+        _async_raise_main(InjectedException)
+    elif fault == Fault.HANG:
+        # Replace forward progress: the injector thread can't stop the main
+        # thread without holding the GIL, so we raise a hijack exception the
+        # wrapper maps to an infinite sleep. Simpler and just as effective
+        # for testing hang detection: stop sending heartbeats is up to the
+        # workload; here we SIGSTOP ourselves (GIL-released "hang").
+        os.kill(os.getpid(), signal.SIGSTOP)
+    elif fault == Fault.GIL_HANG:
+        _gil_hang()
+    elif fault == Fault.SIGKILL:
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif fault == Fault.SIGTERM:
+        os.kill(os.getpid(), signal.SIGTERM)
+    elif fault == Fault.SIGSEGV:
+        os.kill(os.getpid(), signal.SIGSEGV)
+    elif fault == Fault.EXIT:
+        os._exit(1)
+    elif fault == Fault.DEVICE_HANG:
+        _device_hang()
+
+
+def inject_fault(fault: Fault, delay: float = 0.0) -> threading.Thread:
+    """Schedule `fault` to fire after `delay` seconds (daemon thread)."""
+
+    def _runner():
+        if delay:
+            time.sleep(delay)
+        _fire(fault)
+
+    t = threading.Thread(target=_runner, name=f"tpurx-fault-{fault.value}", daemon=True)
+    t.start()
+    return t
+
+
+def maybe_inject_from_env(rank: Optional[int] = None) -> Optional[threading.Thread]:
+    """Parse TPURX_FAULT / TPURX_FAULT_RANKS and schedule if applicable."""
+    spec = os.environ.get(ENV_FAULT)
+    if not spec:
+        return None
+    ranks = os.environ.get(ENV_FAULT_RANKS)
+    if ranks is not None and rank is not None:
+        if rank not in {int(r) for r in ranks.split(",") if r.strip()}:
+            return None
+    name, _, delay_s = spec.partition(":")
+    return inject_fault(Fault(name), float(delay_s) if delay_s else 0.0)

@@ -1,0 +1,119 @@
+"""Structured, rank-aware logging.
+
+Capability parity with the reference's ``shared_utils/log_manager.py:105-429``
+(``LogConfig`` / ``setup_logger``): env-driven levels, rank / node prefixes,
+optional node-local file sink.  Re-designed, not ported: a single module-level
+logger hierarchy under ``"tpurx"`` with lazily-resolved rank info, because in
+a JAX process the rank comes from the launcher env (``TPURX_RANK``) or from
+``jax.process_index()`` once distributed init has happened — never from
+torch.distributed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import socket
+import sys
+from typing import Optional
+
+_ROOT_NAME = "tpurx"
+
+# Env knobs (reference analog: NVRX_LOG_DEBUG etc.)
+ENV_LOG_LEVEL = "TPURX_LOG_LEVEL"
+ENV_LOG_FILE = "TPURX_LOG_FILE"
+ENV_RANK = "TPURX_RANK"
+ENV_INFRA_RANK = "TPURX_INFRA_RANK"
+
+
+@dataclasses.dataclass
+class LogConfig:
+    """Logging configuration.
+
+    Attributes:
+        level: log level name ("DEBUG", "INFO", ...). Env ``TPURX_LOG_LEVEL``
+            overrides.
+        to_file: optional path for a per-process log file; ``%r`` expands to
+            the rank, ``%h`` to the hostname.  Env ``TPURX_LOG_FILE``.
+        rank: explicit rank for the prefix; defaults to env / unknown.
+        stream: stream for the console handler.
+    """
+
+    level: str = "INFO"
+    to_file: Optional[str] = None
+    rank: Optional[int] = None
+    stream: object = None
+
+    @classmethod
+    def from_env(cls) -> "LogConfig":
+        return cls(
+            level=os.environ.get(ENV_LOG_LEVEL, "INFO"),
+            to_file=os.environ.get(ENV_LOG_FILE),
+        )
+
+
+def _resolve_rank(explicit: Optional[int] = None) -> str:
+    if explicit is not None:
+        return str(explicit)
+    for key in (ENV_RANK, "TPURX_GROUP_RANK", ENV_INFRA_RANK):
+        val = os.environ.get(key)
+        if val is not None:
+            return val
+    return "?"
+
+
+class _RankFilter(logging.Filter):
+    """Injects rank/host fields into every record (cheap, lazy)."""
+
+    def __init__(self, rank: Optional[int] = None):
+        super().__init__()
+        self._rank = rank
+        self._host = socket.gethostname()
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank = _resolve_rank(self._rank)
+        record.host = self._host
+        return True
+
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(host)s:r%(rank)s] [%(name)s] %(message)s"
+
+
+def setup_logger(config: Optional[LogConfig] = None) -> logging.Logger:
+    """Configure and return the root ``tpurx`` logger. Idempotent."""
+    cfg = config or LogConfig.from_env()
+    logger = logging.getLogger(_ROOT_NAME)
+    level = getattr(logging, os.environ.get(ENV_LOG_LEVEL, cfg.level).upper(), logging.INFO)
+    logger.setLevel(level)
+    if getattr(logger, "_tpurx_configured", False):
+        return logger
+
+    logger.propagate = False
+    rank_filter = _RankFilter(cfg.rank)
+    formatter = logging.Formatter(_FORMAT)
+
+    console = logging.StreamHandler(cfg.stream or sys.stderr)
+    console.setFormatter(formatter)
+    console.addFilter(rank_filter)
+    logger.addHandler(console)
+
+    to_file = os.environ.get(ENV_LOG_FILE, cfg.to_file)
+    if to_file:
+        path = to_file.replace("%r", _resolve_rank(cfg.rank)).replace(
+            "%h", socket.gethostname()
+        )
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fh = logging.FileHandler(path)
+        fh.setFormatter(formatter)
+        fh.addFilter(rank_filter)
+        logger.addHandler(fh)
+
+    logger._tpurx_configured = True  # type: ignore[attr-defined]
+    return logger
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    """Child logger under the ``tpurx`` hierarchy; configures root on first use."""
+    setup_logger()
+    return logging.getLogger(f"{_ROOT_NAME}.{name}" if name else _ROOT_NAME)

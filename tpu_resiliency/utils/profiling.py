@@ -1,0 +1,104 @@
+"""Cycle-stamped profiling event recorder.
+
+Capability parity with ``shared_utils/profiling.py:28-149``
+(``FaultToleranceProfiler``): a tiny append-only event log around the restart
+pipeline — FAILURE_DETECTED → RENDEZVOUS_* → WORKER_START_* — which is how
+hang-detection latency and restart latency are measured end to end.
+
+Events are JSON lines so external tooling (and our own bench) can consume
+them without importing the package.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ProfilingEvent(str, enum.Enum):
+    # Detection
+    FAILURE_DETECTED = "failure_detected"
+    HANG_DETECTED = "hang_detected"
+    STRAGGLER_DETECTED = "straggler_detected"
+    # Restart pipeline
+    RENDEZVOUS_STARTED = "rendezvous_started"
+    RENDEZVOUS_COMPLETED = "rendezvous_completed"
+    WORKER_START_REQUESTED = "worker_start_requested"
+    WORKER_STARTED = "worker_started"
+    WORKER_STOP_REQUESTED = "worker_stop_requested"
+    WORKER_STOPPED = "worker_stopped"
+    # Checkpointing
+    CHECKPOINT_SAVE_STARTED = "checkpoint_save_started"
+    CHECKPOINT_SAVE_FINALIZED = "checkpoint_save_finalized"
+    CHECKPOINT_LOAD_STARTED = "checkpoint_load_started"
+    CHECKPOINT_LOAD_COMPLETED = "checkpoint_load_completed"
+    # In-process restart
+    INPROCESS_INTERRUPTED = "inprocess_interrupted"
+    INPROCESS_RESTART_STARTED = "inprocess_restart_started"
+    INPROCESS_RESTART_COMPLETED = "inprocess_restart_completed"
+    # Health
+    HEALTH_CHECK_STARTED = "health_check_started"
+    HEALTH_CHECK_COMPLETED = "health_check_completed"
+
+
+class ProfilingRecorder:
+    """Thread-safe in-memory recorder with optional JSONL file sink."""
+
+    def __init__(self, path: Optional[str] = None, cycle: int = 0):
+        self._path = path
+        self._cycle = cycle
+        self._lock = threading.Lock()
+        self._events: List[Dict[str, Any]] = []
+
+    def set_cycle(self, cycle: int) -> None:
+        self._cycle = cycle
+
+    def record(self, event: ProfilingEvent, **extra: Any) -> Dict[str, Any]:
+        rec = {
+            "ts": time.time(),
+            "mono_ns": time.monotonic_ns(),
+            "event": str(event.value),
+            "cycle": self._cycle,
+            "pid": os.getpid(),
+            **extra,
+        }
+        with self._lock:
+            self._events.append(rec)
+            if self._path:
+                try:
+                    with open(self._path, "a") as f:
+                        f.write(json.dumps(rec) + "\n")
+                except OSError:
+                    pass
+        return rec
+
+    @property
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def latency_ns(self, start: ProfilingEvent, end: ProfilingEvent) -> Optional[int]:
+        """Monotonic delta between the last `start` and the first later `end`."""
+        events = self.events
+        start_ns = None
+        for rec in events:
+            if rec["event"] == start.value:
+                start_ns = rec["mono_ns"]
+            elif rec["event"] == end.value and start_ns is not None:
+                return rec["mono_ns"] - start_ns
+        return None
+
+
+_global_recorder = ProfilingRecorder(path=os.environ.get("TPURX_PROFILING_FILE"))
+
+
+def get_recorder() -> ProfilingRecorder:
+    return _global_recorder
+
+
+def record_event(event: ProfilingEvent, **extra: Any) -> Dict[str, Any]:
+    return _global_recorder.record(event, **extra)
