@@ -1,0 +1,203 @@
+"""Rank monitor server/client tests.
+
+Mirrors reference ``tests/fault_tolerance/unit/test_rank_monitor_server.py``:
+runs a real RankMonitorServer (in-thread asyncio here; subprocess covered by
+launcher tests) and exercises heartbeat/section timeout detection with an
+injectable kill function.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+from tpu_resiliency.fault_tolerance.data import RankInfo
+from tpu_resiliency.fault_tolerance.rank_monitor_client import RankMonitorClient
+from tpu_resiliency.fault_tolerance.rank_monitor_server import RankMonitorServer
+
+
+class ServerThread:
+    """Run RankMonitorServer's asyncio loop on a daemon thread."""
+
+    def __init__(self, cfg, socket_path, kill_fn=None):
+        self.server = RankMonitorServer(cfg, socket_path, kill_fn=kill_fn)
+        self._loop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(5)
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self.server.run_async(self._started))
+        except Exception:
+            pass
+
+    def stop(self):
+        if self._loop:
+            self._loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(self._loop)]
+            )
+        self._thread.join(timeout=3)
+
+
+@pytest.fixture
+def monitor(tmp_path):
+    def make(cfg, kill_fn=None):
+        path = str(tmp_path / "monitor.sock")
+        st = ServerThread(cfg, path, kill_fn=kill_fn)
+        return st, path
+
+    made = []
+
+    def wrapper(cfg, kill_fn=None):
+        st, path = make(cfg, kill_fn)
+        made.append(st)
+        return st, path
+
+    yield wrapper
+    for st in made:
+        st.stop()
+
+
+def _client(cfg, path, rank=0):
+    client = RankMonitorClient(cfg)
+    client.init_workload_monitoring(
+        socket_path=path, rank_info=RankInfo(global_rank=rank, local_rank=rank, pid=12345)
+    )
+    return client
+
+
+def test_init_and_heartbeat(monitor):
+    cfg = FaultToleranceConfig(workload_check_interval=0.1, skip_section_response=False)
+    st, path = monitor(cfg)
+    client = _client(cfg, path)
+    assert client.hb_timeouts.initial == cfg.initial_rank_heartbeat_timeout
+    for _ in range(3):
+        client.send_heartbeat()
+    assert st.server.state.last_hb is not None
+    client.shutdown_workload_monitoring()
+
+
+def test_heartbeat_timeout_kills_rank(monitor):
+    killed = []
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=0.3,
+        rank_heartbeat_timeout=0.3,
+        workload_check_interval=0.05,
+    )
+    st, path = monitor(cfg, kill_fn=lambda pid, sig: killed.append((pid, sig)))
+    client = _client(cfg, path)
+    client.send_heartbeat()
+    deadline = time.monotonic() + 3.0
+    while not killed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert killed and killed[0][0] == 12345
+
+
+def test_no_initial_heartbeat_detected(monitor):
+    killed = []
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=0.2,
+        workload_check_interval=0.05,
+    )
+    st, path = monitor(cfg, kill_fn=lambda pid, sig: killed.append(pid))
+    client = _client(cfg, path)  # never heartbeats; keep alive so UDS stays open
+    assert client.is_initialized
+    deadline = time.monotonic() + 3.0
+    while not killed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert killed == [12345]
+
+
+def test_section_timeout(monitor):
+    killed = []
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=None,
+        rank_heartbeat_timeout=None,
+        rank_section_timeouts={"step": 0.2},
+        workload_check_interval=0.05,
+        skip_section_response=False,
+    )
+    st, path = monitor(cfg, kill_fn=lambda pid, sig: killed.append(pid))
+    client = _client(cfg, path)
+    client.start_section("step")
+    time.sleep(0.1)
+    client.end_section("step")   # within timeout: fine
+    assert not killed
+    client.start_section("step")  # now hang inside the section
+    deadline = time.monotonic() + 3.0
+    while not killed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert killed == [12345]
+
+
+def test_out_of_section_timeout(monitor):
+    killed = []
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=None,
+        rank_heartbeat_timeout=None,
+        rank_section_timeouts={"step": 5.0},
+        rank_out_of_section_timeout=0.2,
+        workload_check_interval=0.05,
+        skip_section_response=False,
+    )
+    st, path = monitor(cfg, kill_fn=lambda pid, sig: killed.append(pid))
+    client = _client(cfg, path)
+    client.start_section("step")
+    client.end_section("step")
+    # now "hang" outside any section
+    deadline = time.monotonic() + 3.0
+    while not killed and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert killed == [12345]
+
+
+def test_calculated_timeouts_roundtrip(monitor):
+    cfg = FaultToleranceConfig(workload_check_interval=5.0, skip_section_response=False)
+    st, path = monitor(cfg)
+    client = _client(cfg, path)
+    client.send_heartbeat()
+    time.sleep(0.05)
+    client.send_heartbeat()
+    new = client.calculate_and_set_hb_timeouts()
+    assert new.were_calculated
+    assert st.server.hb_timeouts.were_calculated
+    assert st.server.hb_timeouts.initial == pytest.approx(new.initial)
+    # persistence roundtrip: state_dict -> new client -> restore on init
+    state = client.state_dict()
+    client.shutdown_workload_monitoring()
+    client2 = RankMonitorClient(cfg)
+    client2.load_state_dict(state)
+    client2.init_workload_monitoring(
+        socket_path=path, rank_info=RankInfo(global_rank=0, local_rank=0, pid=12345)
+    )
+    assert client2.hb_timeouts.were_calculated
+    assert client2.hb_timeouts.initial == pytest.approx(new.initial)
+    client2.shutdown_workload_monitoring()
+
+
+def test_monitor_in_subprocess(tmp_path):
+    """Full-fidelity path: monitor as a separate process, like the launcher runs it."""
+    cfg = FaultToleranceConfig(workload_check_interval=0.1, skip_section_response=False)
+    path = str(tmp_path / "sub.sock")
+    proc, ctrl = RankMonitorServer.run_in_subprocess(cfg, path)
+    try:
+        client = _client(cfg, path)
+        client.send_heartbeat()
+        ctrl.send({"cmd": "cycle", "cycle": 7})
+        time.sleep(0.5)
+        # reconnect gets the new cycle number
+        client.shutdown_workload_monitoring()
+        client2 = _client(cfg, path)
+        assert client2.cycle == 7
+        client2.shutdown_workload_monitoring()
+    finally:
+        ctrl.send({"cmd": "shutdown"})
+        proc.join(timeout=5)
+        if proc.is_alive():
+            proc.terminate()

@@ -115,7 +115,7 @@ def test_prefix_store(store):
     nested = PrefixStore("inner", ps)
     nested.set("deep", b"d")
     assert store.get("iter/0/inner/deep") == b"d"
-    assert ps.list_keys() == [b"iter/0/k", b"iter/0/c", b"iter/0/inner/deep"] or True
+    assert sorted(ps.list_keys()) == [b"iter/0/c", b"iter/0/inner/deep", b"iter/0/k"]
     assert sorted(ps.list_keys("inner/")) == [b"iter/0/inner/deep"]
 
 

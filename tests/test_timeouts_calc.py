@@ -1,0 +1,115 @@
+"""TimeoutsCalc unit tests (reference analog: tests/fault_tolerance/unit/test_timeouts_calc.py)."""
+
+import threading
+
+import pytest
+
+from tpu_resiliency.fault_tolerance.data import HeartbeatTimeouts
+from tpu_resiliency.fault_tolerance.timeouts import TimeoutsCalc, TimeoutsCalcError
+from tpu_resiliency.store import StoreClient
+
+
+def test_heartbeat_observation():
+    tc = TimeoutsCalc(start_time=100.0, safety_factor=5.0)
+    assert not tc.can_get_hb_timeouts
+    tc.update_on_heartbeat(now=102.0)   # initial = 2.0
+    assert not tc.can_get_hb_timeouts
+    tc.update_on_heartbeat(now=103.0)   # subsequent = 1.0
+    tc.update_on_heartbeat(now=106.0)   # subsequent = 3.0
+    assert tc.can_get_hb_timeouts
+    t = tc.calculate_hb_timeouts()
+    assert t.initial == pytest.approx(10.0)
+    assert t.subsequent == pytest.approx(15.0)
+    assert t.were_calculated
+
+
+def test_hb_timeout_ema_never_shrinks_below_needed():
+    tc = TimeoutsCalc(start_time=0.0, safety_factor=2.0, ema_alpha=0.5)
+    tc.update_on_heartbeat(now=1.0)
+    tc.update_on_heartbeat(now=2.0)
+    current = HeartbeatTimeouts(initial=100.0, subsequent=100.0, were_calculated=True)
+    t = tc.calculate_hb_timeouts(current)
+    # EMA of (2, 100) = 51, and >= 2*observed
+    assert t.initial == pytest.approx(51.0)
+    # configured (not calculated) timeouts are replaced, not merged
+    configured = HeartbeatTimeouts(initial=100.0, subsequent=100.0, were_calculated=False)
+    t2 = tc.calculate_hb_timeouts(configured)
+    assert t2.initial == pytest.approx(2.0)
+
+
+def test_sections():
+    tc = TimeoutsCalc(start_time=0.0, safety_factor=2.0, sections=("step",))
+    tc.update_on_section_start("step", now=5.0)   # out-of-section gap: 5
+    tc.update_on_section_end("step", now=7.0)     # step: 2
+    tc.update_on_section_start("step", now=8.0)   # oos: 1
+    tc.update_on_section_end("step", now=12.0)    # step: 4
+    t = tc.calculate_section_timeouts()
+    assert t.section["step"] == pytest.approx(8.0)
+    assert t.out_of_section == pytest.approx(10.0)
+    assert "step" in t.calculated_sections
+    with pytest.raises(TimeoutsCalcError):
+        tc.update_on_section_end("never-opened")
+
+
+def test_section_nesting_error():
+    tc = TimeoutsCalc(start_time=0.0)
+    tc.update_on_section_start("a", now=1.0)
+    with pytest.raises(TimeoutsCalcError):
+        tc.update_on_section_start("a", now=2.0)
+
+
+def test_synchronize_all_store_max(store_server):
+    world = 3
+    results = {}
+
+    def member(rank):
+        c = StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+        tc = TimeoutsCalc(start_time=0.0, safety_factor=2.0)
+        tc.update_on_heartbeat(now=1.0 + rank)        # initial = 1+rank
+        tc.update_on_heartbeat(now=1.0 + rank + (rank + 1) * 0.5)  # subseq
+        tc.synchronize_all(store=c, rank=rank, world_size=world)
+        results[rank] = (tc.initial_max, tc.subsequent_max)
+        c.close()
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # all ranks converge on the global max
+    assert all(results[r] == results[0] for r in range(world))
+    assert results[0][0] == pytest.approx(3.0)   # max initial
+    assert results[0][1] == pytest.approx(1.5)   # max subsequent
+
+
+def test_synchronize_all_reduce_fn():
+    tc = TimeoutsCalc(start_time=0.0)
+    tc.update_on_heartbeat(now=2.0)
+    tc.synchronize_all(reduce_fn=lambda vals: {k: v * 10 for k, v in vals.items()})
+    assert tc.initial_max == pytest.approx(20.0)
+
+
+def test_synchronize_all_disjoint_sections(store_server):
+    """Ranks that observed different section sets merge by key union."""
+    results = {}
+
+    def member(rank, section):
+        c = StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+        tc = TimeoutsCalc(start_time=0.0, safety_factor=2.0)
+        tc.update_on_section_start(section, now=1.0)
+        tc.update_on_section_end(section, now=1.0 + (rank + 1))
+        tc.synchronize_all(store=c, rank=rank, world_size=2)
+        results[rank] = dict(tc.section_max)
+        c.close()
+
+    threads = [
+        threading.Thread(target=member, args=(0, "fwd")),
+        threading.Thread(target=member, args=(1, "bwd")),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for r in (0, 1):
+        assert results[r]["fwd"] == pytest.approx(1.0)
+        assert results[r]["bwd"] == pytest.approx(2.0)

@@ -1,0 +1,32 @@
+"""In-job restart ring (reference: ``fault_tolerance/``).
+
+Per-host elastic launcher + barrier rendezvous + per-rank monitor processes
+with heartbeat/section hang detection, re-designed for JAX/TPU workloads:
+ranks are TPU hosts/chips, the control plane is the tpurx KV store over DCN,
+and timeout synchronization uses store max-reduction (device quorum kernel in
+``tpu_resiliency.ops`` is the fast path).
+"""
+
+from .config import FaultToleranceConfig
+from .data import (
+    HeartbeatTimeouts,
+    RankInfo,
+    SectionTimeouts,
+    WorkloadAction,
+    WorkloadControlRequest,
+)
+from .rank_monitor_client import RankMonitorClient
+from .rank_monitor_server import RankMonitorServer
+from .timeouts import TimeoutsCalc
+
+__all__ = [
+    "FaultToleranceConfig",
+    "RankInfo",
+    "HeartbeatTimeouts",
+    "SectionTimeouts",
+    "WorkloadAction",
+    "WorkloadControlRequest",
+    "RankMonitorClient",
+    "RankMonitorServer",
+    "TimeoutsCalc",
+]

@@ -1,0 +1,135 @@
+"""Fault tolerance configuration.
+
+Capability parity with ``fault_tolerance/config.py:27-396``
+(``FaultToleranceConfig``): heartbeat/section timeouts, health-check toggles,
+restart policy, progress tracking — merged from dataclass defaults, a YAML
+section, and CLI/env overrides (in that order of precedence, lowest first).
+
+TPU-specific fields replace CUDA ones: no GPU-memory-reclaim wait (XLA owns
+HBM per-process; freeing is process exit), instead a device-availability
+probe; NUMA binding kept (TPU hosts are NUMA machines too).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Mapping, Optional
+
+import yaml
+
+
+@dataclasses.dataclass
+class FaultToleranceConfig:
+    # --- heartbeat hang detection ---
+    initial_rank_heartbeat_timeout: Optional[float] = 60.0 * 60
+    rank_heartbeat_timeout: Optional[float] = 45.0 * 60
+    workload_check_interval: float = 1.0
+    safety_factor: float = 5.0
+    # --- section hang detection ---
+    rank_section_timeouts: Dict[str, Optional[float]] = dataclasses.field(
+        default_factory=dict
+    )
+    rank_out_of_section_timeout: Optional[float] = None
+    # fast path: do not wait for monitor ACK on section/heartbeat messages
+    skip_section_response: bool = True
+    # --- restart policy ---
+    max_rank_restarts: int = 0  # in-job worker restarts before giving up (0 = unlimited)
+    max_no_progress_cycles: int = 3
+    restart_policy: str = "any-failed"  # any-failed | min-healthy
+    term_signal: str = "SIGKILL"
+    workers_stop_timeout: float = 15.0
+    # --- rendezvous ---
+    rdzv_round_timeout: float = 600.0
+    min_nodes: int = 1
+    max_nodes: Optional[int] = None
+    node_group_key: Optional[str] = None  # TPU slice/ICI-domain segment constraint
+    # --- health checks ---
+    enable_device_health_check: bool = True
+    enable_storage_health_check: bool = False
+    storage_health_check_path: Optional[str] = None
+    # --- progress tracking ---
+    enable_progress_tracking: bool = True
+    progress_iteration_file: Optional[str] = None
+    # --- logging / observability ---
+    log_level: str = "INFO"
+    per_cycle_log_dir: Optional[str] = None
+    profiling_file: Optional[str] = None
+    # --- timeouts persistence ---
+    state_dict_path: Optional[str] = None
+
+    ENV_PREFIX = "TPURX_FT_"
+
+    @classmethod
+    def field_names(cls) -> list[str]:
+        return [f.name for f in dataclasses.fields(cls)]
+
+    @classmethod
+    def from_yaml(cls, path: str, section: str = "fault_tolerance") -> "FaultToleranceConfig":
+        """Load from a YAML file; searches for the `section` key at any top level
+        (the reference discovers its section inside arbitrary trainer configs,
+        ``config.py:186-240``)."""
+        with open(path) as f:
+            tree = yaml.safe_load(f) or {}
+        found = _find_section(tree, section)
+        if found is None:
+            raise ValueError(f"section {section!r} not found in {path}")
+        return cls.from_dict(found)
+
+    @classmethod
+    def from_dict(cls, values: Mapping[str, Any]) -> "FaultToleranceConfig":
+        known = {k: v for k, v in values.items() if k in cls.field_names()}
+        unknown = set(values) - set(known)
+        if unknown:
+            raise ValueError(f"unknown fault_tolerance config keys: {sorted(unknown)}")
+        return cls(**known)
+
+    def merged_with(self, overrides: Mapping[str, Any]) -> "FaultToleranceConfig":
+        vals = dataclasses.asdict(self)
+        for k, v in overrides.items():
+            if v is None:
+                continue
+            if k not in vals:
+                raise ValueError(f"unknown fault_tolerance config key: {k}")
+            vals[k] = v
+        return FaultToleranceConfig(**vals)
+
+    def merged_with_env(self) -> "FaultToleranceConfig":
+        """TPURX_FT_<UPPER_FIELD> env overrides (highest precedence)."""
+        overrides: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            env_val = os.environ.get(self.ENV_PREFIX + f.name.upper())
+            if env_val is None:
+                continue
+            overrides[f.name] = _coerce(env_val, f.type)
+        return self.merged_with(overrides)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _find_section(tree: Any, section: str) -> Optional[Mapping[str, Any]]:
+    if isinstance(tree, Mapping):
+        if section in tree and isinstance(tree[section], Mapping):
+            return tree[section]
+        for v in tree.values():
+            found = _find_section(v, section)
+            if found is not None:
+                return found
+    return None
+
+
+def _coerce(value: str, type_hint: Any) -> Any:
+    hint = str(type_hint)
+    lowered = value.strip().lower()
+    if lowered in ("null", "none", ""):
+        return None
+    if "Dict" in hint or "dict" in hint:
+        return yaml.safe_load(value)
+    if "bool" in hint:
+        return lowered in ("1", "true", "yes", "on")
+    if "int" in hint and "float" not in hint:
+        return int(value)
+    if "float" in hint:
+        return float(value)
+    return value

@@ -1,0 +1,150 @@
+"""IPC message model for rank ↔ monitor ↔ launcher communication.
+
+Capability parity with ``fault_tolerance/data.py`` (RankInfo,
+Heartbeat/SectionTimeouts, message dataclasses, WorkloadAction/
+WorkloadControlRequest).  Messages serialize to JSON (not pickle): the
+channel crosses a process boundary only on the same host, but JSON keeps the
+protocol language-neutral for native monitor implementations.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import math
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class RankInfo:
+    global_rank: int
+    local_rank: int
+    host: str = ""
+    pid: int = 0
+
+    @classmethod
+    def from_env(cls) -> "RankInfo":
+        import os
+        import socket
+
+        return cls(
+            global_rank=int(os.environ.get("TPURX_RANK", os.environ.get("RANK", "0"))),
+            local_rank=int(
+                os.environ.get("TPURX_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0"))
+            ),
+            host=socket.gethostname(),
+            pid=os.getpid(),
+        )
+
+
+@dataclasses.dataclass
+class HeartbeatTimeouts:
+    """Initial (first heartbeat after start) and subsequent heartbeat timeouts.
+
+    ``were_calculated`` marks values derived from observed intervals rather
+    than configured (reference ``data.py:73-98``)."""
+
+    initial: Optional[float] = None
+    subsequent: Optional[float] = None
+    were_calculated: bool = False
+
+    @property
+    def are_valid(self) -> bool:
+        return self.initial is not None and self.subsequent is not None
+
+
+@dataclasses.dataclass
+class SectionTimeouts:
+    """Per-section timeouts + the out-of-section gap timeout.
+
+    ``calculated_sections`` lists section names whose timeouts are observed,
+    not configured (reference ``data.py:99-140``)."""
+
+    section: Dict[str, Optional[float]] = dataclasses.field(default_factory=dict)
+    out_of_section: Optional[float] = None
+    calculated_sections: tuple = ()
+    calculated_out_of_section: bool = False
+
+    def is_valid_for(self, name: str) -> bool:
+        return self.section.get(name) is not None
+
+
+class MsgType(str, enum.Enum):
+    INIT = "init"
+    HEARTBEAT = "heartbeat"
+    SECTION_START = "section_start"
+    SECTION_END = "section_end"
+    UPDATE_TIMEOUTS = "update_timeouts"
+    OK = "ok"
+    ERROR = "error"
+
+
+class WorkloadAction(str, enum.Enum):
+    Continue = "continue"
+    ExcludeThisNode = "exclude_this_node"
+    ShutdownWorkload = "shutdown_workload"
+
+
+@dataclasses.dataclass
+class WorkloadControlRequest:
+    action: WorkloadAction
+    reason: str = ""
+
+    def to_json(self) -> str:
+        return json.dumps({"action": self.action.value, "reason": self.reason})
+
+    @classmethod
+    def from_json(cls, raw: str) -> "WorkloadControlRequest":
+        d = json.loads(raw)
+        return cls(action=WorkloadAction(d["action"]), reason=d.get("reason", ""))
+
+
+# --- JSON (de)serialization for the UDS channel -----------------------------
+
+def _none_safe(v: Optional[float]) -> Optional[float]:
+    if v is None or (isinstance(v, float) and math.isinf(v)):
+        return None
+    return v
+
+
+def encode_msg(msg_type: MsgType, payload: Optional[Dict[str, Any]] = None) -> bytes:
+    return json.dumps({"type": msg_type.value, **(payload or {})}).encode()
+
+
+def decode_msg(raw: bytes) -> Dict[str, Any]:
+    return json.loads(raw.decode())
+
+
+def heartbeat_timeouts_to_dict(t: HeartbeatTimeouts) -> Dict[str, Any]:
+    return {
+        "initial": _none_safe(t.initial),
+        "subsequent": _none_safe(t.subsequent),
+        "were_calculated": t.were_calculated,
+    }
+
+
+def heartbeat_timeouts_from_dict(d: Dict[str, Any]) -> HeartbeatTimeouts:
+    return HeartbeatTimeouts(
+        initial=d.get("initial"),
+        subsequent=d.get("subsequent"),
+        were_calculated=bool(d.get("were_calculated", False)),
+    )
+
+
+def section_timeouts_to_dict(t: SectionTimeouts) -> Dict[str, Any]:
+    return {
+        "section": {k: _none_safe(v) for k, v in t.section.items()},
+        "out_of_section": _none_safe(t.out_of_section),
+        "calculated_sections": list(t.calculated_sections),
+        "calculated_out_of_section": t.calculated_out_of_section,
+    }
+
+
+def section_timeouts_from_dict(d: Dict[str, Any]) -> SectionTimeouts:
+    return SectionTimeouts(
+        section=dict(d.get("section", {})),
+        out_of_section=d.get("out_of_section"),
+        calculated_sections=tuple(d.get("calculated_sections", ())),
+        calculated_out_of_section=bool(d.get("calculated_out_of_section", False)),
+    )

@@ -1,0 +1,248 @@
+"""In-rank monitoring API.
+
+Capability parity with ``fault_tolerance/rank_monitor_client.py:52-567``
+(``RankMonitorClient``): connect to the per-rank monitor over UDS, send
+init/heartbeat/section messages (unidirectional fast path when
+``skip_section_response``), locally observe intervals via
+:class:`TimeoutsCalc`, synchronize and push calculated timeouts, persist them
+across restarts via ``state_dict()``.
+
+The heartbeat send is the hot path: with ``skip_section_response=True`` it is
+one 4-byte-framed JSON write on a connected UDS — O(10µs), negligible next to
+a training step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.ipc import _U32, recv_msg
+from ..utils.logging import get_logger
+from .config import FaultToleranceConfig
+from .data import (
+    HeartbeatTimeouts,
+    MsgType,
+    RankInfo,
+    SectionTimeouts,
+    WorkloadAction,
+    WorkloadControlRequest,
+    heartbeat_timeouts_from_dict,
+    heartbeat_timeouts_to_dict,
+    section_timeouts_from_dict,
+    section_timeouts_to_dict,
+)
+from .timeouts import TimeoutsCalc
+
+log = get_logger("rank_monitor_client")
+
+ENV_MONITOR_SOCKET = "TPURX_RANK_MONITOR_SOCKET"
+ENV_LAUNCHER_IPC_SOCKET = "TPURX_LAUNCHER_IPC_SOCKET"
+
+
+class RankMonitorClientError(RuntimeError):
+    pass
+
+
+class RankMonitorClient:
+    def __init__(self, cfg: Optional[FaultToleranceConfig] = None):
+        self.cfg = cfg or FaultToleranceConfig().merged_with_env()
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self.rank_info: Optional[RankInfo] = None
+        self.hb_timeouts: Optional[HeartbeatTimeouts] = None
+        self.section_timeouts: Optional[SectionTimeouts] = None
+        self.cycle: int = 0
+        self.timeouts_calc: Optional[TimeoutsCalc] = None
+        self._loaded_state: Optional[Dict[str, Any]] = None
+
+    # -- connection / init -------------------------------------------------
+
+    def init_workload_monitoring(
+        self, socket_path: Optional[str] = None, rank_info: Optional[RankInfo] = None
+    ) -> None:
+        path = socket_path or os.environ.get(ENV_MONITOR_SOCKET)
+        if not path:
+            raise RankMonitorClientError(
+                f"no monitor socket: set {ENV_MONITOR_SOCKET} or pass socket_path"
+            )
+        self.rank_info = rank_info or RankInfo.from_env()
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(30.0)
+        self._sock.connect(path)
+        init: Dict[str, Any] = {
+            "type": MsgType.INIT.value,
+            "rank": self.rank_info.global_rank,
+            "local_rank": self.rank_info.local_rank,
+            "pid": self.rank_info.pid,
+        }
+        if self._loaded_state:
+            init["hb_timeouts"] = self._loaded_state.get("hb_timeouts")
+            init["section_timeouts"] = self._loaded_state.get("section_timeouts")
+        reply = self._request(init)
+        self.hb_timeouts = heartbeat_timeouts_from_dict(reply["hb_timeouts"])
+        self.section_timeouts = section_timeouts_from_dict(reply["section_timeouts"])
+        self.cycle = int(reply.get("cycle", 0))
+        self.timeouts_calc = TimeoutsCalc(
+            safety_factor=self.cfg.safety_factor,
+            sections=tuple(self.section_timeouts.section),
+        )
+        log.info(
+            "workload monitoring initialized (rank=%s cycle=%s)",
+            self.rank_info.global_rank, self.cycle,
+        )
+
+    def shutdown_workload_monitoring(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                with contextlib.suppress(OSError):
+                    self._sock.close()
+                self._sock = None
+
+    @property
+    def is_initialized(self) -> bool:
+        return self._sock is not None
+
+    # -- message plumbing --------------------------------------------------
+
+    def _send(self, payload: Dict[str, Any], want_ack: bool) -> Optional[Dict[str, Any]]:
+        if self._sock is None:
+            raise RankMonitorClientError("not initialized")
+        if not want_ack:
+            payload = {**payload, "noack": True}
+        raw = json.dumps(payload).encode()
+        with self._lock:
+            self._sock.sendall(_U32.pack(len(raw)) + raw)
+            if not want_ack:
+                return None
+            reply = recv_msg(self._sock)
+        if reply is None:
+            raise RankMonitorClientError("monitor connection closed")
+        if reply.get("type") == MsgType.ERROR.value:
+            raise RankMonitorClientError(reply.get("error", "monitor error"))
+        return reply
+
+    def _request(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        reply = self._send(payload, want_ack=True)
+        assert reply is not None
+        return reply
+
+    # -- heartbeats / sections --------------------------------------------
+
+    def send_heartbeat(self) -> None:
+        ack = not self.cfg.skip_section_response
+        self._send({"type": MsgType.HEARTBEAT.value}, want_ack=ack)
+        if self.timeouts_calc is not None:
+            self.timeouts_calc.update_on_heartbeat()
+
+    def start_section(self, name: str) -> None:
+        ack = not self.cfg.skip_section_response
+        self._send({"type": MsgType.SECTION_START.value, "name": name}, want_ack=ack)
+        if self.timeouts_calc is not None:
+            self.timeouts_calc.update_on_section_start(name)
+
+    def end_section(self, name: str) -> None:
+        ack = not self.cfg.skip_section_response
+        self._send({"type": MsgType.SECTION_END.value, "name": name}, want_ack=ack)
+        if self.timeouts_calc is not None:
+            self.timeouts_calc.update_on_section_end(name)
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        self.start_section(name)
+        try:
+            yield
+        finally:
+            self.end_section(name)
+
+    # -- timeout calculation ----------------------------------------------
+
+    def calculate_and_set_hb_timeouts(
+        self, store=None, rank=None, world_size=None, reduce_fn=None
+    ) -> HeartbeatTimeouts:
+        """Sync observed maxima across ranks, derive timeouts, push to monitor.
+
+        Pass either store+rank+world_size (DCN path) or reduce_fn (device
+        pmax), or nothing for purely local calculation (single rank)."""
+        assert self.timeouts_calc is not None
+        if reduce_fn is not None or store is not None:
+            self.timeouts_calc.synchronize_all(
+                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn
+            )
+        new = self.timeouts_calc.calculate_hb_timeouts(self.hb_timeouts)
+        self.hb_timeouts = new
+        self._request(
+            {
+                "type": MsgType.UPDATE_TIMEOUTS.value,
+                "hb_timeouts": heartbeat_timeouts_to_dict(new),
+            }
+        )
+        return new
+
+    def calculate_and_set_section_timeouts(
+        self, selection=None, store=None, rank=None, world_size=None, reduce_fn=None
+    ) -> SectionTimeouts:
+        assert self.timeouts_calc is not None
+        if reduce_fn is not None or store is not None:
+            self.timeouts_calc.synchronize_all(
+                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn
+            )
+        new = self.timeouts_calc.calculate_section_timeouts(
+            self.section_timeouts, selection=selection
+        )
+        self.section_timeouts = new
+        self._request(
+            {
+                "type": MsgType.UPDATE_TIMEOUTS.value,
+                "section_timeouts": section_timeouts_to_dict(new),
+            }
+        )
+        return new
+
+    # -- persistence (reference `state_dict` :496-550) ---------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "hb_timeouts": heartbeat_timeouts_to_dict(self.hb_timeouts)
+            if self.hb_timeouts
+            else None,
+            "section_timeouts": section_timeouts_to_dict(self.section_timeouts)
+            if self.section_timeouts
+            else None,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._loaded_state = state
+        if self.is_initialized:
+            # already connected: push restored timeouts immediately
+            payload: Dict[str, Any] = {"type": MsgType.UPDATE_TIMEOUTS.value}
+            if state.get("hb_timeouts"):
+                self.hb_timeouts = heartbeat_timeouts_from_dict(state["hb_timeouts"])
+                payload["hb_timeouts"] = state["hb_timeouts"]
+            if state.get("section_timeouts"):
+                self.section_timeouts = section_timeouts_from_dict(
+                    state["section_timeouts"]
+                )
+                payload["section_timeouts"] = state["section_timeouts"]
+            self._request(payload)
+
+    # -- workload control (rank → launcher) --------------------------------
+
+    def send_workload_control_request(
+        self, action: WorkloadAction, reason: str = ""
+    ) -> None:
+        """Ask the launcher to exclude this node / shut down the workload
+        (reference ``WorkloadControlRequest``, ``data.py:272``)."""
+        from ..utils.ipc import IpcConnector
+
+        path = os.environ.get(ENV_LAUNCHER_IPC_SOCKET)
+        if not path:
+            raise RankMonitorClientError(f"{ENV_LAUNCHER_IPC_SOCKET} not set")
+        req = WorkloadControlRequest(action=action, reason=reason)
+        IpcConnector(path).send(
+            {"kind": "workload_control", "action": req.action.value, "reason": req.reason}
+        )

@@ -1,0 +1,306 @@
+"""Per-rank watchdog process: heartbeat + section hang detection.
+
+Capability parity with ``fault_tolerance/rank_monitor_server.py:122-704``
+(``RankMonitorServer``): a separate OS process per worker rank (forked by the
+launcher *before* any threads exist), hosting an asyncio unix-socket server
+the rank's :class:`RankMonitorClient` connects to.  It tracks heartbeats and
+open timed sections and, on timeout, kills the rank (SIGCONT first in case it
+is stopped, then the configured signal) so the launcher's monitor loop sees a
+failed worker and triggers the restart cycle.
+
+TPU-native notes: the watchdog is pure host-side (it must survive XLA/device
+hangs, so it never touches JAX).  The fast on-device quorum detection in
+``tpu_resiliency.ops.quorum`` complements — not replaces — this process: the
+kernel gives sub-ms detection *inside* healthy steps, this process is the
+source of truth when the device or the Python loop is gone.
+
+Control: the launcher communicates over a ``multiprocessing.Pipe`` (cycle
+updates, shutdown) instead of a second unix socket — same capability, simpler
+ownership.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import multiprocessing as mp
+import os
+import signal
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.ipc import _U32
+from ..utils.logging import get_logger, setup_logger
+from ..utils.profiling import ProfilingEvent, record_event
+from .config import FaultToleranceConfig
+from .data import (
+    HeartbeatTimeouts,
+    MsgType,
+    SectionTimeouts,
+    heartbeat_timeouts_from_dict,
+    heartbeat_timeouts_to_dict,
+    section_timeouts_from_dict,
+    section_timeouts_to_dict,
+)
+
+import json
+
+log = get_logger("rank_monitor")
+
+
+@dataclasses.dataclass
+class _RankState:
+    pid: Optional[int] = None
+    rank: Optional[int] = None
+    connected_at: Optional[float] = None
+    last_hb: Optional[float] = None
+    open_sections: Dict[str, float] = dataclasses.field(default_factory=dict)
+    last_section_activity: Optional[float] = None
+    seen_section_msgs: bool = False
+
+    def reset(self) -> None:
+        self.pid = None
+        self.rank = None
+        self.connected_at = None
+        self.last_hb = None
+        self.open_sections.clear()
+        self.last_section_activity = None
+        self.seen_section_msgs = False
+
+
+class RankMonitorServer:
+    def __init__(
+        self,
+        cfg: FaultToleranceConfig,
+        socket_path: str,
+        ctrl_conn=None,
+        kill_fn: Optional[Callable[[int, str], None]] = None,
+    ):
+        self.cfg = cfg
+        self.socket_path = socket_path
+        self.ctrl_conn = ctrl_conn
+        self._kill_fn = kill_fn or self._default_kill
+        self.hb_timeouts = HeartbeatTimeouts(
+            initial=cfg.initial_rank_heartbeat_timeout,
+            subsequent=cfg.rank_heartbeat_timeout,
+        )
+        self.section_timeouts = SectionTimeouts(
+            section=dict(cfg.rank_section_timeouts),
+            out_of_section=cfg.rank_out_of_section_timeout,
+        )
+        self.state = _RankState()
+        self.cycle = 0
+        self._hang_detected = False
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    # -- kill action -------------------------------------------------------
+
+    @staticmethod
+    def _default_kill(pid: int, sig_name: str) -> None:
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except OSError:
+            pass
+        sig = getattr(signal, sig_name, signal.SIGKILL)
+        try:
+            os.kill(pid, sig)
+        except OSError:
+            pass
+
+    def _shutdown_rank(self, reason: str) -> None:
+        pid = self.state.pid
+        log.error(
+            "hang detected (cycle=%s rank=%s pid=%s): %s — terminating rank",
+            self.cycle, self.state.rank, pid, reason,
+        )
+        record_event(
+            ProfilingEvent.HANG_DETECTED,
+            rank=self.state.rank, reason=reason, cycle=self.cycle,
+        )
+        self._hang_detected = True
+        if pid:
+            self._kill_fn(pid, self.cfg.term_signal)
+        self.state.reset()
+
+    # -- timeout checks (reference `_periodic_rank_check` :545) ------------
+
+    def _check_timeouts(self, now: Optional[float] = None) -> Optional[str]:
+        st = self.state
+        if st.connected_at is None:
+            return None
+        now = time.monotonic() if now is None else now
+        # heartbeat path
+        if st.last_hb is None:
+            t = self.hb_timeouts.initial
+            if t is not None and now - st.connected_at > t:
+                return f"no initial heartbeat within {t:.1f}s"
+        else:
+            t = self.hb_timeouts.subsequent
+            if t is not None and now - st.last_hb > t:
+                return f"heartbeat gap exceeded {t:.1f}s"
+        # section path
+        for name, opened in st.open_sections.items():
+            t = self.section_timeouts.section.get(name)
+            if t is not None and now - opened > t:
+                return f"section {name!r} open for more than {t:.1f}s"
+        if st.seen_section_msgs and not st.open_sections:
+            t = self.section_timeouts.out_of_section
+            ref = st.last_section_activity or st.connected_at
+            if t is not None and now - ref > t:
+                return f"out-of-section gap exceeded {t:.1f}s"
+        return None
+
+    async def _periodic_check(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.workload_check_interval)
+            reason = self._check_timeouts()
+            if reason is not None:
+                self._shutdown_rank(reason)
+
+    # -- message handling --------------------------------------------------
+
+    def _handle_msg(self, msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        try:
+            mtype = MsgType(msg["type"])
+        except (ValueError, KeyError):
+            # Unknown/garbled message (e.g. version skew): report, keep conn.
+            return {"type": MsgType.ERROR.value, "error": f"unknown msg {msg.get('type')!r}"}
+        st = self.state
+        now = time.monotonic()
+        if mtype == MsgType.INIT:
+            st.reset()
+            st.pid = msg.get("pid")
+            st.rank = msg.get("rank")
+            st.connected_at = now
+            # restore persisted calculated timeouts if client carries them
+            if msg.get("hb_timeouts"):
+                restored = heartbeat_timeouts_from_dict(msg["hb_timeouts"])
+                if restored.were_calculated:
+                    self.hb_timeouts = restored
+            if msg.get("section_timeouts"):
+                restored_s = section_timeouts_from_dict(msg["section_timeouts"])
+                if restored_s.calculated_sections or restored_s.calculated_out_of_section:
+                    self.section_timeouts = restored_s
+            log.info("rank %s (pid %s) connected to monitor", st.rank, st.pid)
+            return {
+                "type": MsgType.OK.value,
+                "hb_timeouts": heartbeat_timeouts_to_dict(self.hb_timeouts),
+                "section_timeouts": section_timeouts_to_dict(self.section_timeouts),
+                "cycle": self.cycle,
+            }
+        if mtype == MsgType.HEARTBEAT:
+            st.last_hb = now
+            return {"type": MsgType.OK.value}
+        if mtype == MsgType.SECTION_START:
+            st.seen_section_msgs = True
+            st.open_sections[msg["name"]] = now
+            return {"type": MsgType.OK.value}
+        if mtype == MsgType.SECTION_END:
+            st.seen_section_msgs = True
+            st.open_sections.pop(msg["name"], None)
+            st.last_section_activity = now
+            return {"type": MsgType.OK.value}
+        if mtype == MsgType.UPDATE_TIMEOUTS:
+            if msg.get("hb_timeouts"):
+                self.hb_timeouts = heartbeat_timeouts_from_dict(msg["hb_timeouts"])
+            if msg.get("section_timeouts"):
+                self.section_timeouts = section_timeouts_from_dict(msg["section_timeouts"])
+            log.info(
+                "timeouts updated: hb=%s sections=%s",
+                self.hb_timeouts, self.section_timeouts,
+            )
+            return {"type": MsgType.OK.value}
+        return {"type": MsgType.ERROR.value, "error": f"unknown msg {mtype}"}
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                (ln,) = _U32.unpack(header)
+                raw = await reader.readexactly(ln)
+                msg = json.loads(raw.decode())
+                reply = self._handle_msg(msg)
+                if reply is not None and not msg.get("noack"):
+                    out = json.dumps(reply).encode()
+                    writer.write(_U32.pack(len(out)) + out)
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            if self.state.connected_at is not None:
+                log.info("rank %s disconnected from monitor", self.state.rank)
+                self.state.reset()
+        finally:
+            writer.close()
+
+    async def _poll_ctrl(self) -> None:
+        """Launcher control pipe: {'cmd': 'cycle', 'cycle': N} / {'cmd': 'shutdown'}."""
+        if self.ctrl_conn is None:
+            return
+        loop = asyncio.get_running_loop()
+        while True:
+            has_data = await loop.run_in_executor(None, self.ctrl_conn.poll, 0.25)
+            if not has_data:
+                continue
+            try:
+                msg = self.ctrl_conn.recv()
+            except (EOFError, OSError):
+                msg = {"cmd": "shutdown"}
+            if msg.get("cmd") == "cycle":
+                self.cycle = int(msg["cycle"])
+            elif msg.get("cmd") == "shutdown":
+                raise asyncio.CancelledError
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run_async(self, started_evt=None) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        os.makedirs(os.path.dirname(self.socket_path) or ".", exist_ok=True)
+        self._server = await asyncio.start_unix_server(self._handle_conn, self.socket_path)
+        if started_evt is not None:
+            started_evt.set()
+        tasks = [asyncio.create_task(self._periodic_check())]
+        if self.ctrl_conn is not None:
+            tasks.append(asyncio.create_task(self._poll_ctrl()))
+        try:
+            async with self._server:
+                await asyncio.gather(*tasks)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    @classmethod
+    def _proc_main(cls, cfg, socket_path, ctrl_conn, started_evt) -> None:
+        setup_logger()
+        server = cls(cfg, socket_path, ctrl_conn)
+        try:
+            asyncio.run(server.run_async(started_evt))
+        except KeyboardInterrupt:
+            pass
+
+    @classmethod
+    def run_in_subprocess(
+        cls, cfg: FaultToleranceConfig, socket_path: str, mp_ctx=None
+    ) -> tuple[mp.Process, Any]:
+        """Fork the monitor process; returns (process, control_conn).
+
+        Must be called before the caller spawns threads (same constraint the
+        reference documents at ``launcher.py:703-759``).
+        """
+        ctx = mp_ctx or mp.get_context("fork")
+        parent_conn, child_conn = ctx.Pipe()
+        started_evt = ctx.Event()
+        proc = ctx.Process(
+            target=cls._proc_main,
+            args=(cfg, socket_path, child_conn, started_evt),
+            name=f"tpurx-rank-monitor:{os.path.basename(socket_path)}",
+            daemon=True,
+        )
+        proc.start()
+        if not started_evt.wait(timeout=15):
+            proc.terminate()
+            raise RuntimeError("rank monitor server failed to start")
+        return proc, parent_conn

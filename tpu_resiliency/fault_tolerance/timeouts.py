@@ -1,0 +1,193 @@
+"""Timeout calculator: derive hang-detection timeouts from observed intervals.
+
+Capability parity with ``fault_tolerance/timeouts_calc.py:33-281``
+(``TimeoutsCalc``): track max observed heartbeat interval and per-section
+durations, synchronize the MAX across ranks, and produce
+timeout = safety_factor × observed-max, EMA-merged with the current timeout.
+
+The cross-rank MAX reduction is the TPU twist: the reference all-reduces a
+tensor over NCCL/Gloo (``timeouts_calc.py:74-91``).  Here the default path is
+a KV-store gather-max over DCN (control plane — always available, even when
+ranks hold no devices), and callers inside a live JAX mesh can pass
+``reduce_fn`` to use an on-device ``pmax`` instead (see
+``tpu_resiliency.parallel.collectives.host_max``).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..store.barrier import barrier
+from .data import HeartbeatTimeouts, SectionTimeouts
+
+
+class TimeoutsCalcError(RuntimeError):
+    pass
+
+
+class TimeoutsCalc:
+    def __init__(
+        self,
+        start_time: Optional[float] = None,
+        safety_factor: float = 5.0,
+        ema_alpha: float = 0.5,
+        sections: Sequence[str] = (),
+    ):
+        if safety_factor <= 1.0:
+            raise ValueError("safety_factor must be > 1.0")
+        self._safety_factor = safety_factor
+        self._ema_alpha = ema_alpha
+        self._start_time = start_time if start_time is not None else time.monotonic()
+        self._last_hb_time: Optional[float] = None
+        self.initial_max: float = float("-inf")
+        self.subsequent_max: float = float("-inf")
+        # sections
+        self._section_open: Dict[str, float] = {}
+        self.section_max: Dict[str, float] = {s: float("-inf") for s in sections}
+        self.out_of_section_max: float = float("-inf")
+        self._last_section_close: Optional[float] = None
+        self._sync_gen = 0
+
+    # -- observation -------------------------------------------------------
+
+    def update_on_heartbeat(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if self._last_hb_time is None:
+            self.initial_max = max(self.initial_max, now - self._start_time)
+        else:
+            self.subsequent_max = max(self.subsequent_max, now - self._last_hb_time)
+        self._last_hb_time = now
+
+    def update_on_section_start(self, name: str, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if name in self._section_open:
+            raise TimeoutsCalcError(f"section {name!r} already open")
+        # gap since last activity counts as out-of-section time
+        ref = self._last_section_close if self._last_section_close is not None else self._start_time
+        if not self._section_open:
+            self.out_of_section_max = max(self.out_of_section_max, now - ref)
+        self._section_open[name] = now
+        self.section_max.setdefault(name, float("-inf"))
+
+    def update_on_section_end(self, name: str, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        start = self._section_open.pop(name, None)
+        if start is None:
+            raise TimeoutsCalcError(f"section {name!r} not open")
+        self.section_max[name] = max(self.section_max.get(name, float("-inf")), now - start)
+        if not self._section_open:
+            self._last_section_close = now
+
+    @property
+    def can_get_hb_timeouts(self) -> bool:
+        return self.initial_max > float("-inf") and self.subsequent_max > float("-inf")
+
+    # -- cross-rank MAX sync ----------------------------------------------
+
+    # Stats travel as {key: value} dicts (not positional vectors) so ranks
+    # that observed different section sets merge by key union instead of
+    # silently misaligning columns.
+    def _values(self) -> Dict[str, float]:
+        out = {
+            "__initial__": self.initial_max,
+            "__subsequent__": self.subsequent_max,
+            "__oos__": self.out_of_section_max,
+        }
+        for n, v in self.section_max.items():
+            out["s:" + n] = v
+        return out
+
+    def _load_values(self, vals: Dict[str, float]) -> None:
+        self.initial_max = vals.get("__initial__", self.initial_max)
+        self.subsequent_max = vals.get("__subsequent__", self.subsequent_max)
+        self.out_of_section_max = vals.get("__oos__", self.out_of_section_max)
+        for k, v in vals.items():
+            if k.startswith("s:"):
+                self.section_max[k[2:]] = v
+
+    def synchronize_all(
+        self,
+        store=None,
+        rank: Optional[int] = None,
+        world_size: Optional[int] = None,
+        reduce_fn: Optional[Callable[[Dict[str, float]], Dict[str, float]]] = None,
+        timeout: float = 60.0,
+    ) -> None:
+        """Key-wise MAX of observed stats across ranks.
+
+        Either pass ``reduce_fn`` (e.g. an on-device pmax wrapper taking and
+        returning the ``{stat_key: value}`` dict) or a store + rank +
+        world_size for the DCN gather-max path.
+        """
+        vals = self._values()
+        if reduce_fn is not None:
+            self._load_values(dict(reduce_fn(vals)))
+            return
+        if store is None or rank is None or world_size is None:
+            raise TimeoutsCalcError("need store+rank+world_size or reduce_fn")
+        gen = self._sync_gen
+        self._sync_gen += 1
+        prefix = f"tc_sync/{gen}"
+        store.set(f"{prefix}/vals/{rank}", json.dumps(vals))
+        barrier(store, f"{prefix}/gather", world_size, timeout=timeout)
+        merged: Dict[str, float] = {}
+        for r in range(world_size):
+            raw = store.get(f"{prefix}/vals/{r}", timeout=timeout)
+            for k, v in json.loads(raw).items():
+                merged[k] = max(merged.get(k, float("-inf")), v)
+        self._load_values(merged)
+        # second barrier so no rank deletes/reuses keys while others read
+        barrier(store, f"{prefix}/done", world_size, timeout=timeout)
+
+    # -- timeout derivation ------------------------------------------------
+
+    def _merge(self, current: Optional[float], observed: float) -> float:
+        new = self._safety_factor * observed
+        if current is None:
+            return new
+        # EMA, but never shrink below what we just observed needs
+        merged = self._ema_alpha * new + (1 - self._ema_alpha) * current
+        return max(merged, new)
+
+    def calculate_hb_timeouts(
+        self, current: Optional[HeartbeatTimeouts] = None
+    ) -> HeartbeatTimeouts:
+        if not self.can_get_hb_timeouts:
+            raise TimeoutsCalcError("not enough heartbeats observed")
+        cur_ini = current.initial if current and current.were_calculated else None
+        cur_sub = current.subsequent if current and current.were_calculated else None
+        return HeartbeatTimeouts(
+            initial=self._merge(cur_ini, self.initial_max),
+            subsequent=self._merge(cur_sub, self.subsequent_max),
+            were_calculated=True,
+        )
+
+    def calculate_section_timeouts(
+        self,
+        current: Optional[SectionTimeouts] = None,
+        selection: Optional[Sequence[str]] = None,
+        calc_out_of_section: bool = True,
+    ) -> SectionTimeouts:
+        names = list(selection) if selection is not None else sorted(self.section_max)
+        section: Dict[str, Optional[float]] = dict(current.section) if current else {}
+        calculated = set(current.calculated_sections) if current else set()
+        for n in names:
+            observed = self.section_max.get(n, float("-inf"))
+            if observed == float("-inf"):
+                continue
+            cur = section.get(n) if n in calculated else None
+            section[n] = self._merge(cur, observed)
+            calculated.add(n)
+        oos = current.out_of_section if current else None
+        calc_oos = current.calculated_out_of_section if current else False
+        if calc_out_of_section and self.out_of_section_max > float("-inf"):
+            oos = self._merge(oos if calc_oos else None, self.out_of_section_max)
+            calc_oos = True
+        return SectionTimeouts(
+            section=section,
+            out_of_section=oos,
+            calculated_sections=tuple(sorted(calculated)),
+            calculated_out_of_section=calc_oos,
+        )

@@ -37,7 +37,7 @@ def barrier(
     name: str,
     world_size: int,
     timeout: float = 300.0,
-    poll_interval: float = 0.05,
+    poll_interval: float = 1.0,
 ) -> None:
     """Counting barrier.  Each participant calls exactly once per `name`."""
     count_key = f"barrier/{name}/count"
@@ -57,7 +57,8 @@ def barrier(
             count = int(store.try_get(count_key) or b"0")
             raise BarrierTimeout(name, count, world_size)
         try:
-            store.wait([done_key], timeout=min(remaining, max(poll_interval, 1.0)))
+            # Wait in poll_interval chunks so deadline/overflow checks can run.
+            store.wait([done_key], timeout=min(remaining, poll_interval))
             return
         except StoreTimeout:
             continue

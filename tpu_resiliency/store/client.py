@@ -25,6 +25,26 @@ _U32 = struct.Struct("<I")
 
 _DEFAULT_TIMEOUT = 300.0
 
+# Ops safe to resend after a connection drop: resending cannot change the
+# final store state.  ADD/APPEND/COMPARE_SET are NOT here — the server may
+# have applied the op before the connection died, and a blind resend would
+# double-apply (e.g. a phantom barrier arrival).
+_IDEMPOTENT_OPS = frozenset(
+    {
+        Op.SET,
+        Op.GET,
+        Op.TRY_GET,
+        Op.WAIT,
+        Op.CHECK,
+        Op.DELETE,
+        Op.NUM_KEYS,
+        Op.PING,
+        Op.LIST_KEYS,
+        Op.MULTI_SET,
+        Op.MULTI_GET,
+    }
+)
+
 
 class StoreError(RuntimeError):
     pass
@@ -106,9 +126,11 @@ class StoreClient:
                 payload.append(a)
             attempt = 0
             while True:
+                sent = False
                 try:
                     self._sock.settimeout(io_timeout)
                     self._sock.sendall(b"".join(payload))
+                    sent = True
                     status = Status(self._read_exact(1)[0])
                     (nargs,) = _U32.unpack(self._read_exact(4))
                     out = []
@@ -122,6 +144,13 @@ class StoreClient:
                     raise StoreTimeout(f"store op {op.name} timed out") from exc
                 except (ConnectionError, BrokenPipeError, OSError) as exc:
                     self._drop_socket()
+                    # A non-idempotent op may already have been applied once
+                    # the request bytes left — never resend those.
+                    if sent and op not in _IDEMPOTENT_OPS:
+                        raise StoreError(
+                            f"store op {op.name} connection lost after send; "
+                            f"not retrying non-idempotent op: {exc}"
+                        ) from exc
                     attempt += 1
                     if attempt > self._retries:
                         raise StoreError(f"store op {op.name} failed: {exc}") from exc

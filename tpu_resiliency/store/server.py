@@ -148,7 +148,13 @@ class StoreServer:
                 header = await reader.read(1)
                 if not header:
                     break
-                op = Op(header[0])
+                try:
+                    op = Op(header[0])
+                except ValueError:
+                    # Garbage/unknown opcode: the stream is unparseable from
+                    # here on — drop the connection, keep the server.
+                    log.warning("dropping connection: unknown opcode %r", header)
+                    break
                 (nargs,) = _U32.unpack(await self._read_exact(reader, 4))
                 args = []
                 for _ in range(nargs):

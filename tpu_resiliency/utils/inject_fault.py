@@ -131,7 +131,14 @@ def maybe_inject_from_env(rank: Optional[int] = None) -> Optional[threading.Thre
     if not spec:
         return None
     ranks = os.environ.get(ENV_FAULT_RANKS)
-    if ranks is not None and rank is not None:
+    if ranks is not None:
+        if rank is None:
+            env_rank = os.environ.get("TPURX_RANK", os.environ.get("RANK"))
+            rank = int(env_rank) if env_rank is not None else None
+        if rank is None:
+            # Rank gate requested but rank unknown: do NOT fire on everyone.
+            log.warning("%s set but rank unknown; skipping injection", ENV_FAULT_RANKS)
+            return None
         if rank not in {int(r) for r in ranks.split(",") if r.strip()}:
             return None
     name, _, delay_s = spec.partition(":")

@@ -1,0 +1,142 @@
+"""Unix-domain-socket message channels.
+
+Two pieces of capability parity:
+
+- Length-prefixed JSON framing used by rank ↔ monitor IPC (reference frames
+  pickled msgs over UDS in ``rank_monitor_client.py:283-366``; we use JSON to
+  keep the protocol language-neutral).
+- :class:`IpcConnector` — fire-and-forget message channel with a receiver
+  thread (reference ``fault_tolerance/ipc_connector.py:30``), used for
+  rank → launcher workload-control requests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .logging import get_logger
+
+log = get_logger("ipc")
+
+_U32 = struct.Struct("<I")
+
+
+def send_msg(sock: socket.socket, payload: Dict[str, Any]) -> None:
+    raw = json.dumps(payload).encode()
+    sock.sendall(_U32.pack(len(raw)) + raw)
+
+
+def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (ln,) = _U32.unpack(header)
+    raw = _recv_exact(sock, ln)
+    if raw is None:
+        return None
+    return json.loads(raw.decode())
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class IpcConnector:
+    """Fire-and-forget UDS message channel.
+
+    Receiver side: ``start_receiving(callback)`` spawns a listener thread;
+    every JSON message is passed to the callback and kept in ``.messages``.
+    Sender side: ``send(payload)`` opens a short-lived connection.
+    """
+
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.messages: List[Dict[str, Any]] = []
+        self._server: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._callback: Optional[Callable[[Dict[str, Any]], None]] = None
+
+    # -- receiver ----------------------------------------------------------
+
+    def start_receiving(
+        self, callback: Optional[Callable[[Dict[str, Any]], None]] = None
+    ) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        os.makedirs(os.path.dirname(self.socket_path) or ".", exist_ok=True)
+        self._callback = callback
+        self._server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._server.bind(self.socket_path)
+        self._server.listen(64)
+        self._server.settimeout(0.25)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._serve, name="tpurx-ipc-recv", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        assert self._server is not None
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            try:
+                conn.settimeout(5.0)
+                while True:
+                    msg = recv_msg(conn)
+                    if msg is None:
+                        break
+                    self.messages.append(msg)
+                    if self._callback:
+                        try:
+                            self._callback(msg)
+                        except Exception:  # noqa: BLE001
+                            log.exception("ipc callback failed")
+            except (socket.timeout, OSError):
+                pass
+            finally:
+                conn.close()
+
+    def stop_receiving(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def clear(self) -> None:
+        self.messages.clear()
+
+    # -- sender ------------------------------------------------------------
+
+    def send(self, payload: Dict[str, Any], timeout: float = 10.0) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        try:
+            sock.connect(self.socket_path)
+            send_msg(sock, payload)
+        finally:
+            sock.close()
