@@ -1,0 +1,232 @@
+"""Barrier rendezvous tests (reference analog: tests/fault_tolerance/unit/test_barrier_rendezvous.py).
+
+Nodes are threads sharing a real store server — same store protocol a
+multi-host deployment uses.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.fault_tolerance.rendezvous import (
+    NodeDesc,
+    NodeRole,
+    RendezvousClosedError,
+    RendezvousHost,
+    RendezvousJoiner,
+    RendezvousTimeout,
+    assign_group_ranks,
+)
+from tpu_resiliency.store import StoreClient
+
+
+def _node(i, slots=2, slice_key="", prev=None, excluded=False):
+    return NodeDesc(
+        node_id=f"node{i}", hostname=f"h{i}", slots=slots, slice_key=slice_key,
+        prev_group_rank=prev, arrival=i, excluded=excluded,
+    )
+
+
+class TestAssignGroupRanks:
+    def test_basic(self):
+        nodes = [_node(0), _node(1), _node(2)]
+        out = assign_group_ranks(nodes, min_nodes=2, max_nodes=None)
+        ranks = {nid: a["group_rank"] for nid, a in out.items()}
+        assert sorted(ranks.values()) == [0, 1, 2]
+
+    def test_spares_beyond_max(self):
+        nodes = [_node(i) for i in range(5)]
+        out = assign_group_ranks(nodes, min_nodes=2, max_nodes=3)
+        roles = [a["role"] for a in out.values()]
+        assert roles.count(NodeRole.PARTICIPANT.value) == 3
+        assert roles.count(NodeRole.STANDBY.value) == 2
+
+    def test_rank_stability(self):
+        # node2 had rank 0 before: keeps it; new nodes fill after
+        nodes = [_node(0), _node(1, prev=1), _node(2, prev=0)]
+        out = assign_group_ranks(nodes, min_nodes=1, max_nodes=None)
+        assert out["node2"]["group_rank"] == 0
+        assert out["node1"]["group_rank"] == 1
+        assert out["node0"]["group_rank"] == 2
+
+    def test_excluded_nodes_skipped(self):
+        nodes = [_node(0, excluded=True), _node(1), _node(2)]
+        out = assign_group_ranks(nodes, min_nodes=2, max_nodes=2)
+        assert out["node0"]["role"] == NodeRole.EXCLUDED.value
+        assert out["node0"]["group_rank"] is None
+        assert out["node1"]["group_rank"] is not None
+
+    def test_min_nodes_violated(self):
+        with pytest.raises(Exception):
+            assign_group_ranks([_node(0, excluded=True)], min_nodes=1, max_nodes=None)
+
+    def test_slices_kept_whole(self):
+        # two slices of 2 plus a loner; cap 2 -> take one whole slice, not a mix
+        nodes = [
+            _node(0, slice_key="sliceA"), _node(1, slice_key="sliceA"),
+            _node(2, slice_key="sliceB"), _node(3, slice_key="sliceB"),
+        ]
+        out = assign_group_ranks(nodes, min_nodes=2, max_nodes=2)
+        chosen = {nid for nid, a in out.items() if a["group_rank"] is not None}
+        assert chosen in ({"node0", "node1"}, {"node2", "node3"})
+
+    def test_heterogeneous_slots_rejected(self):
+        with pytest.raises(Exception):
+            assign_group_ranks([_node(0, slots=2), _node(1, slots=4)], 1, None)
+
+
+@pytest.fixture
+def rdzv_store(store_server):
+    def make():
+        return StoreClient("127.0.0.1", store_server.port, timeout=20.0)
+
+    yield make
+
+
+def _run_join(store_factory, desc, results, timeout=20.0):
+    joiner = RendezvousJoiner(store_factory(), desc, open_poll_interval=0.05)
+    try:
+        results[desc.node_id] = joiner.join(timeout=timeout)
+    except Exception as exc:  # noqa: BLE001
+        results[desc.node_id] = exc
+
+
+def test_full_round(rdzv_store):
+    host = RendezvousHost(rdzv_store(), min_nodes=3, max_nodes=3, settle_time=0.2)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    threads = [
+        threading.Thread(
+            target=_run_join, args=(rdzv_store, NodeDesc.create(f"n{i}", slots=4), results)
+        )
+        for i in range(3)
+    ]
+    for t in threads:
+        t.start()
+    host.close_round_when_ready(timeout=20.0)
+    for t in threads:
+        t.join(timeout=20.0)
+    assert len(results) == 3
+    ranks = sorted(r.group_rank for r in results.values())
+    assert ranks == [0, 1, 2]
+    for r in results.values():
+        assert r.global_world_size == 12
+        assert r.group_world_size == 3
+        assert r.rank_offset == r.group_rank * 4
+        assert r.role == NodeRole.PARTICIPANT
+
+
+def test_hot_spare_promoted_on_restart(rdzv_store):
+    """4 nodes, max 3: one becomes standby; when a participant dies and a new
+    round opens, the spare is promoted with rank continuity for survivors."""
+    host = RendezvousHost(rdzv_store(), min_nodes=3, max_nodes=3, settle_time=0.3)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    descs = {f"n{i}": NodeDesc.create(f"n{i}", slots=1) for i in range(4)}
+    threads = [
+        threading.Thread(target=_run_join, args=(rdzv_store, descs[f"n{i}"], results))
+        for i in range(4)
+    ]
+    for t in threads:
+        t.start()
+    host.close_round_when_ready(timeout=20.0)
+    # the spare's thread keeps waiting at the next open gate; 3 finish
+    deadline = time.monotonic() + 10
+    while sum(1 for r in results.values() if not isinstance(r, Exception)) < 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    participant_ids = {nid for nid, r in results.items() if getattr(r, "group_rank", None) is not None}
+    spare_id = set(descs) - participant_ids
+    assert len(spare_id) == 1
+    spare_id = spare_id.pop()
+
+    # round 2: one participant (rank 2) died; survivors + spare rejoin
+    dead = next(nid for nid in participant_ids if results[nid].group_rank == 2)
+    survivors = participant_ids - {dead}
+    host.open_round()
+    results2 = {}
+    threads2 = [
+        threading.Thread(target=_run_join, args=(rdzv_store, descs[nid], results2))
+        for nid in survivors
+    ]
+    for t in threads2:
+        t.start()
+    host.close_round_when_ready(timeout=20.0)
+    for t in threads + threads2:
+        t.join(timeout=20.0)
+    # the spare (still in its first join() call) got promoted
+    spare_result = results[spare_id]
+    assert not isinstance(spare_result, Exception)
+    assert spare_result.role == NodeRole.PARTICIPANT
+    # survivors kept their previous ranks
+    for nid in survivors:
+        assert results2[nid].group_rank == results[nid].group_rank
+    all_ranks = sorted(
+        [results2[nid].group_rank for nid in survivors] + [spare_result.group_rank]
+    )
+    assert all_ranks == [0, 1, 2]
+    assert spare_result.cycle == 1
+
+
+def test_shutdown_releases_waiters(rdzv_store):
+    host = RendezvousHost(rdzv_store(), min_nodes=2, settle_time=0.1)
+    host.bootstrap()
+    results = {}
+    t = threading.Thread(
+        target=_run_join, args=(rdzv_store, NodeDesc.create("w0"), results, 10.0)
+    )
+    t.start()
+    time.sleep(0.3)
+    host.shutdown("test over")
+    t.join(timeout=10.0)
+    assert isinstance(results["w0"], RendezvousClosedError)
+
+
+def test_close_timeout_without_min_nodes(rdzv_store):
+    host = RendezvousHost(rdzv_store(), min_nodes=2, settle_time=0.1)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    t = threading.Thread(
+        target=_run_join, args=(rdzv_store, NodeDesc.create("only"), results, 5.0)
+    )
+    t.start()
+    with pytest.raises(RendezvousTimeout):
+        host.close_round_when_ready(timeout=1.0)
+    t.join(timeout=10.0)
+
+
+def test_unhealthy_node_does_not_join(rdzv_store):
+    from tpu_resiliency.fault_tolerance.rendezvous import UnhealthyNodeError
+
+    host = RendezvousHost(rdzv_store(), min_nodes=1, max_nodes=2, settle_time=0.3)
+    host.bootstrap()
+    host.open_round()
+
+    def bad_health():
+        raise UnhealthyNodeError("injected bad device")
+
+    results = {}
+    bad = RendezvousJoiner(rdzv_store(), NodeDesc.create("bad"), pre_join_health_check=bad_health)
+
+    def run_bad():
+        try:
+            bad.join(timeout=5.0)
+        except UnhealthyNodeError as e:
+            results["bad"] = e
+
+    threads = [
+        threading.Thread(target=run_bad),
+        threading.Thread(target=_run_join, args=(rdzv_store, NodeDesc.create("good"), results)),
+    ]
+    for t in threads:
+        t.start()
+    host.close_round_when_ready(timeout=10.0)
+    for t in threads:
+        t.join(timeout=10.0)
+    assert isinstance(results["bad"], UnhealthyNodeError)
+    assert results["good"].group_rank == 0
+    assert results["good"].group_world_size == 1

@@ -1,0 +1,9 @@
+"""Workload that always crashes immediately (restart-budget tests)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "/root/repo"))
+
+print(f"crash_always: cycle={os.environ.get('TPURX_CYCLE')}", flush=True)
+os._exit(23)

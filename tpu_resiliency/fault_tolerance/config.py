@@ -84,10 +84,17 @@ class FaultToleranceConfig:
             raise ValueError(f"unknown fault_tolerance config keys: {sorted(unknown)}")
         return cls(**known)
 
-    def merged_with(self, overrides: Mapping[str, Any]) -> "FaultToleranceConfig":
+    def merged_with(
+        self, overrides: Mapping[str, Any], allow_none: bool = False
+    ) -> "FaultToleranceConfig":
+        """Apply overrides.  With ``allow_none=False`` (CLI defaults path) a
+        None value means "not provided" and is skipped; with ``allow_none=True``
+        (env path, where the key's very presence is the override) None is an
+        explicit value — e.g. TPURX_FT_RANK_HEARTBEAT_TIMEOUT=null disables
+        that timeout."""
         vals = dataclasses.asdict(self)
         for k, v in overrides.items():
-            if v is None:
+            if v is None and not allow_none:
                 continue
             if k not in vals:
                 raise ValueError(f"unknown fault_tolerance config key: {k}")
@@ -102,7 +109,7 @@ class FaultToleranceConfig:
             if env_val is None:
                 continue
             overrides[f.name] = _coerce(env_val, f.type)
-        return self.merged_with(overrides)
+        return self.merged_with(overrides, allow_none=True)
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -1,0 +1,546 @@
+"""Elastic per-host launcher (``torchrun``-replacement analog).
+
+Capability parity with ``fault_tolerance/launcher.py:300-3612``
+(``LocalElasticAgent`` + ``launch_agent`` + CLI): one launcher process per TPU
+host that
+
+- forks per-rank :class:`RankMonitorServer` watchdog processes *before* any
+  threads exist,
+- hosts (or connects to) the KV store and performs barrier rendezvous,
+- spawns one worker process per local chip/slot with the rank/cycle env,
+- monitors workers + peer restarts + workload-control requests in a hot loop,
+- on failure: profiling events, progress-tracker gate, restart budget, new
+  rendezvous round, worker stop (SIGTERM → grace → SIGKILL), respawn,
+- per-cycle log capture via pipes.
+
+TPU-native deltas from the reference: no GPU-memory-reclaim polling (HBM is
+freed when the worker process dies — the stop path's waitpid is the
+equivalent gate); no NUMA binding yet; JAX distributed coordination env
+(``JAX_COORDINATOR_*``) is exported for multi-host workloads.
+
+CLI:  python -m tpu_resiliency.fault_tolerance.launcher \
+        --nnodes 1:2 --nproc-per-node 4 --rdzv-endpoint 127.0.0.1:29500 \
+        [--host-store] [--ft-cfg path.yaml] [--max-restarts 3] \
+        script.py [script args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ..store import StoreClient, StoreError, StoreServer
+from ..utils.ipc import IpcConnector
+from ..utils.logging import get_logger, setup_logger
+from ..utils.profiling import ProfilingEvent, get_recorder, record_event
+from .config import FaultToleranceConfig
+from .data import WorkloadAction
+from .per_cycle_logs import CycleLogRouter
+from .progress_tracker import TrainingProgressTracker
+from .rank_monitor_server import RankMonitorServer
+from .rendezvous import (
+    K_SHUTDOWN,
+    NodeDesc,
+    NodeRole,
+    RendezvousClosedError,
+    RendezvousHost,
+    RendezvousJoiner,
+    RendezvousResult,
+    UnhealthyNodeError,
+    is_next_round_open,
+    k_restart_req,
+    request_restart,
+)
+
+log = get_logger("launcher")
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    cmd: List[str]
+    nproc_per_node: int
+    monitor_interval: float = 0.1
+    extra_env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class _Worker:
+    local_rank: int
+    global_rank: int
+    proc: subprocess.Popen
+
+
+class HostRoundLoop:
+    """Store-host side thread: opens/closes rounds for the whole job.
+
+    Loop: close the currently-open round, then wait for either a restart
+    request or shutdown; on restart request open the next round."""
+
+    def __init__(self, host: RendezvousHost, round_timeout: float):
+        self.host = host
+        self.round_timeout = round_timeout
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="tpurx-rdzv-host", daemon=True
+        )
+
+    def start(self) -> None:
+        self.host.bootstrap()
+        self.host.open_round()
+        self._thread.start()
+
+    def _run(self) -> None:
+        store = self.host.store
+        while not self._stop.is_set():
+            try:
+                n = self.host.close_round_when_ready(timeout=self.round_timeout)
+            except Exception as exc:  # noqa: BLE001
+                log.error("rendezvous host failed to close round: %s", exc)
+                store.set(K_SHUTDOWN, f"rendezvous failed: {exc}")
+                return
+            # wait for restart request or shutdown
+            while not self._stop.is_set():
+                if store.try_get(K_SHUTDOWN) is not None:
+                    return
+                if store.check([k_restart_req(n)]):
+                    self.host.open_round()
+                    break
+                time.sleep(0.1)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class ElasticAgent:
+    def __init__(
+        self,
+        cfg: FaultToleranceConfig,
+        spec: WorkerSpec,
+        store_addr: str,
+        store_port: int,
+        host_store: bool = False,
+        node_id: Optional[str] = None,
+        max_restarts: Optional[int] = None,
+        slice_key: str = "",
+    ):
+        self.cfg = cfg
+        self.spec = spec
+        self.store_addr = store_addr
+        self.store_port = store_port
+        self.host_store = host_store
+        self.max_restarts = (
+            max_restarts if max_restarts is not None else cfg.max_rank_restarts
+        )
+        self.node_id = node_id or f"{os.uname().nodename}-{uuid.uuid4().hex[:8]}"
+        self.slice_key = slice_key
+        self.remaining_restarts = self.max_restarts
+        self._store_server: Optional[StoreServer] = None
+        self._host_loop: Optional[HostRoundLoop] = None
+        self.store: Optional[StoreClient] = None
+        self.workers: List[_Worker] = []
+        self.monitors: List = []  # (proc, ctrl_conn, socket_path)
+        self.log_router = CycleLogRouter(cfg.per_cycle_log_dir)
+        self.progress = TrainingProgressTracker(
+            cfg.progress_iteration_file, cfg.max_no_progress_cycles
+        )
+        run_dir = f"/tmp/tpurx-{os.getpid()}"
+        os.makedirs(run_dir, exist_ok=True)
+        self._run_dir = run_dir
+        self.ipc = IpcConnector(os.path.join(run_dir, "launcher.sock"))
+        self._pending_exclude = False
+        self._pending_shutdown: Optional[str] = None
+        self._result: Optional[RendezvousResult] = None
+
+    # -- setup -------------------------------------------------------------
+
+    def setup_rank_monitors_early(self) -> None:
+        """Fork monitor processes before any threads exist (reference
+        constraint, ``launcher.py:703-759``)."""
+        for lr in range(self.spec.nproc_per_node):
+            sock = os.path.join(self._run_dir, f"monitor_{lr}.sock")
+            proc, ctrl = RankMonitorServer.run_in_subprocess(self.cfg, sock)
+            self.monitors.append((proc, ctrl, sock))
+
+    def _setup_store(self) -> None:
+        if self.host_store:
+            self._store_server = StoreServer(
+                host="0.0.0.0", port=self.store_port
+            ).start_in_thread()
+            self.store_port = self._store_server.port
+        self.store = StoreClient(
+            self.store_addr, self.store_port, timeout=self.cfg.rdzv_round_timeout
+        )
+        if self.host_store:
+            host = RendezvousHost(
+                self.store.clone(),
+                min_nodes=self.cfg.min_nodes,
+                max_nodes=self.cfg.max_nodes,
+            )
+            self._host_loop = HostRoundLoop(host, self.cfg.rdzv_round_timeout)
+            self._host_loop.start()
+
+    def _on_ipc(self, msg: Dict) -> None:
+        if msg.get("kind") != "workload_control":
+            return
+        action = WorkloadAction(msg["action"])
+        log.warning("workload control request: %s (%s)", action.value, msg.get("reason"))
+        if action == WorkloadAction.ExcludeThisNode:
+            self._pending_exclude = True
+        elif action == WorkloadAction.ShutdownWorkload:
+            self._pending_shutdown = msg.get("reason", "workload requested shutdown")
+
+    # -- worker lifecycle --------------------------------------------------
+
+    def _start_workers(self, result: RendezvousResult) -> None:
+        cycle = result.cycle
+        self.log_router.start_cycle(cycle)
+        for _, ctrl, _ in self.monitors:
+            ctrl.send({"cmd": "cycle", "cycle": cycle})
+        record_event(ProfilingEvent.WORKER_START_REQUESTED, cycle=cycle)
+        self.workers = []
+        for lr in range(self.spec.nproc_per_node):
+            grank = result.rank_offset + lr
+            env = dict(os.environ)
+            env.update(self.spec.extra_env)
+            env.update(
+                {
+                    "TPURX_RANK": str(grank),
+                    "TPURX_LOCAL_RANK": str(lr),
+                    "TPURX_WORLD_SIZE": str(result.global_world_size),
+                    "TPURX_GROUP_RANK": str(result.group_rank),
+                    "TPURX_NNODES": str(result.group_world_size),
+                    "TPURX_CYCLE": str(cycle),
+                    "TPURX_STORE_ADDR": self.store_addr,
+                    "TPURX_STORE_PORT": str(self.store_port),
+                    "TPURX_RANK_MONITOR_SOCKET": self.monitors[lr][2],
+                    "TPURX_LAUNCHER_IPC_SOCKET": self.ipc.socket_path,
+                }
+            )
+            out_fd = self.log_router.make_worker_pipe(grank, "out")
+            err_fd = self.log_router.make_worker_pipe(grank, "err")
+            proc = subprocess.Popen(
+                self.spec.cmd,
+                env=env,
+                stdout=out_fd,
+                stderr=err_fd,
+                start_new_session=True,  # own PGID so we can signal the tree
+            )
+            os.close(out_fd)
+            os.close(err_fd)
+            self.workers.append(_Worker(lr, grank, proc))
+        record_event(ProfilingEvent.WORKER_STARTED, cycle=cycle)
+        log.info(
+            "cycle %s: started %s workers (global ranks %s..%s)",
+            cycle, len(self.workers), result.rank_offset,
+            result.rank_offset + self.spec.nproc_per_node - 1,
+        )
+
+    def _stop_workers(self) -> None:
+        if not self.workers:
+            return
+        record_event(ProfilingEvent.WORKER_STOP_REQUESTED)
+        for w in self.workers:
+            if w.proc.poll() is None:
+                try:
+                    os.killpg(w.proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.monotonic() + self.cfg.workers_stop_timeout
+        for w in self.workers:
+            remaining = max(0.0, deadline - time.monotonic())
+            try:
+                w.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                pass
+        for w in self.workers:
+            # Always sweep the process group: a dead leader can leave live
+            # children (data loaders, probes) that would hold devices/ports.
+            try:
+                os.killpg(w.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            if w.proc.poll() is None:
+                w.proc.wait()
+        record_event(ProfilingEvent.WORKER_STOPPED)
+        self.workers = []
+
+    def _workers_status(self) -> str:
+        """'running' | 'succeeded' | 'failed'"""
+        codes = [w.proc.poll() for w in self.workers]
+        if any(c is not None and c != 0 for c in codes):
+            return "failed"
+        if all(c == 0 for c in codes):
+            return "succeeded"
+        return "running"
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> int:
+        self._setup_store()
+        self.ipc.start_receiving(self._on_ipc)
+        joiner = RendezvousJoiner(
+            self.store.clone(),
+            NodeDesc(
+                node_id=self.node_id,
+                hostname=os.uname().nodename,
+                slots=self.spec.nproc_per_node,
+                slice_key=self.slice_key,
+            ),
+            pre_join_health_check=self._pre_join_health_check,
+        )
+        try:
+            return self._run_loop(joiner)
+        finally:
+            self._stop_workers()
+            self._teardown()
+
+    def _pre_join_health_check(self) -> None:
+        # Device health gate before joining a round (reference pre_join_hook).
+        # Full TPU checks live in tpu_resiliency.health; the launcher-level
+        # gate is injectable for tests via env.
+        from .health_gate import pre_rendezvous_health_check
+        from .rendezvous import K_CYCLE
+
+        cycle = int(self.store.try_get(K_CYCLE) or b"1") - 1
+        pre_rendezvous_health_check(self.cfg, self.node_id, current_cycle=cycle)
+
+    def _run_loop(self, joiner: RendezvousJoiner) -> int:
+        while True:
+            try:
+                result = joiner.join(timeout=self.cfg.rdzv_round_timeout)
+            except RendezvousClosedError as exc:
+                log.info("rendezvous closed: %s", exc)
+                return 0 if "success" in str(exc) else 1
+            except UnhealthyNodeError as exc:
+                log.error("node unhealthy, leaving the job: %s", exc)
+                return 1
+            if result.role != NodeRole.PARTICIPANT:
+                continue
+            self._result = result
+            self._start_workers(result)
+            outcome = self._monitor_until_event(result)
+            if outcome == "succeeded":
+                log.info("workers finished successfully")
+                try:
+                    self.store.set(K_SHUTDOWN, "success")
+                except StoreError:
+                    pass  # store host already gone — job is over either way
+                return 0
+            if outcome == "shutdown":
+                return 1
+            if outcome == "excluded":
+                joiner.desc.excluded = True
+                self._stop_workers()
+                request_restart(self.store, "node excluded")
+                # rejoin so the host can reassign without us; join() raises
+                # RendezvousClosedError for excluded nodes
+                continue
+            # outcome == restart (local failure or peer restart)
+            self._stop_workers()
+            continue
+
+    def _monitor_until_event(self, result: RendezvousResult) -> str:
+        """Hot loop (reference ``launcher.py:629-697``). Returns outcome."""
+        while True:
+            try:
+                return self._monitor_tick(result)
+            except StoreError:
+                # Store host vanished: if our workers are done, the job most
+                # likely succeeded and the host tore down first; otherwise
+                # treat it as a fatal shutdown.
+                status = self._workers_status()
+                log.warning("store unreachable in monitor loop (workers: %s)", status)
+                if status == "succeeded":
+                    return "succeeded"
+                self._stop_workers()
+                return "shutdown"
+
+    def _monitor_tick(self, result: RendezvousResult) -> str:
+        while True:
+            time.sleep(self.spec.monitor_interval)
+            if self._pending_shutdown:
+                log.warning("shutting down workload: %s", self._pending_shutdown)
+                self.store.set(K_SHUTDOWN, self._pending_shutdown)
+                self._stop_workers()
+                return "shutdown"
+            if self._pending_exclude:
+                self._pending_exclude = False
+                return "excluded"
+            shutdown = self.store.try_get(K_SHUTDOWN)
+            if shutdown == b"success":
+                # Peers finished; let local workers drain instead of killing
+                # them mid-final-step, then report success.
+                deadline = time.monotonic() + self.cfg.workers_stop_timeout
+                for w in self.workers:
+                    try:
+                        w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                    except subprocess.TimeoutExpired:
+                        break
+                self._stop_workers()
+                return "succeeded"
+            if shutdown is not None:
+                log.info("shutdown flag observed: %s", shutdown.decode())
+                self._stop_workers()
+                return "shutdown"
+            status = self._workers_status()
+            if status == "succeeded":
+                return "succeeded"
+            if status == "failed":
+                failed = [
+                    (w.global_rank, w.proc.poll())
+                    for w in self.workers
+                    if w.proc.poll() not in (None, 0)
+                ]
+                log.error("worker failure detected: ranks %s", failed)
+                record_event(
+                    ProfilingEvent.FAILURE_DETECTED,
+                    cycle=result.cycle,
+                    failed=[[r, c] for r, c in failed],
+                )
+                if not self._restart_allowed():
+                    self.store.set(K_SHUTDOWN, "restart budget exhausted")
+                    return "shutdown"
+                request_restart(self.store, f"worker failure on {self.node_id}")
+                return "restart"
+            if is_next_round_open(self.store, result.round_num):
+                log.info("peer-initiated restart: new round open")
+                return "restart"
+
+    def _restart_allowed(self) -> bool:
+        self.progress.analyze_previous_cycle()
+        if self.progress.should_terminate_early():
+            log.error(
+                "terminating early: no progress for %s cycles",
+                self.progress.no_progress_cycles,
+            )
+            return False
+        if self.max_restarts > 0:
+            if self.remaining_restarts <= 0:
+                log.error("restart budget exhausted (%s)", self.max_restarts)
+                return False
+            self.remaining_restarts -= 1
+        return True
+
+    def _teardown(self) -> None:
+        self.ipc.stop_receiving()
+        for proc, ctrl, _ in self.monitors:
+            try:
+                ctrl.send({"cmd": "shutdown"})
+            except (BrokenPipeError, OSError):
+                pass
+        for proc, _, _ in self.monitors:
+            proc.join(timeout=3)
+            if proc.is_alive():
+                proc.terminate()
+        if self._host_loop:
+            self._host_loop.stop()
+        self.log_router.close()
+        if self._store_server:
+            # give peers a window to observe the shutdown flag before the
+            # store disappears (they tolerate store loss after that)
+            time.sleep(3.0)
+            self._store_server.stop()
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="tpurx-launch", description="TPU-resilient elastic launcher"
+    )
+    p.add_argument("--nnodes", default="1:1", help="MIN:MAX nodes (or a single N)")
+    p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument("--rdzv-endpoint", default="127.0.0.1:29400")
+    p.add_argument(
+        "--host-store",
+        action="store_true",
+        help="host the KV store + rendezvous rounds in this launcher",
+    )
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--slice-key", default="", help="TPU slice / ICI domain id")
+    p.add_argument("--max-restarts", type=int, default=None)
+    p.add_argument("--ft-cfg", default=None, help="YAML config path")
+    p.add_argument("--monitor-interval", type=float, default=0.1)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("cmd", nargs=argparse.REMAINDER, help="worker command")
+    args = p.parse_args(argv)
+    if not args.cmd:
+        p.error("worker command required")
+    if args.cmd and args.cmd[0] == "--":
+        args.cmd = args.cmd[1:]
+    return args
+
+
+def build_agent(args: argparse.Namespace) -> ElasticAgent:
+    cfg = (
+        FaultToleranceConfig.from_yaml(args.ft_cfg)
+        if args.ft_cfg
+        else FaultToleranceConfig()
+    )
+    cfg = cfg.merged_with_env()
+    if ":" in args.nnodes:
+        mn, mx = args.nnodes.split(":")
+        cfg = cfg.merged_with({"min_nodes": int(mn), "max_nodes": int(mx)})
+    else:
+        n = int(args.nnodes)
+        cfg = cfg.merged_with({"min_nodes": n, "max_nodes": n})
+    if args.log_dir:
+        cfg = cfg.merged_with({"per_cycle_log_dir": args.log_dir})
+    host, port = args.rdzv_endpoint.rsplit(":", 1)
+    cmd = args.cmd
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+    spec = WorkerSpec(
+        cmd=cmd,
+        nproc_per_node=args.nproc_per_node,
+        monitor_interval=args.monitor_interval,
+    )
+    return ElasticAgent(
+        cfg,
+        spec,
+        store_addr=host,
+        store_port=int(port),
+        host_store=args.host_store,
+        node_id=args.node_id,
+        max_restarts=args.max_restarts,
+        slice_key=args.slice_key,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    setup_logger()
+    args = parse_args(argv)
+    agent = build_agent(args)
+    if agent.cfg.profiling_file:
+        get_recorder()._path = agent.cfg.profiling_file
+    agent.setup_rank_monitors_early()
+
+    # SIGTERM/SIGINT must sweep the worker process groups before the launcher
+    # dies — orphaned workers would keep holding TPU chips and ports
+    # (reference stops worker groups on agent shutdown, ``launcher.py:922``).
+    def _terminate(signum, frame):
+        log.warning("launcher received %s; stopping workers", signal.Signals(signum).name)
+        try:
+            agent._stop_workers()
+            agent._teardown()
+        finally:
+            os._exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _terminate)
+    signal.signal(signal.SIGINT, _terminate)
+
+    rc = agent.run()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Training-progress tracker: stop restart loops that make no progress.
+
+Capability parity with ``fault_tolerance/progress_tracker.py:40-212``
+(``TrainingProgressTracker``): each cycle, read the max training iteration the
+workload reached (from an iteration file the workload/checkpointing layer
+maintains); if ``max_no_progress_cycles`` consecutive cycles end without the
+iteration advancing, tell the launcher to terminate early instead of burning
+the allocation on a crash loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("progress_tracker")
+
+
+class TrainingProgressTracker:
+    def __init__(
+        self,
+        iteration_file: Optional[str] = None,
+        max_no_progress_cycles: int = 3,
+    ):
+        self.iteration_file = iteration_file
+        self.max_no_progress_cycles = max_no_progress_cycles
+        self.best_iteration: Optional[int] = None
+        self.no_progress_cycles = 0
+
+    def read_current_iteration(self) -> Optional[int]:
+        if not self.iteration_file or not os.path.exists(self.iteration_file):
+            return None
+        try:
+            with open(self.iteration_file) as f:
+                return int(f.read().strip() or "0")
+        except (OSError, ValueError):
+            log.warning("unreadable iteration file %s", self.iteration_file)
+            return None
+
+    def analyze_previous_cycle(self) -> bool:
+        """Called by the launcher right before deciding a restart.
+        Returns True if the previous cycle made progress."""
+        current = self.read_current_iteration()
+        if current is None:
+            # no signal — count as no-progress only if tracking is possible
+            if self.iteration_file:
+                self.no_progress_cycles += 1
+            return False
+        if self.best_iteration is None or current > self.best_iteration:
+            self.best_iteration = current
+            self.no_progress_cycles = 0
+            return True
+        self.no_progress_cycles += 1
+        log.warning(
+            "no training progress in previous cycle (iteration stuck at %s, %s/%s)",
+            current, self.no_progress_cycles, self.max_no_progress_cycles,
+        )
+        return False
+
+    def should_terminate_early(self) -> bool:
+        return (
+            self.max_no_progress_cycles > 0
+            and self.no_progress_cycles >= self.max_no_progress_cycles
+        )
+
+
+def write_progress_iteration(path: str, iteration: int) -> None:
+    """Workload-side helper: atomically record the reached iteration."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(int(iteration)))
+    os.replace(tmp, path)

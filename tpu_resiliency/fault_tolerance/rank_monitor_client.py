@@ -15,13 +15,12 @@ a training step.
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import socket
 import threading
 from typing import Any, Dict, Optional
 
-from ..utils.ipc import _U32, recv_msg
+from ..utils.ipc import recv_msg, send_msg
 from ..utils.logging import get_logger
 from .config import FaultToleranceConfig
 from .data import (
@@ -114,9 +113,8 @@ class RankMonitorClient:
             raise RankMonitorClientError("not initialized")
         if not want_ack:
             payload = {**payload, "noack": True}
-        raw = json.dumps(payload).encode()
         with self._lock:
-            self._sock.sendall(_U32.pack(len(raw)) + raw)
+            send_msg(self._sock, payload)
             if not want_ack:
                 return None
             reply = recv_msg(self._sock)
@@ -171,7 +169,8 @@ class RankMonitorClient:
         assert self.timeouts_calc is not None
         if reduce_fn is not None or store is not None:
             self.timeouts_calc.synchronize_all(
-                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn
+                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn,
+                namespace=f"cycle{self.cycle}",
             )
         new = self.timeouts_calc.calculate_hb_timeouts(self.hb_timeouts)
         self.hb_timeouts = new
@@ -189,7 +188,8 @@ class RankMonitorClient:
         assert self.timeouts_calc is not None
         if reduce_fn is not None or store is not None:
             self.timeouts_calc.synchronize_all(
-                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn
+                store=store, rank=rank, world_size=world_size, reduce_fn=reduce_fn,
+                namespace=f"cycle{self.cycle}",
             )
         new = self.timeouts_calc.calculate_section_timeouts(
             self.section_timeouts, selection=selection

@@ -97,15 +97,17 @@ class RankMonitorServer:
 
     @staticmethod
     def _default_kill(pid: int, sig_name: str) -> None:
-        try:
-            os.kill(pid, signal.SIGCONT)
-        except OSError:
-            pass
+        """Kill the whole worker process group (the launcher starts workers as
+        session leaders), falling back to the single pid — a hung worker's
+        children (data loaders, probes) must not survive into the next cycle."""
         sig = getattr(signal, sig_name, signal.SIGKILL)
-        try:
-            os.kill(pid, sig)
-        except OSError:
-            pass
+        for send in (os.killpg, os.kill):
+            try:
+                send(pid, signal.SIGCONT)
+                send(pid, sig)
+                return
+            except (ProcessLookupError, PermissionError, OSError):
+                continue
 
     def _shutdown_rank(self, reason: str) -> None:
         pid = self.state.pid

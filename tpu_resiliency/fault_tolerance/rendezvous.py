@@ -1,0 +1,443 @@
+"""Barrier rendezvous over the KV store.
+
+Capability parity with the reference's v2 "barrier rendezvous"
+(``fault_tolerance/ft_rendezvous_barrier.py:543-2301``): an atomic,
+store-based, round-numbered rendezvous with
+
+- a round-open gate where late joiners and **hot spares** block (reference
+  step 0, ``:1296,1842-1865``),
+- atomic join counting + round-fenced per-node info writes (step 1,
+  ``:1914-1997`` — every key embeds the round number so stale writers from a
+  previous incarnation can never corrupt a newer round),
+- host-side round closing and group-rank assignment (step 2, ``:1418,881``),
+- a ``done`` fence all joiners read the assignment through (step 3, ``:1734``).
+
+Re-designed for TPU: the "segment" constraint that keeps NVLink domains
+whole (reference ``:757-1018``) becomes a **slice key** — nodes carry the TPU
+slice/ICI-domain they belong to and assignment keeps slices contiguous and
+whole, because a partial slice cannot form a usable ICI mesh.
+
+Roles: nodes beyond ``max_nodes`` become STANDBY hot spares: they get no rank
+and block at the next round's open gate, ready to replace a failed node
+without waiting for scheduler capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import socket
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from ..utils.profiling import ProfilingEvent, record_event
+
+log = get_logger("rendezvous")
+
+# Store key schema (all round-fenced)
+K_ACTIVE_ROUND = "rdzv/active_round"
+K_CYCLE = "rdzv/cycle"
+K_SHUTDOWN = "rdzv/shutdown"
+
+
+def k_restart_req(n: int) -> str:
+    return f"rdzv/restart_req/{n}"
+
+
+def request_restart(store, reason: str = "") -> None:
+    """Any agent may request a new round after a failure; the host's round
+    loop observes this and opens round N+1 (reference: any agent calls
+    ``open_rendezvous``, ``ft_rendezvous_barrier.py:2273``)."""
+    n = int(store.get(K_ACTIVE_ROUND))
+    store.set(k_restart_req(n), reason or "restart")
+
+
+def is_restart_requested(store) -> bool:
+    n = int(store.get(K_ACTIVE_ROUND))
+    return store.check([k_restart_req(n)])
+
+
+def is_next_round_open(store, current_round: int) -> bool:
+    """Healthy agents poll this to join peer-initiated restarts
+    (reference ``launcher.py:677``)."""
+    raw = store.try_get(K_ACTIVE_ROUND)
+    return raw is not None and int(raw) > current_round
+
+
+def k_open(n: int) -> str:
+    return f"rdzv/open/{n}"
+
+
+def k_closed(n: int) -> str:
+    return f"rdzv/closed/{n}"
+
+
+def k_join_count(n: int) -> str:
+    return f"rdzv/join_count/{n}"
+
+
+def k_node(n: int, node_id: str) -> str:
+    return f"rdzv/node/{n}/{node_id}"
+
+
+def k_result(n: int) -> str:
+    return f"rdzv/result/{n}"
+
+
+def k_done(n: int) -> str:
+    return f"rdzv/done/{n}"
+
+
+class NodeRole(str, enum.Enum):
+    PARTICIPANT = "participant"
+    STANDBY = "standby"
+    EXCLUDED = "excluded"
+
+
+class RendezvousError(RuntimeError):
+    pass
+
+
+class RendezvousClosedError(RendezvousError):
+    """Rendezvous shut down for good (max restarts / operator stop)."""
+
+
+class RendezvousTimeout(RendezvousError, TimeoutError):
+    pass
+
+
+class UnhealthyNodeError(RendezvousError):
+    """Local pre-join health check failed; node must not join."""
+
+
+@dataclasses.dataclass
+class NodeDesc:
+    """What a node advertises when joining a round."""
+
+    node_id: str
+    hostname: str = ""
+    slots: int = 1                      # worker processes this node contributes
+    slice_key: str = ""                 # TPU slice / ICI-domain id (segment analog)
+    prev_group_rank: Optional[int] = None  # for rank stability across rounds
+    arrival: int = 0                    # join order within the round
+    excluded: bool = False              # marked bad by workload control
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw: bytes | str) -> "NodeDesc":
+        return cls(**json.loads(raw))
+
+    @classmethod
+    def create(cls, node_id: Optional[str] = None, slots: int = 1, slice_key: str = "") -> "NodeDesc":
+        host = socket.gethostname()
+        return cls(
+            node_id=node_id or f"{host}:{slots}",
+            hostname=host,
+            slots=slots,
+            slice_key=slice_key,
+        )
+
+
+@dataclasses.dataclass
+class RendezvousResult:
+    round_num: int
+    cycle: int
+    role: NodeRole
+    group_rank: Optional[int]           # this node's rank among participant nodes
+    group_world_size: int               # number of participant nodes
+    global_world_size: int              # total worker slots across participants
+    rank_offset: int                    # first global worker rank on this node
+    participants: List[str]             # node_ids in group-rank order
+    store_addr: str = ""
+    store_port: int = 0
+
+
+def assign_group_ranks(
+    nodes: List[NodeDesc],
+    min_nodes: int,
+    max_nodes: Optional[int],
+    require_equal_slots: bool = True,
+) -> Dict[str, Dict]:
+    """Pure assignment policy (host side).
+
+    Selection order favors (1) non-excluded nodes, (2) keeping whole slices
+    together (nodes sharing a slice_key are sorted adjacent and a slice is
+    only used if it fits entirely), (3) rank stability (previous group rank),
+    (4) arrival order.  Returns {node_id: {"role", "group_rank"}}.
+    """
+    healthy = [n for n in nodes if not n.excluded]
+    if require_equal_slots and healthy:
+        slot_counts = {n.slots for n in healthy}
+        if len(slot_counts) > 1:
+            raise RendezvousError(f"heterogeneous slots per node: {sorted(slot_counts)}")
+    cap = max_nodes if max_nodes is not None else len(healthy)
+
+    def sort_key(n: NodeDesc):
+        return (
+            n.prev_group_rank if n.prev_group_rank is not None else 1 << 30,
+            n.slice_key,
+            n.arrival,
+            n.node_id,
+        )
+
+    ordered = sorted(healthy, key=sort_key)
+
+    # Keep slices whole: greedily take slice groups (in order of their best
+    # member) while they fit entirely under the cap; single (keyless) nodes
+    # fill the remainder.
+    by_slice: Dict[str, List[NodeDesc]] = {}
+    for n in ordered:
+        by_slice.setdefault(n.slice_key, []).append(n)
+
+    selected: List[NodeDesc] = []
+    if len(by_slice) == 1:
+        selected = ordered[:cap]
+    else:
+        slice_order = sorted(
+            by_slice.items(), key=lambda kv: min(sort_key(n) for n in kv[1])
+        )
+        for key, members in slice_order:
+            if key == "":
+                continue
+            if len(selected) + len(members) <= cap:
+                selected.extend(members)
+        for n in by_slice.get("", []):
+            if len(selected) < cap:
+                selected.append(n)
+        # If slice-whole packing under-fills below min_nodes, fall back to
+        # plain ordering (a degraded mesh beats no mesh).
+        if len(selected) < min(min_nodes, len(ordered)):
+            selected = ordered[:cap]
+
+    if len(selected) < min_nodes:
+        raise RendezvousError(
+            f"not enough healthy nodes: {len(selected)} < min_nodes {min_nodes}"
+        )
+
+    selected_ids = {n.node_id for n in selected}
+    out: Dict[str, Dict] = {}
+    rank = 0
+    for n in selected:
+        out[n.node_id] = {"role": NodeRole.PARTICIPANT.value, "group_rank": rank}
+        rank += 1
+    for n in nodes:
+        if n.node_id in selected_ids:
+            continue
+        role = NodeRole.EXCLUDED if n.excluded else NodeRole.STANDBY
+        out[n.node_id] = {"role": role.value, "group_rank": None}
+    return out
+
+
+class RendezvousHost:
+    """Round lifecycle owner — runs next to the store server (launcher of the
+    store-hosting node, or the standalone control plane)."""
+
+    def __init__(
+        self,
+        store,
+        min_nodes: int,
+        max_nodes: Optional[int] = None,
+        settle_time: float = 2.0,
+        close_poll_interval: float = 0.1,
+    ):
+        self.store = store
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.settle_time = settle_time
+        self.close_poll_interval = close_poll_interval
+
+    def bootstrap(self) -> None:
+        """Initialize round/cycle counters if this is a fresh store."""
+        self.store.compare_set(K_ACTIVE_ROUND, b"", b"0")
+        self.store.compare_set(K_CYCLE, b"", b"0")
+
+    def current_round(self) -> int:
+        return int(self.store.get(K_ACTIVE_ROUND))
+
+    def open_round(self) -> int:
+        """Open the next round (called on start and on every restart decision).
+        Idempotent per round transition thanks to CAS on the round pointer."""
+        n = self.current_round()
+        if self.store.check([k_done(n)]) or not self.store.check([k_open(n)]):
+            target = n + 1 if self.store.check([k_open(n)]) else n
+            # advance pointer (only one host instance does this; CAS guards
+            # against double-open from re-entrant calls)
+            self.store.compare_set(K_ACTIVE_ROUND, str(n).encode(), str(target).encode())
+            self.store.set(k_open(target), b"1")
+            cycle = self.store.add(K_CYCLE, 1) - 1
+            log.info("rendezvous round %s open (cycle %s)", target, cycle)
+            record_event(ProfilingEvent.RENDEZVOUS_STARTED, round=target, cycle=cycle)
+            return target
+        return n
+
+    def close_round_when_ready(self, timeout: float = 600.0) -> int:
+        """Step 2: wait for >= min_nodes joiners (plus a settle window to let
+        stragglers/spares in, ended early once max_nodes reached), then fence
+        the round, assign ranks, publish the result."""
+        n = self.current_round()
+        deadline = time.monotonic() + timeout
+        settle_deadline: Optional[float] = None
+        while True:
+            count = int(self.store.try_get(k_join_count(n)) or b"0")
+            if self.max_nodes is not None and count >= self.max_nodes:
+                break
+            if count >= self.min_nodes:
+                if settle_deadline is None:
+                    settle_deadline = time.monotonic() + self.settle_time
+                elif time.monotonic() >= settle_deadline:
+                    break
+            else:
+                settle_deadline = None
+            if time.monotonic() >= deadline:
+                if count >= self.min_nodes:
+                    break
+                raise RendezvousTimeout(
+                    f"round {n}: only {count}/{self.min_nodes} nodes joined"
+                )
+            time.sleep(self.close_poll_interval)
+
+        self.store.set(k_closed(n), b"1")
+        # small grace for in-flight joiners who passed the open-gate check
+        time.sleep(self.close_poll_interval)
+        count = int(self.store.try_get(k_join_count(n)) or b"0")
+        nodes = []
+        for key in self.store.list_keys(f"rdzv/node/{n}/"):
+            nodes.append(NodeDesc.from_json(self.store.get(key)))
+        assignment = assign_group_ranks(nodes, self.min_nodes, self.max_nodes)
+        participants = sorted(
+            (nid for nid, a in assignment.items() if a["group_rank"] is not None),
+            key=lambda nid: assignment[nid]["group_rank"],
+        )
+        slots = {d.node_id: d.slots for d in nodes}
+        result = {
+            "assignment": assignment,
+            "participants": participants,
+            "slots": slots,
+            "cycle": int(self.store.get(K_CYCLE)) - 1,
+        }
+        self.store.set(k_result(n), json.dumps(result))
+        self.store.set(k_done(n), b"1")
+        log.info(
+            "round %s closed: %s participants, %s standby",
+            n,
+            len(participants),
+            sum(1 for a in assignment.values() if a["role"] == NodeRole.STANDBY.value),
+        )
+        record_event(
+            ProfilingEvent.RENDEZVOUS_COMPLETED, round=n, participants=len(participants)
+        )
+        return n
+
+    def shutdown(self, reason: str = "") -> None:
+        self.store.set(K_SHUTDOWN, reason or "shutdown")
+
+
+class RendezvousJoiner:
+    """Node-side protocol (steps 0/1/3)."""
+
+    def __init__(
+        self,
+        store,
+        desc: NodeDesc,
+        pre_join_health_check=None,
+        open_poll_interval: float = 0.25,
+    ):
+        self.store = store
+        self.desc = desc
+        self.pre_join_health_check = pre_join_health_check
+        self.open_poll_interval = open_poll_interval
+
+    def _check_shutdown(self) -> None:
+        reason = self.store.try_get(K_SHUTDOWN)
+        if reason is not None:
+            raise RendezvousClosedError(reason.decode() or "shutdown")
+
+    def wait_round_open(self, timeout: float = 600.0) -> int:
+        """Step 0: block until a joinable (open, not closed) round exists.
+        Hot spares and late arrivals park here."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self._check_shutdown()
+            raw = self.store.try_get(K_ACTIVE_ROUND)
+            if raw is not None:
+                n = int(raw)
+                if self.store.check([k_open(n)]) and not self.store.check([k_closed(n)]):
+                    return n
+            if time.monotonic() >= deadline:
+                raise RendezvousTimeout("no open rendezvous round")
+            time.sleep(self.open_poll_interval)
+
+    def join(self, timeout: float = 600.0) -> RendezvousResult:
+        """Full join: wait for open round → health check → register → await
+        assignment.  Raises UnhealthyNodeError if the local check fails."""
+        deadline = time.monotonic() + timeout
+        while True:
+            n = self.wait_round_open(timeout=deadline - time.monotonic())
+            if self.pre_join_health_check is not None:
+                self.pre_join_health_check()  # raises UnhealthyNodeError
+            arrival = self.store.add(k_join_count(n), 1)
+            desc = dataclasses.replace(self.desc, arrival=arrival)
+            self.store.set(k_node(n, desc.node_id), desc.to_json())
+            try:
+                self.store.wait([k_done(n)], timeout=max(1.0, deadline - time.monotonic()))
+            except Exception as exc:
+                self._check_shutdown()
+                raise RendezvousTimeout(f"round {n} never completed: {exc}") from exc
+            result = json.loads(self.store.get(k_result(n)))
+            mine = result["assignment"].get(self.desc.node_id)
+            if mine is None:
+                # Raced the round close: our info write landed after the host
+                # read the node list.  Not fatal — retry at the next round's
+                # open gate like a hot spare.
+                log.warning(
+                    "node %s joined round %s too late for assignment; retrying",
+                    self.desc.node_id, n,
+                )
+                time.sleep(self.open_poll_interval)
+                continue
+            role = NodeRole(mine["role"])
+            participants = result["participants"]
+            slots = result["slots"]
+            global_world = sum(slots[p] for p in participants)
+            if role == NodeRole.PARTICIPANT:
+                grank = mine["group_rank"]
+                self.desc.prev_group_rank = grank
+                rank_offset = sum(slots[p] for p in participants[:grank])
+                return RendezvousResult(
+                    round_num=n,
+                    cycle=result["cycle"],
+                    role=role,
+                    group_rank=grank,
+                    group_world_size=len(participants),
+                    global_world_size=global_world,
+                    rank_offset=rank_offset,
+                    participants=participants,
+                )
+            if role == NodeRole.EXCLUDED:
+                raise RendezvousClosedError(f"node {self.desc.node_id} excluded")
+            # STANDBY: hot spare — park at the next round's open gate by
+            # looping (the next wait_round_open only returns on a new round).
+            log.info("node %s standby for round %s; waiting as hot spare", self.desc.node_id, n)
+            if time.monotonic() >= deadline:
+                return RendezvousResult(
+                    round_num=n,
+                    cycle=result["cycle"],
+                    role=role,
+                    group_rank=None,
+                    group_world_size=len(participants),
+                    global_world_size=global_world,
+                    rank_offset=0,
+                    participants=participants,
+                )
+            while (
+                self.store.check([k_closed(n)])
+                and int(self.store.get(K_ACTIVE_ROUND)) == n
+            ):
+                self._check_shutdown()
+                if time.monotonic() >= deadline:
+                    raise RendezvousTimeout("standby node: no new round opened")
+                time.sleep(self.open_poll_interval)

@@ -114,12 +114,18 @@ class TimeoutsCalc:
         world_size: Optional[int] = None,
         reduce_fn: Optional[Callable[[Dict[str, float]], Dict[str, float]]] = None,
         timeout: float = 60.0,
+        namespace: str = "",
     ) -> None:
         """Key-wise MAX of observed stats across ranks.
 
         Either pass ``reduce_fn`` (e.g. an on-device pmax wrapper taking and
         returning the ``{stat_key: value}`` dict) or a store + rank +
         world_size for the DCN gather-max path.
+
+        ``namespace`` must be shared by all ranks of one incarnation but
+        unique across restarts (e.g. the restart cycle number) — the store
+        outlives worker incarnations, and reusing ``tc_sync`` keys from a
+        previous cycle would corrupt the gather barrier.
         """
         vals = self._values()
         if reduce_fn is not None:
@@ -129,7 +135,7 @@ class TimeoutsCalc:
             raise TimeoutsCalcError("need store+rank+world_size or reduce_fn")
         gen = self._sync_gen
         self._sync_gen += 1
-        prefix = f"tc_sync/{gen}"
+        prefix = f"tc_sync/{namespace}/{gen}" if namespace else f"tc_sync/{gen}"
         store.set(f"{prefix}/vals/{rank}", json.dumps(vals))
         barrier(store, f"{prefix}/gather", world_size, timeout=timeout)
         merged: Dict[str, float] = {}

@@ -1,0 +1,24 @@
+"""Node/device/storage health checks (reference: ``shared_utils/health_check.py``).
+
+TPU re-design of the reference's check suite: NVML GPU-recovery-action and
+NVLink checks become a device probe that must NOT touch JAX in-process (a
+launcher that initializes the TPU would steal the chips from its workers —
+the probe runs in a short-lived subprocess instead); IB ``link_downed``
+counters become generic NIC link-state reads under ``/sys/class/net``;
+Lustre/NFS storage probes keep their shape (timed write/read/delete).
+"""
+
+from .base import ChainedHealthCheck, HealthCheck, HealthCheckResult
+from .device import DeviceHealthCheck
+from .node import NicLinkHealthCheck, NodeResourceHealthCheck
+from .storage import StoragePathHealthCheck
+
+__all__ = [
+    "HealthCheck",
+    "HealthCheckResult",
+    "ChainedHealthCheck",
+    "DeviceHealthCheck",
+    "NodeResourceHealthCheck",
+    "NicLinkHealthCheck",
+    "StoragePathHealthCheck",
+]

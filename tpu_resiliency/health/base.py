@@ -1,0 +1,78 @@
+"""Health check base types."""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+from ..utils.profiling import ProfilingEvent, record_event
+
+log = get_logger("health")
+
+
+@dataclasses.dataclass
+class HealthCheckResult:
+    healthy: bool
+    message: str = ""
+    name: str = ""
+    duration_s: float = 0.0
+
+    def __bool__(self) -> bool:
+        return self.healthy
+
+
+class HealthCheck(abc.ABC):
+    """A single named health check with a bounded runtime."""
+
+    name: str = "health_check"
+
+    @abc.abstractmethod
+    def _check(self) -> HealthCheckResult:
+        ...
+
+    def run(self) -> HealthCheckResult:
+        record_event(ProfilingEvent.HEALTH_CHECK_STARTED, check=self.name)
+        t0 = time.monotonic()
+        try:
+            result = self._check()
+        except Exception as exc:  # noqa: BLE001 - a crashing check is unhealthy
+            result = HealthCheckResult(False, f"{type(exc).__name__}: {exc}")
+        result.name = self.name
+        result.duration_s = time.monotonic() - t0
+        record_event(
+            ProfilingEvent.HEALTH_CHECK_COMPLETED,
+            check=self.name,
+            healthy=result.healthy,
+            duration_s=result.duration_s,
+        )
+        if not result.healthy:
+            log.warning("health check %s FAILED: %s", self.name, result.message)
+        return result
+
+
+class ChainedHealthCheck(HealthCheck):
+    """Run checks in order; first failure wins (reference chains GPU→NVL→NIC,
+    ``inprocess/health_check.py:155-228``)."""
+
+    name = "chained"
+
+    def __init__(self, checks: List[HealthCheck], fail_fast: bool = True):
+        self.checks = checks
+        self.fail_fast = fail_fast
+
+    def _check(self) -> HealthCheckResult:
+        failures: List[HealthCheckResult] = []
+        for check in self.checks:
+            result = check.run()
+            if not result.healthy:
+                if self.fail_fast:
+                    return result
+                failures.append(result)
+        if failures:
+            return HealthCheckResult(
+                False, "; ".join(f"{r.name}: {r.message}" for r in failures)
+            )
+        return HealthCheckResult(True, "all checks passed")

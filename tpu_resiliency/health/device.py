@@ -1,0 +1,81 @@
+"""TPU device health probe.
+
+Reference analog: ``GPUHealthCheck`` (NVML recovery action,
+``shared_utils/health_check.py:253-447``).  TPUs expose no NVML; the honest
+liveness signal is "can a fresh process initialize the runtime and run one
+op".  Crucially the probe must run in a **subprocess**: initializing JAX in
+the launcher would claim the TPU chips and starve the workers.
+
+The subprocess runs a trivial computation with a wall-clock timeout and
+prints a sentinel; hang, crash, or missing devices all fail the check.
+Results are cached for ``cache_ttl`` seconds because a full probe costs a
+runtime init (~seconds).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from .base import HealthCheck, HealthCheckResult
+
+_PROBE_CODE = r"""
+import os
+os.environ.setdefault("TPU_PROCESS_BOUNDS", "")
+import jax
+devs = jax.devices()
+assert devs, "no devices"
+import jax.numpy as jnp
+x = jnp.ones((8, 8))
+y = (x @ x).sum()
+assert float(y) == 512.0, float(y)
+print("TPURX_DEVICE_OK", len(devs))
+"""
+
+
+class DeviceHealthCheck(HealthCheck):
+    name = "device"
+
+    _cache: Optional[tuple[float, HealthCheckResult]] = None
+
+    def __init__(self, timeout: float = 120.0, cache_ttl: float = 300.0, env=None):
+        self.timeout = timeout
+        self.cache_ttl = cache_ttl
+        self.env = env
+
+    def _check(self) -> HealthCheckResult:
+        cached = type(self)._cache
+        if cached is not None and time.monotonic() - cached[0] < self.cache_ttl:
+            return HealthCheckResult(cached[1].healthy, cached[1].message + " (cached)")
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=self.timeout,
+            )
+        except subprocess.TimeoutExpired:
+            result = HealthCheckResult(False, f"device probe hung (> {self.timeout}s)")
+            type(self)._cache = (time.monotonic(), result)
+            return result
+        if out.returncode == 0 and "TPURX_DEVICE_OK" in out.stdout:
+            n = out.stdout.strip().rsplit(" ", 1)[-1]
+            result = HealthCheckResult(True, f"{n} device(s) healthy")
+        else:
+            tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
+            result = HealthCheckResult(
+                False, f"device probe rc={out.returncode}: {' | '.join(tail)}"
+            )
+        type(self)._cache = (time.monotonic(), result)
+        return result
+
+    @classmethod
+    def clear_cache(cls) -> None:
+        cls._cache = None

@@ -1,0 +1,106 @@
+"""Host-level health checks: resources and NIC link state.
+
+Reference analogs: ``NodeHealthCheck`` (external daemon,
+``shared_utils/health_check.py:1418``) — replaced by direct local resource
+thresholds (no daemon dependency); ``NicHealthCheck``/``NicLinkStateHealthCheck``
+(IB sysfs counters, ``:449,722``) — replaced by generic ``/sys/class/net``
+link-state reads, since TPU pods ride ICI (invisible to the host) + standard
+NICs for DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from .base import HealthCheck, HealthCheckResult
+
+
+class NodeResourceHealthCheck(HealthCheck):
+    """Fails when the host is resource-starved enough to wedge training."""
+
+    name = "node_resources"
+
+    def __init__(
+        self,
+        min_free_mem_mb: float = 512.0,
+        max_load_per_cpu: float = 32.0,
+        min_free_disk_mb: float = 256.0,
+        disk_path: str = "/tmp",
+    ):
+        self.min_free_mem_mb = min_free_mem_mb
+        self.max_load_per_cpu = max_load_per_cpu
+        self.min_free_disk_mb = min_free_disk_mb
+        self.disk_path = disk_path
+
+    def _check(self) -> HealthCheckResult:
+        # memory
+        meminfo = {}
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    meminfo[key.strip()] = rest.strip()
+            avail_kb = int(meminfo.get("MemAvailable", "0 kB").split()[0])
+            if avail_kb / 1024.0 < self.min_free_mem_mb:
+                return HealthCheckResult(
+                    False, f"low memory: {avail_kb / 1024.0:.0f}MB available"
+                )
+        except OSError:
+            pass
+        # load
+        try:
+            load1, _, _ = os.getloadavg()
+            ncpu = os.cpu_count() or 1
+            if load1 / ncpu > self.max_load_per_cpu:
+                return HealthCheckResult(False, f"load {load1:.1f} on {ncpu} cpus")
+        except OSError:
+            pass
+        # disk
+        try:
+            st = os.statvfs(self.disk_path)
+            free_mb = st.f_bavail * st.f_frsize / (1024.0 * 1024.0)
+            if free_mb < self.min_free_disk_mb:
+                return HealthCheckResult(
+                    False, f"low disk on {self.disk_path}: {free_mb:.0f}MB free"
+                )
+        except OSError:
+            pass
+        return HealthCheckResult(True, "node resources ok")
+
+
+class NicLinkHealthCheck(HealthCheck):
+    """Checks that the given (or all physical) network interfaces are up."""
+
+    name = "nic_link"
+
+    def __init__(self, interfaces: Optional[Sequence[str]] = None, sys_net: str = "/sys/class/net"):
+        self.interfaces = interfaces
+        self.sys_net = sys_net
+
+    def _interfaces(self) -> Sequence[str]:
+        if self.interfaces is not None:
+            return self.interfaces
+        try:
+            return [
+                i
+                for i in os.listdir(self.sys_net)
+                if i != "lo" and not i.startswith(("docker", "veth", "br-"))
+            ]
+        except OSError:
+            return []
+
+    def _check(self) -> HealthCheckResult:
+        down = []
+        for iface in self._interfaces():
+            oper = os.path.join(self.sys_net, iface, "operstate")
+            try:
+                with open(oper) as f:
+                    state = f.read().strip()
+                if state not in ("up", "unknown"):
+                    down.append(f"{iface}={state}")
+            except OSError:
+                down.append(f"{iface}=unreadable")
+        if down:
+            return HealthCheckResult(False, f"links down: {', '.join(down)}")
+        return HealthCheckResult(True, "links up")

@@ -1,0 +1,50 @@
+"""Storage health checks.
+
+Reference analogs: ``DistributedStorageHealthCheck`` /
+``StoragePathHealthCheck`` (``shared_utils/health_check.py:1606,1734``): a
+timed write→read→delete probe on the checkpoint path, run in a worker thread
+so a wedged NFS/Lustre/GCS-fuse mount fails the check instead of hanging the
+caller.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import uuid
+
+from .base import HealthCheck, HealthCheckResult
+
+
+class StoragePathHealthCheck(HealthCheck):
+    name = "storage_path"
+
+    def __init__(self, path: str, timeout: float = 30.0, probe_bytes: int = 4096):
+        self.path = path
+        self.timeout = timeout
+        self.probe_bytes = probe_bytes
+
+    def _probe(self) -> HealthCheckResult:
+        os.makedirs(self.path, exist_ok=True)
+        probe = os.path.join(self.path, f".tpurx_probe_{uuid.uuid4().hex}")
+        payload = os.urandom(self.probe_bytes)
+        with open(probe, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(probe, "rb") as f:
+            back = f.read()
+        os.unlink(probe)
+        if back != payload:
+            return HealthCheckResult(False, f"readback mismatch on {self.path}")
+        return HealthCheckResult(True, f"{self.path} writable")
+
+    def _check(self) -> HealthCheckResult:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            future = pool.submit(self._probe)
+            try:
+                return future.result(timeout=self.timeout)
+            except concurrent.futures.TimeoutError:
+                return HealthCheckResult(
+                    False, f"storage probe on {self.path} hung (> {self.timeout}s)"
+                )
