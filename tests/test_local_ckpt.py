@@ -1,0 +1,208 @@
+"""Local checkpointing tests (reference analog: tests/checkpointing/unit/test_basic_local.py,
+test_cleanup.py + replication tests): multi-threaded "ranks" with real TCP
+peer exchange and a real store."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpointing.local.manager import LocalCheckpointManager
+from tpu_resiliency.checkpointing.local.replication import (
+    CliqueReplication,
+    PeerExchange,
+    clique_members,
+)
+from tpu_resiliency.checkpointing.local.state_dict import TensorAwareTree
+from tpu_resiliency.store import StoreClient
+
+
+def make_tree(rank, seed=0):
+    k = jax.random.PRNGKey(seed * 100 + rank)
+    return {
+        "w": jax.random.normal(k, (8, 4)),
+        "step": np.int64(seed),
+        "rank_marker": np.array([rank], dtype=np.int32),
+    }
+
+
+class TestTensorAwareTree:
+    def test_pop_insert_roundtrip(self):
+        tree = make_tree(0)
+        tat = TensorAwareTree.from_tree(tree)
+        arrays = tat.pop_tensors()
+        assert tat.is_hollow
+        with pytest.raises(RuntimeError):
+            tat.pop_tensors()
+        tat.insert_tensors(arrays)
+        rebuilt = tat.to_tree(template=tree)
+        np.testing.assert_array_equal(np.asarray(rebuilt["w"]), np.asarray(tree["w"]))
+        assert isinstance(rebuilt["w"], jax.Array)
+
+    def test_bytes_roundtrip(self):
+        tree = make_tree(3, seed=9)
+        blob = TensorAwareTree.from_tree(tree).to_bytes()
+        back = TensorAwareTree.from_bytes(blob)
+        rebuilt = back.to_tree_like(tree)
+        np.testing.assert_array_equal(np.asarray(rebuilt["w"]), np.asarray(tree["w"]))
+        assert rebuilt["rank_marker"][0] == 3
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            TensorAwareTree.from_bytes(b"NOTMAGIC" + b"x" * 64)
+
+
+class TestCliqueMembers:
+    def test_contiguous(self):
+        assert clique_members(0, 8, 2, 1) == [0, 1]
+        assert clique_members(1, 8, 2, 1) == [0, 1]
+        assert clique_members(5, 8, 2, 1) == [4, 5]
+
+    def test_jump(self):
+        # factor 2, jump 4 (e.g. 4 ranks per host): replicas on another host
+        assert clique_members(0, 8, 2, 4) == [0, 4]
+        assert clique_members(5, 8, 2, 4) == [1, 5]
+
+    def test_no_replication(self):
+        assert clique_members(3, 8, 1, 1) == [3]
+
+    def test_truncated_tail(self):
+        assert clique_members(6, 7, 2, 1) == [6]
+
+
+def _run_ranks(world, fn):
+    errors, results = [], {}
+
+    def wrap(rank):
+        try:
+            results[rank] = fn(rank)
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            errors.append((rank, exc))
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+def test_peer_exchange(store_server):
+    world = 3
+
+    def member(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="px1")
+        try:
+            ex.send((rank + 1) % world, tag=7, payload=f"hello-from-{rank}".encode())
+            got = ex.recv((rank - 1) % world, tag=7, timeout=30.0)
+            return got.decode()
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, member)
+    for r in range(world):
+        assert results[r] == f"hello-from-{(r - 1) % world}"
+
+
+def test_save_load_with_replication(store_server, tmp_path):
+    world, factor = 4, 2
+
+    def member(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="px2")
+        repl = CliqueReplication(ex, world, replication_factor=factor)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"node{rank}"),  # separate dirs = separate "disks"
+            rank, world, store=store, replication=repl,
+        )
+        try:
+            mgr.save(make_tree(rank, seed=1), iteration=10, is_async=False)
+            latest = mgr.find_latest()
+            assert latest == 10
+            tree, it = mgr.load(make_tree(rank), iteration=latest)
+            return int(np.asarray(tree["rank_marker"])[0])
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, member)
+    for r in range(world):
+        assert results[r] == r  # every rank got ITS OWN data back
+
+
+def test_load_after_node_loss(store_server, tmp_path):
+    """Rank 1 loses its disk; its clique buddy (rank 0) serves the replica."""
+    world, factor = 2, 2
+
+    def phase1(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="px3a")
+        repl = CliqueReplication(ex, world, replication_factor=factor)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"node{rank}"), rank, world, store=store, replication=repl
+        )
+        try:
+            mgr.save(make_tree(rank, seed=2), iteration=5, is_async=False)
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+
+    # simulate node 1's disk loss
+    import shutil
+
+    shutil.rmtree(tmp_path / "node1")
+
+    def phase2(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="px3b")
+        repl = CliqueReplication(ex, world, replication_factor=factor)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"node{rank}"), rank, world, store=store, replication=repl
+        )
+        try:
+            latest = mgr.find_latest()
+            assert latest == 5, f"rank {rank} found {latest}"
+            tree, _ = mgr.load(make_tree(rank), iteration=latest)
+            return int(np.asarray(tree["rank_marker"])[0])
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, phase2)
+    assert results[1] == 1  # recovered its own data from rank 0's replica
+    assert results[0] == 0
+
+
+def test_cleanup_keeps_last(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(
+        str(tmp_path / "solo"), 0, 1, store=store, keep_last=2
+    )
+    for it in (1, 2, 3, 4):
+        mgr.save(make_tree(0, seed=it), iteration=it, is_async=False)
+    holdings = mgr._holdings()
+    assert sorted(holdings) == [3, 4]
+    assert mgr.find_latest() == 4
+    store.close()
+
+
+def test_async_local_save(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "a"), 0, 1, store=store)
+    tree = make_tree(0, seed=7)
+    mgr.save(tree, iteration=42, is_async=True)
+    mgr.wait()
+    loaded, it = mgr.load(tree)
+    assert it == 42
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), np.asarray(tree["w"]))
+    store.close()

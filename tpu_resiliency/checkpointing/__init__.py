@@ -1,0 +1,32 @@
+"""Checkpointing: async global saves + node-local saves with replication.
+
+Reference: ``checkpointing/`` (async_ckpt + local).  TPU re-design:
+
+- D2H staging uses JAX's async host transfer (``copy_to_host_async`` on every
+  array, then materialize) into POSIX shared memory, so the training step
+  resumes after one device sync instead of blocking on file writes
+  (reference stages via CUDA streams + pinned buffers,
+  ``async_ckpt/filesystem_async.py:230``).
+- The persistent writer is a ``spawn``-ed process receiving zero-copy shm
+  handles (reference uses CUDA-IPC / CPU-shm handles, ``core.py:434-438``).
+- Completion consensus rides the tpurx KV store over DCN instead of a NCCL
+  all_reduce (reference ``core.py:279-291``).
+- The on-disk format is a process-sharded array layout with a commit-marker
+  metadata file (reference leans on torch DCP; we have no torch).
+"""
+
+from .async_ckpt.core import AsyncCallsQueue, AsyncRequest
+from .async_ckpt.checkpointer import AsyncCheckpointer, load_checkpoint
+from .local.state_dict import TensorAwareTree
+from .local.manager import LocalCheckpointManager
+from .local.replication import CliqueReplication
+
+__all__ = [
+    "AsyncCallsQueue",
+    "AsyncRequest",
+    "AsyncCheckpointer",
+    "load_checkpoint",
+    "TensorAwareTree",
+    "LocalCheckpointManager",
+    "CliqueReplication",
+]

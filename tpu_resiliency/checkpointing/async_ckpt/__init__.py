@@ -1,0 +1,1 @@
+"""Asynchronous checkpointing core (reference: ``checkpointing/async_ckpt/``)."""

@@ -1,0 +1,212 @@
+"""High-level async checkpoint API for JAX pytrees.
+
+Reference analogs: ``TorchAsyncCheckpoint`` (``torch_ckpt.py:32``) +
+``save_state_dict_async_plan`` / ``..._finalize`` (``state_dict_saver.py``).
+
+Save pipeline per request:
+  1. (trainer, sync)   stage_pytree: async D2H of every shard into shm
+  2. (worker, async)   write_process_shards: shm -> .npy files + process index
+  3. (trainer, later)  finalize once ALL ranks' writes are done:
+                       coordinator merges process indices -> metadata.json
+                       (atomic commit), everyone unlinks shm
+
+Plan caching analog (reference ``CheckpointMetadataCache``): the tree
+structure (treedef + leaf paths) of the previous save is remembered; when
+unchanged, validation work is skipped and the same leaf ordering is reused.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import get_logger
+from .core import AsyncCallsQueue, AsyncRequest, store_sync_fn
+from .staging import StagedTree, shard_payload, stage_pytree
+from .writer import (
+    is_committed,
+    read_leaf,
+    read_metadata,
+    write_metadata,
+    write_process_shards,
+)
+
+log = get_logger("checkpointer")
+
+
+class AsyncCheckpointer:
+    def __init__(
+        self,
+        store=None,
+        rank: int = 0,
+        world_size: int = 1,
+        process_index: Optional[int] = None,
+        persistent_worker: bool = True,
+        write_threads: int = 4,
+    ):
+        sync_fn = (
+            store_sync_fn(store, rank, world_size) if store is not None else None
+        )
+        self.queue = AsyncCallsQueue(persistent=persistent_worker, sync_fn=sync_fn)
+        self.rank = rank
+        self.world_size = world_size
+        self.write_threads = write_threads
+        if process_index is None:
+            try:
+                import jax
+
+                process_index = jax.process_index()
+            except Exception:  # noqa: BLE001
+                process_index = 0
+        self.process_index = process_index
+        self._cached_structure: Optional[tuple] = None
+
+    # -- save --------------------------------------------------------------
+
+    def async_save(
+        self,
+        tree: Any,
+        ckpt_dir: str,
+        extra_metadata: Optional[Dict] = None,
+        save_id: Optional[str] = None,
+    ) -> int:
+        """Stage synchronously (cheap), write + commit asynchronously.
+        Returns the call idx.  Call :meth:`maybe_finalize` every step.
+
+        ``save_id`` must match across ranks of one save (e.g. the training
+        iteration); finalize only merges process indices carrying the same
+        id, so stale index files from a previous run into the same directory
+        (possibly with a different world size) are never committed."""
+        os.makedirs(ckpt_dir, exist_ok=True)
+        if save_id is None:
+            save_id = str((extra_metadata or {}).get("iteration", "default"))
+        # drop our own leftovers from any previous save into this directory
+        for stale in (
+            os.path.join(ckpt_dir, f"process_{self.process_index}.json"),
+            os.path.join(ckpt_dir, "metadata.json") if self.rank == 0 else None,
+        ):
+            if stale and os.path.exists(stale):
+                os.unlink(stale)
+        staged = stage_pytree(tree, process_index=self.process_index)
+        structure = (staged.treedef_repr, tuple(staged.leaf_paths))
+        if self._cached_structure != structure:
+            self._cached_structure = structure
+        payloads = [shard_payload(s) for s in staged.shards]
+
+        finalize_fns: List[Callable] = []
+        if self.rank == 0:
+            finalize_fns.append(
+                lambda: _finalize_metadata(ckpt_dir, staged, extra_metadata, save_id)
+            )
+
+        req = AsyncRequest(
+            async_fn=write_process_shards,
+            async_fn_args=(
+                ckpt_dir, self.process_index, payloads, self.write_threads, save_id,
+            ),
+            finalize_fns=finalize_fns,
+            cleanup_fns=[lambda: staged.close(unlink=True)],
+        )
+        return self.queue.schedule_async_request(req)
+
+    def save(self, tree: Any, ckpt_dir: str, extra_metadata: Optional[Dict] = None) -> None:
+        """Synchronous save (stage + write + commit before returning)."""
+        self.async_save(tree, ckpt_dir, extra_metadata)
+        self.finalize_all()
+
+    def maybe_finalize(self, blocking: bool = False) -> List[int]:
+        return self.queue.maybe_finalize_async_calls(blocking=blocking)
+
+    def finalize_all(self, timeout: float = 600.0) -> None:
+        self.queue.maybe_finalize_async_calls(blocking=True, timeout=timeout)
+
+    def close(self) -> None:
+        self.queue.close()
+
+
+def _finalize_metadata(
+    ckpt_dir: str, staged: StagedTree, extra: Optional[Dict], save_id: str
+) -> None:
+    all_shards: List[Dict] = []
+    merged = 0
+    for pf in sorted(glob.glob(os.path.join(ckpt_dir, "process_*.json"))):
+        with open(pf) as f:
+            idx = json.load(f)
+        if idx.get("save_id") != save_id:
+            log.warning("ignoring stale process index %s (save_id %r != %r)",
+                        pf, idx.get("save_id"), save_id)
+            continue
+        merged += 1
+        for s in idx["shards"]:
+            s["process_index"] = idx["process_index"]
+            all_shards.append(s)
+    write_metadata(
+        ckpt_dir,
+        staged.treedef_repr,
+        staged.leaf_paths,
+        all_shards,
+        num_processes=merged,
+        extra={**(extra or {}), "save_id": save_id},
+    )
+    log.info("checkpoint committed: %s (%d shards)", ckpt_dir, len(all_shards))
+
+
+# -- load --------------------------------------------------------------------
+
+class CachedMetadataReader:
+    """Caches metadata.json across loads (reference
+    ``cached_metadata_filesystem_reader.py:24``)."""
+
+    def __init__(self):
+        self._cache: Dict[str, Dict] = {}
+
+    def read(self, ckpt_dir: str) -> Dict:
+        key = os.path.abspath(ckpt_dir)
+        if key not in self._cache:
+            self._cache[key] = read_metadata(ckpt_dir)
+        return self._cache[key]
+
+
+_default_reader = CachedMetadataReader()
+
+
+def load_checkpoint(
+    ckpt_dir: str,
+    template: Any,
+    reader: Optional[CachedMetadataReader] = None,
+) -> Any:
+    """Load into the structure (and shardings) of ``template``.
+
+    Template leaves that are jax.Arrays get the restored values placed with
+    the template's sharding; numpy/scalar leaves come back as numpy.
+    """
+    if not is_committed(ckpt_dir):
+        raise FileNotFoundError(f"no committed checkpoint at {ckpt_dir}")
+    meta = (reader or _default_reader).read(ckpt_dir)
+
+    import jax
+    import jax.tree_util as jtu
+
+    leaves, treedef = jtu.tree_flatten(template)
+    if len(leaves) != len(meta["leaf_paths"]):
+        raise ValueError(
+            f"template has {len(leaves)} leaves, checkpoint has "
+            f"{len(meta['leaf_paths'])}"
+        )
+    out_leaves = []
+    for i, tmpl in enumerate(leaves):
+        arr = read_leaf(ckpt_dir, meta, i)
+        if isinstance(tmpl, jax.Array):
+            if tuple(arr.shape) != tuple(tmpl.shape):
+                raise ValueError(
+                    f"leaf {meta['leaf_paths'][i]}: shape {arr.shape} != "
+                    f"template {tmpl.shape}"
+                )
+            out_leaves.append(jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding))
+        else:
+            out_leaves.append(np.asarray(arr, dtype=getattr(tmpl, "dtype", None)))
+    return jtu.tree_unflatten(treedef, out_leaves)

@@ -1,0 +1,393 @@
+"""Async call machinery: AsyncRequest / callers / AsyncCallsQueue.
+
+Capability parity with ``checkpointing/async_ckpt/core.py`` (1054 LoC):
+
+- :class:`AsyncRequest` — (async_fn, args, preload_fn, finalize_fns, call_idx)
+  (reference ``core.py:120``).
+- :class:`TemporalAsyncCaller` — process-per-save (reference ``:308``).
+- :class:`PersistentAsyncCaller` — one long-lived spawned worker fed through
+  queues, kept at low scheduling priority (reference ``:41-117`` uses
+  nice/ionice; we renice in the worker).
+- :class:`AsyncCallsQueue` — facade the trainer uses: ``schedule_async_request``
+  then ``maybe_finalize_async_calls`` each step (reference ``:849``).
+- Global completion consensus: every rank reports per-call done/alive state
+  and finalization runs only once ALL ranks finished a call, with matching
+  call_idx validation (reference all_reduce ``:279-291`` and ``:188-215``);
+  here the reduction is a KV-store gather over DCN (device collectives stay
+  free for training), pluggable via ``sync_fn``.
+
+The preload (D2H staging) happens in the **trainer** process before the
+worker is involved — JAX arrays never cross the process boundary; only shm
+names and numpy metadata do (see ``staging.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...utils.logging import get_logger
+from ...utils.profiling import ProfilingEvent, record_event
+
+log = get_logger("async_ckpt")
+
+
+@dataclasses.dataclass
+class AsyncRequest:
+    """A scheduled async checkpoint save.
+
+    ``async_fn(*async_fn_args)`` runs in the background worker process; its
+    args must be picklable (shm handles, paths — not jax arrays).
+    ``preload_fn()`` runs synchronously in the trainer right before
+    scheduling (D2H staging). ``finalize_fns`` run in the trainer once ALL
+    ranks' async_fn completed (metadata commit). ``cleanup_fns`` run on both
+    success and failure (releasing staged shm must happen even when the write
+    dies, or every failed save leaks a checkpoint-sized tmpfs segment).
+    """
+
+    async_fn: Optional[Callable]
+    async_fn_args: Tuple = ()
+    preload_fn: Optional[Callable] = None
+    finalize_fns: Sequence[Callable] = ()
+    cleanup_fns: Sequence[Callable] = ()
+    call_idx: int = 0
+
+    def execute_sync(self) -> None:
+        if self.preload_fn is not None:
+            self.preload_fn()
+        try:
+            if self.async_fn is not None:
+                self.async_fn(*self.async_fn_args)
+            for fn in self.finalize_fns:
+                fn()
+        finally:
+            self.run_cleanup()
+
+    def run_cleanup(self) -> None:
+        for fn in self.cleanup_fns:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                log.exception("checkpoint cleanup fn failed")
+
+
+class _PipeWorker:
+    """One worker subprocess speaking the worker_main pickle-frame protocol.
+
+    Deliberately a plain subprocess, not multiprocessing spawn: mp-spawn
+    re-imports the parent's ``__main__``, which crashes in any user script
+    lacking the ``__main__`` guard — unacceptable for a sidecar library."""
+
+    _U32 = struct.Struct("<I")
+
+    def __init__(self):
+        env = dict(os.environ)
+        # propagate the parent's import paths so pickled-by-reference fns
+        # from any importable module resolve in the worker
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] + [env.get("PYTHONPATH", "")]
+        ).rstrip(os.pathsep)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_resiliency.checkpointing.async_ckpt.worker_main"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env=env,
+            # stderr inherited: worker tracebacks surface in trainer logs
+            start_new_session=False,
+        )
+        self.results: Dict[int, Tuple[Optional[str], float]] = {}
+        self._cv = threading.Condition()
+        self._reader = threading.Thread(
+            target=self._read_loop, name="tpurx-ckpt-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        stream = self.proc.stdout
+        while True:
+            hdr = stream.read(4)
+            if len(hdr) < 4:
+                break
+            (n,) = self._U32.unpack(hdr)
+            raw = stream.read(n)
+            if len(raw) < n:
+                break
+            call_idx, err, dur = pickle.loads(raw)
+            with self._cv:
+                self.results[call_idx] = (err, dur)
+                self._cv.notify_all()
+        with self._cv:
+            self._cv.notify_all()
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def submit(self, call_idx: int, fn: Callable, args: Tuple) -> None:
+        raw = pickle.dumps((call_idx, fn, args))
+        self.proc.stdin.write(self._U32.pack(len(raw)) + raw)
+        self.proc.stdin.flush()
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        try:
+            raw = pickle.dumps(None)
+            self.proc.stdin.write(self._U32.pack(len(raw)) + raw)
+            self.proc.stdin.flush()
+        except (BrokenPipeError, OSError, ValueError):
+            pass
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def kill(self) -> None:
+        if self.alive:
+            self.proc.kill()
+            self.proc.wait()
+
+
+class PersistentAsyncCaller:
+    """Long-lived writer worker (reference ``core.py:380+``)."""
+
+    def __init__(self):
+        self._worker: Optional[_PipeWorker] = None
+        self._inflight: Dict[int, bool] = {}
+        self._failed: Dict[int, str] = {}
+
+    def _ensure_worker(self) -> _PipeWorker:
+        if self._worker is None or not self._worker.alive:
+            self._worker = _PipeWorker()
+        return self._worker
+
+    def schedule(self, call_idx: int, fn: Callable, args: Tuple) -> None:
+        worker = self._ensure_worker()
+        self._inflight[call_idx] = True
+        worker.submit(call_idx, fn, args)
+
+    def _collect(self) -> None:
+        if self._worker is None:
+            return
+        with self._worker._cv:
+            done = list(self._worker.results.items())
+            self._worker.results.clear()
+        for call_idx, (err, dur) in done:
+            self._inflight.pop(call_idx, None)
+            if err is not None:
+                self._failed[call_idx] = err
+                log.error("async checkpoint call %s failed: %s", call_idx, err)
+            else:
+                log.debug("async call %s finished in %.2fs", call_idx, dur)
+        if not self._worker.alive and self._inflight:
+            for idx in list(self._inflight):
+                self._failed[idx] = "checkpoint worker died"
+                self._inflight.pop(idx)
+
+    def is_done(self, call_idx: int) -> bool:
+        self._collect()
+        return call_idx not in self._inflight
+
+    def error(self, call_idx: int) -> Optional[str]:
+        return self._failed.get(call_idx)
+
+    def wait(self, call_idx: int, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.is_done(call_idx):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"async call {call_idx} still running")
+            if self._worker is not None:
+                with self._worker._cv:
+                    self._worker._cv.wait(timeout=0.25)
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._worker.shutdown()
+            self._worker = None
+
+    def abort(self) -> None:
+        """Hard-kill the worker (used by in-process restart's Abort path —
+        reference ``inprocess/abort.py:194`` AbortPersistentCheckpointProcesses)."""
+        if self._worker is not None:
+            self._worker.kill()
+            self._worker = None
+        for idx in list(self._inflight):
+            self._failed[idx] = "aborted"
+            self._inflight.pop(idx)
+
+
+class TemporalAsyncCaller:
+    """Process-per-save (reference ``core.py:308``): simpler isolation, pays
+    worker startup per checkpoint.  One _PipeWorker per call, shut down after."""
+
+    def __init__(self):
+        self._workers: Dict[int, _PipeWorker] = {}
+        self._failed: Dict[int, str] = {}
+
+    def schedule(self, call_idx: int, fn: Callable, args: Tuple) -> None:
+        worker = _PipeWorker()
+        worker.submit(call_idx, fn, args)
+        self._workers[call_idx] = worker
+
+    def is_done(self, call_idx: int) -> bool:
+        worker = self._workers.get(call_idx)
+        if worker is None:
+            return True
+        with worker._cv:
+            if call_idx in worker.results:
+                err, _ = worker.results.pop(call_idx)
+                if err is not None:
+                    self._failed[call_idx] = err
+                worker.shutdown(timeout=5)
+                del self._workers[call_idx]
+                return True
+        if not worker.alive:
+            self._failed[call_idx] = f"worker exitcode {worker.proc.returncode}"
+            del self._workers[call_idx]
+            return True
+        return False
+
+    def error(self, call_idx: int) -> Optional[str]:
+        return self._failed.get(call_idx)
+
+    def wait(self, call_idx: int, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self.is_done(call_idx):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"async call {call_idx} still running")
+            time.sleep(0.05)
+
+    def close(self) -> None:
+        for worker in list(self._workers.values()):
+            worker.shutdown()
+        self._workers.clear()
+
+    def abort(self) -> None:
+        for worker in self._workers.values():
+            worker.kill()
+        self._workers.clear()
+
+
+class AsyncCallsQueue:
+    """Trainer-facing facade (reference ``core.py:849``).
+
+    ``sync_fn(call_idx, locally_done) -> globally_done`` implements the
+    cross-rank consensus; default is local-only (single process).  Use
+    :func:`store_sync_fn` for the DCN KV-store consensus.
+    """
+
+    def __init__(self, persistent: bool = True, sync_fn: Optional[Callable] = None):
+        self.caller = PersistentAsyncCaller() if persistent else TemporalAsyncCaller()
+        self.sync_fn = sync_fn or (lambda call_idx, done: done)
+        self._call_idx = 0
+        self._pending: List[AsyncRequest] = []
+
+    def schedule_async_request(self, req: AsyncRequest) -> int:
+        self._call_idx += 1
+        req = dataclasses.replace(req, call_idx=self._call_idx)
+        record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, call_idx=req.call_idx)
+        try:
+            if req.preload_fn is not None:
+                req.preload_fn()
+            self.caller.schedule(req.call_idx, req.async_fn, req.async_fn_args)
+        except BaseException:
+            # scheduling failed: staged shm must still be released
+            req.run_cleanup()
+            raise
+        self._pending.append(req)
+        return req.call_idx
+
+    @property
+    def num_unfinalized_calls(self) -> int:
+        return len(self._pending)
+
+    def maybe_finalize_async_calls(self, blocking: bool = False, timeout: float = 600.0) -> List[int]:
+        """Finalize (in order) every pending call that is globally done.
+        Returns finalized call indices.  With ``blocking``, the timeout bounds
+        the WHOLE wait including cross-rank consensus — a dead peer surfaces
+        as TimeoutError instead of an infinite loop."""
+        finalized = []
+        deadline = time.monotonic() + timeout
+        while self._pending:
+            req = self._pending[0]
+            if blocking:
+                self.caller.wait(
+                    req.call_idx, timeout=max(0.0, deadline - time.monotonic())
+                )
+            locally_done = self.caller.is_done(req.call_idx)
+            err = self.caller.error(req.call_idx)
+            if err is not None:
+                self._pending.pop(0)
+                req.run_cleanup()
+                raise CheckpointSaveError(f"async call {req.call_idx}: {err}")
+            globally_done = self.sync_fn(req.call_idx, locally_done)
+            if not globally_done:
+                if not blocking:
+                    break
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"async call {req.call_idx}: global consensus not "
+                        f"reached within {timeout}s (peer rank dead?)"
+                    )
+                time.sleep(0.05)
+                continue
+            try:
+                for fn in req.finalize_fns:
+                    fn()
+            finally:
+                req.run_cleanup()
+            record_event(ProfilingEvent.CHECKPOINT_SAVE_FINALIZED, call_idx=req.call_idx)
+            self._pending.pop(0)
+            finalized.append(req.call_idx)
+        return finalized
+
+    def close(self) -> None:
+        self.maybe_finalize_async_calls(blocking=True)
+        self.caller.close()
+
+    def abort(self) -> None:
+        self.caller.abort()
+        for req in self._pending:
+            req.run_cleanup()
+        self._pending.clear()
+
+
+class CheckpointSaveError(RuntimeError):
+    pass
+
+
+def store_sync_fn(store, rank: int, world_size: int, namespace: Optional[str] = None):
+    """Cross-rank completion consensus over the KV store.
+
+    Each rank publishes its progress as a monotonic "highest locally-done
+    call_idx" key; a call is globally done when every rank's published idx is
+    >= it.  One store write per state change + world_size reads per check —
+    no device collectives, so consensus never perturbs the training program
+    (the reference burns an NCCL all_reduce per check, ``core.py:279-291``).
+
+    The namespace defaults to being fenced by the restart cycle
+    (``TPURX_CYCLE``): call indices reset on restart, and stale done_idx keys
+    from a previous incarnation must never vouch for new calls.
+    """
+    if namespace is None:
+        namespace = f"ckpt/c{os.environ.get('TPURX_CYCLE', '0')}"
+
+    def sync(call_idx: int, locally_done: bool) -> bool:
+        key = f"{namespace}/done_idx/{rank}"
+        if locally_done:
+            store.set(key, str(call_idx))
+        else:
+            return False
+        for r in range(world_size):
+            raw = store.try_get(f"{namespace}/done_idx/{r}")
+            if raw is None or int(raw) < call_idx:
+                return False
+        return True
+
+    return sync

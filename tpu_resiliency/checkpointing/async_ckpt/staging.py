@@ -1,0 +1,184 @@
+"""D2H staging of JAX pytrees into shared memory.
+
+The TPU replacement for the reference's CUDA-stream preload
+(``async_ckpt/filesystem_async.py:230-330``): every ``jax.Array`` leaf starts
+a non-blocking device→host copy (``copy_to_host_async`` on each addressable
+shard), then shards are materialized straight into POSIX shared-memory
+buffers.  The training step only pays for the D2H DMA + one memcpy into shm;
+file writes happen in the worker process reading the same shm — zero copies
+across the process boundary.
+
+A leaf can be a replicated or sharded global array: we stage only
+**addressable** shards and record their global index, so multi-host saves
+write disjoint data per process (process 0 additionally owns fully-replicated
+leaves to avoid N identical writes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils.logging import get_logger
+
+log = get_logger("ckpt.staging")
+
+try:
+    import jax
+
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover
+    _HAVE_JAX = False
+
+
+@dataclasses.dataclass
+class ShardInfo:
+    leaf_idx: int
+    shard_idx: int
+    global_shape: Tuple[int, ...]
+    index: Tuple[Tuple[int, int], ...]   # (start, stop) per dim in the global array
+    dtype: str
+    shm_name: str
+    nbytes: int
+    replica_owner: bool                   # False -> another process owns this data
+
+
+@dataclasses.dataclass
+class StagedTree:
+    treedef_repr: str
+    leaf_paths: List[str]
+    shards: List[ShardInfo]
+    _shms: List[shared_memory.SharedMemory] = dataclasses.field(default_factory=list)
+
+    def close(self, unlink: bool = True) -> None:
+        for shm in self._shms:
+            try:
+                shm.close()
+                if unlink:
+                    shm.unlink()
+            except FileNotFoundError:
+                pass
+        self._shms.clear()
+
+
+def _leaf_paths(tree: Any) -> Tuple[Any, List[str], List[Any]]:
+    import jax.tree_util as jtu
+
+    leaves_with_paths, treedef = jtu.tree_flatten_with_path(tree)
+    paths = [jtu.keystr(path) for path, _ in leaves_with_paths]
+    leaves = [leaf for _, leaf in leaves_with_paths]
+    return treedef, paths, leaves
+
+
+def _shard_index(shard, global_shape) -> Tuple[Tuple[int, int], ...]:
+    out = []
+    for dim, sl in enumerate(shard.index):
+        start = sl.start if sl.start is not None else 0
+        stop = sl.stop if sl.stop is not None else global_shape[dim]
+        out.append((int(start), int(stop)))
+    return tuple(out)
+
+
+def stage_pytree(tree: Any, process_index: Optional[int] = None) -> StagedTree:
+    """Stage all array leaves into shared memory.  Scalars / numpy leaves are
+    staged too (uniform handling keeps the writer simple)."""
+    treedef, paths, leaves = _leaf_paths(tree)
+    staged = StagedTree(treedef_repr=str(treedef), leaf_paths=paths, shards=[])
+    pidx = process_index
+    if pidx is None:
+        pidx = jax.process_index() if _HAVE_JAX else 0
+
+    def _owner(leaf, shard) -> bool:
+        # One replica owner per distinct shard; fully-replicated leaves are
+        # written by process 0 only (avoids N identical writes).
+        replicated = getattr(leaf.sharding, "is_fully_replicated", False)
+        if replicated:
+            return pidx == 0 and shard.replica_id == 0
+        return shard.replica_id == 0
+
+    # Phase 1: kick off async D2H for OWNED shards only (non-owned data is
+    # never written, so paying device bandwidth + host RAM for it would be
+    # pure waste), overlapping the DMA of every owned array.
+    for leaf in leaves:
+        if _HAVE_JAX and isinstance(leaf, jax.Array):
+            for shard in leaf.addressable_shards:
+                if _owner(leaf, shard):
+                    shard.data.copy_to_host_async()
+
+    # Phase 2: materialize owned shards into shm; record non-owned shards as
+    # metadata-only entries.
+    for i, leaf in enumerate(leaves):
+        if _HAVE_JAX and isinstance(leaf, jax.Array):
+            global_shape = tuple(leaf.shape)
+            for j, shard in enumerate(leaf.addressable_shards):
+                owner = _owner(leaf, shard)
+                index = _shard_index(shard, global_shape)
+                if owner:
+                    arr = np.asarray(shard.data)  # completes the async copy
+                    _stage_ndarray(staged, arr, i, j, global_shape, index, True)
+                else:
+                    shape = tuple(b - a for a, b in index)
+                    staged.shards.append(
+                        ShardInfo(
+                            leaf_idx=i, shard_idx=j, global_shape=global_shape,
+                            index=index, dtype=str(shard.data.dtype),
+                            shm_name="", nbytes=0, replica_owner=False,
+                        )
+                    )
+        else:
+            arr = np.asarray(leaf)
+            _stage_ndarray(
+                staged, arr, i, 0, tuple(arr.shape),
+                tuple((0, s) for s in arr.shape), pidx == 0,
+            )
+    return staged
+
+
+def _stage_ndarray(
+    staged: StagedTree,
+    arr: np.ndarray,
+    leaf_idx: int,
+    shard_idx: int,
+    global_shape: Tuple[int, ...],
+    index: Tuple[Tuple[int, int], ...],
+    owner: bool,
+) -> ShardInfo:
+    nbytes = max(1, arr.nbytes)
+    shm_name = ""
+    if owner:
+        shm = shared_memory.SharedMemory(create=True, size=nbytes)
+        dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
+        np.copyto(dst, arr, casting="no")
+        staged._shms.append(shm)
+        shm_name = shm.name
+    info = ShardInfo(
+        leaf_idx=leaf_idx,
+        shard_idx=shard_idx,
+        global_shape=global_shape,
+        index=index,
+        dtype=str(arr.dtype),
+        shm_name=shm_name,
+        nbytes=nbytes,
+        replica_owner=owner,
+    )
+    staged.shards.append(info)
+    return info
+
+
+def shard_payload(info: ShardInfo) -> Dict[str, Any]:
+    """Picklable description handed to the writer process."""
+    shape = tuple(b - a for a, b in info.index)
+    return {
+        "leaf_idx": info.leaf_idx,
+        "shard_idx": info.shard_idx,
+        "global_shape": list(info.global_shape),
+        "index": [list(p) for p in info.index],
+        "dtype": info.dtype,
+        "shm_name": info.shm_name,
+        "shape": list(shape),
+        "nbytes": info.nbytes,
+    }

@@ -1,0 +1,77 @@
+"""Checkpoint writer worker process entrypoint.
+
+Runs as ``python -m tpu_resiliency.checkpointing.async_ckpt.worker_main`` —
+a plain subprocess, NOT a multiprocessing spawn child.  mp-spawn re-imports
+the parent's ``__main__`` module, which detonates in any user training script
+lacking the ``if __name__ == "__main__"`` guard; a training-resiliency
+library must not crash user jobs over that.  (The reference inherits this
+footgun from mp.spawn, ``core.py:482-515``; this design removes it.)
+
+Protocol over stdin/stdout pipes: u32-length-prefixed pickle frames.
+Request: (call_idx, fn, args) — fn must be importable (not defined in the
+user's __main__).  Response: (call_idx, error_str_or_None, duration_s).
+Pickle is acceptable here: the pipe is a private fd pair with our own parent,
+not a network surface.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import sys
+import time
+
+_U32 = struct.Struct("<I")
+
+
+def _read_exact(stream, n: int):
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def main() -> None:
+    # The writer only touches numpy+shm, but imports can pull in jax — this
+    # process must never claim TPU chips from the trainer.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        os.nice(int(os.environ.get("TPURX_CKPT_WORKER_NICE", "10")))
+    except OSError:
+        pass
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    # anything the written fns print must not corrupt the response stream
+    sys.stdout = sys.stderr
+    while True:
+        hdr = _read_exact(stdin, 4)
+        if hdr is None:
+            return
+        (n,) = _U32.unpack(hdr)
+        raw = _read_exact(stdin, n)
+        if raw is None:
+            return
+        req = pickle.loads(raw)
+        if req is None:
+            return
+        call_idx, fn, args = req
+        t0 = time.monotonic()
+        try:
+            fn(*args)
+            resp = (call_idx, None, time.monotonic() - t0)
+        except BaseException as exc:  # noqa: BLE001 - report to trainer
+            resp = (call_idx, f"{type(exc).__name__}: {exc}", time.monotonic() - t0)
+        out = pickle.dumps(resp)
+        try:
+            stdout.write(_U32.pack(len(out)) + out)
+            stdout.flush()
+        except BrokenPipeError:
+            return  # trainer died; nothing to report to
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+"""Sharded checkpoint on-disk format + worker-side writer.
+
+Reference analog: ``FileSystemWriterAsync`` (``filesystem_async.py:154``)
+minus torch DCP.  Layout:
+
+    <ckpt_dir>/
+      process_<p>/shard_<leaf>_<k>.npy     per owned shard, numpy .npy format
+      process_<p>.json                     per-process shard index ("commit")
+      metadata.json                        global metadata — the atomic commit
+                                           marker, written at finalize by the
+                                           coordinating rank
+
+A checkpoint is valid iff ``metadata.json`` exists (written via temp-file +
+rename).  The writer runs in the background worker process and reads staged
+data from shared memory by name — nothing heavy crosses the queue.
+
+Large shards are split across ``num_threads`` concurrent file writes bucketed
+by size (reference ``_split_by_size_and_type``, ``filesystem_async.py:1318``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def shard_filename(leaf_idx: int, shard_idx: int) -> str:
+    return f"shard_{leaf_idx}_{shard_idx}.npy"
+
+
+def write_process_shards(
+    ckpt_dir: str,
+    process_index: int,
+    payloads: List[Dict[str, Any]],
+    num_threads: int = 4,
+    save_id: str = "default",
+) -> None:
+    """Worker-process entry: write every owned shard from shm, then the
+    per-process index file (its atomic rename is the per-process commit)."""
+    pdir = os.path.join(ckpt_dir, f"process_{process_index}")
+    os.makedirs(pdir, exist_ok=True)
+    owned = [p for p in payloads if p["shm_name"]]
+
+    # bucket by size: big shards first so threads stay busy
+    owned.sort(key=lambda p: -p["nbytes"])
+
+    def _write(payload: Dict[str, Any]) -> None:
+        shm = shared_memory.SharedMemory(name=payload["shm_name"])
+        try:
+            arr = np.ndarray(
+                tuple(payload["shape"]), dtype=np.dtype(payload["dtype"]), buffer=shm.buf
+            )
+            path = os.path.join(pdir, shard_filename(payload["leaf_idx"], payload["shard_idx"]))
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.save(f, arr)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            shm.close()
+
+    if owned:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=num_threads) as pool:
+            list(pool.map(_write, owned))
+
+    index = {
+        "process_index": process_index,
+        "save_id": save_id,
+        "shards": [
+            {k: v for k, v in p.items() if k != "shm_name"} for p in owned
+        ],
+    }
+    idx_path = os.path.join(ckpt_dir, f"process_{process_index}.json")
+    tmp = idx_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, idx_path)
+
+
+def write_metadata(
+    ckpt_dir: str,
+    treedef_repr: str,
+    leaf_paths: List[str],
+    all_shards: List[Dict[str, Any]],
+    num_processes: int,
+    extra: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Finalize: the atomic global commit marker."""
+    meta = {
+        "format": "tpurx-ckpt-v1",
+        "treedef": treedef_repr,
+        "leaf_paths": leaf_paths,
+        "num_processes": num_processes,
+        "shards": all_shards,
+        **(extra or {}),
+    }
+    path = os.path.join(ckpt_dir, "metadata.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def is_committed(ckpt_dir: str) -> bool:
+    return os.path.exists(os.path.join(ckpt_dir, "metadata.json"))
+
+
+def read_metadata(ckpt_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(ckpt_dir, "metadata.json")) as f:
+        return json.load(f)
+
+
+def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
+    """Assemble a full global array for one leaf from its shards."""
+    shards = [s for s in meta["shards"] if s["leaf_idx"] == leaf_idx]
+    if not shards:
+        raise KeyError(f"leaf {leaf_idx} has no shards in checkpoint")
+    global_shape = tuple(shards[0]["global_shape"])
+    dtype = np.dtype(shards[0]["dtype"])
+    out = np.empty(global_shape, dtype=dtype)
+    covered = np.zeros(global_shape, dtype=bool) if global_shape else None
+    for s in shards:
+        pdir = os.path.join(ckpt_dir, f"process_{s['process_index']}")
+        arr = np.load(os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])))
+        slices = tuple(slice(a, b) for a, b in s["index"])
+        out[slices] = arr
+        if covered is not None:
+            covered[slices] = True
+    if covered is not None and not covered.all():
+        raise ValueError(
+            f"leaf {leaf_idx}: shards cover only "
+            f"{covered.sum()}/{covered.size} elements"
+        )
+    return out

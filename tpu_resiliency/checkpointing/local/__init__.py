@@ -1,0 +1,1 @@
+"""Node-local checkpointing with peer replication (reference: ``checkpointing/local/``)."""

@@ -1,0 +1,284 @@
+"""Node-local checkpoint manager.
+
+Capability parity with ``BaseCheckpointManager`` / ``LocalCheckpointManager``
+(``checkpointing/local/ckpt_managers/base_manager.py:39-317``,
+``local_manager.py:39``):
+
+- ckpt_id = (iteration, data_rank); blobs live on node-local SSD/ramdisk.
+- ``save``: serialize → clique-replicate over DCN → write own + replica blobs
+  (optionally via the async queue) → publish holdings.
+- ``find_latest``: gather every rank's holdings via the store and pick the
+  highest iteration where the union of holders covers ALL ranks (reference
+  ``find_latest`` ``:156-203``).
+- ``load``: local blob if present, else a deterministic exchange plan elects
+  one holder per missing rank and peers push blobs over TCP (reference
+  retrieval plan + P2P exchange ``:205-234``).
+
+File layout: <root>/iter_<I>/rank_<R>.tpurx (+ .done marker per blob).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...store.barrier import barrier
+from ...utils.logging import get_logger
+from ...utils.profiling import ProfilingEvent, record_event
+from .replication import CliqueReplication
+from .state_dict import TensorAwareTree
+
+log = get_logger("local_ckpt")
+
+_ITER_RE = re.compile(r"^iter_(\d+)$")
+
+
+class LocalCheckpointManager:
+    def __init__(
+        self,
+        root_dir: str,
+        rank: int,
+        world_size: int,
+        store=None,
+        replication: Optional[CliqueReplication] = None,
+        keep_last: int = 2,
+        session: str = "default",
+    ):
+        self.root = os.path.join(root_dir, session)
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.replication = replication
+        self.keep_last = keep_last
+        os.makedirs(self.root, exist_ok=True)
+        self._bg: Optional[threading.Thread] = None
+        self._bg_error: Optional[BaseException] = None
+        # find_latest/load are collective: every rank calls them in lockstep;
+        # generation counters keep their barrier keys unique per invocation
+        self._find_gen = 0
+        self._load_gen = 0
+
+    # -- paths -------------------------------------------------------------
+
+    def _iter_dir(self, iteration: int) -> str:
+        return os.path.join(self.root, f"iter_{iteration}")
+
+    def _blob_path(self, iteration: int, data_rank: int) -> str:
+        return os.path.join(self._iter_dir(iteration), f"rank_{data_rank}.tpurx")
+
+    def _holdings(self) -> Dict[int, List[int]]:
+        """{iteration: [data_ranks held locally]} — only committed blobs."""
+        out: Dict[int, List[int]] = {}
+        if not os.path.isdir(self.root):
+            return out
+        for name in os.listdir(self.root):
+            m = _ITER_RE.match(name)
+            if not m:
+                continue
+            iteration = int(m.group(1))
+            d = os.path.join(self.root, name)
+            ranks = [
+                int(f[len("rank_"):-len(".tpurx")])
+                for f in os.listdir(d)
+                if f.startswith("rank_") and f.endswith(".tpurx")
+                and os.path.exists(os.path.join(d, f) + ".done")
+            ]
+            if ranks:
+                out[iteration] = sorted(ranks)
+        return out
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, tree, iteration: int, is_async: bool = True) -> None:
+        """Serialize + replicate + write.  With ``is_async`` the file writes
+        and holdings publication happen on a background thread; replication
+        (DCN-bound, needs all ranks) stays synchronous."""
+        record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, kind="local", iteration=iteration)
+        tat = TensorAwareTree.from_tree(tree, to_host=True)
+        blob = tat.to_bytes()
+        if self.replication is not None:
+            blobs = self.replication.replicate(blob, tag=iteration & 0x3FFFFFFF)
+        else:
+            blobs = {self.rank: blob}
+
+        def _write_and_publish():
+            d = self._iter_dir(iteration)
+            os.makedirs(d, exist_ok=True)
+            for data_rank, data in blobs.items():
+                path = self._blob_path(iteration, data_rank)
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                with open(path + ".done", "w") as f:
+                    f.write("ok")
+            self._publish_holdings()
+            self._cleanup()
+            record_event(
+                ProfilingEvent.CHECKPOINT_SAVE_FINALIZED, kind="local", iteration=iteration
+            )
+
+        if is_async:
+            self.wait()
+
+            def _bg_main():
+                try:
+                    _write_and_publish()
+                except BaseException as exc:  # noqa: BLE001 - surfaced in wait()
+                    log.exception("async local save failed (iteration %s)", iteration)
+                    self._bg_error = exc
+
+            self._bg = threading.Thread(target=_bg_main, daemon=True)
+            self._bg.start()
+        else:
+            _write_and_publish()
+
+    def wait(self) -> None:
+        """Join the background save; raises if it failed (a silently-lost
+        local checkpoint would defeat the fast-recovery path)."""
+        if self._bg is not None:
+            self._bg.join()
+            self._bg = None
+        if self._bg_error is not None:
+            err, self._bg_error = self._bg_error, None
+            raise RuntimeError(f"async local checkpoint save failed: {err}") from err
+
+    def _publish_holdings(self) -> None:
+        if self.store is None:
+            return
+        holdings = {str(k): v for k, v in self._holdings().items()}
+        self.store.set(f"localckpt/holdings/{self.rank}", json.dumps(holdings))
+
+    def _cleanup(self) -> None:
+        iters = sorted(self._holdings())
+        for old in iters[: max(0, len(iters) - self.keep_last)]:
+            shutil.rmtree(self._iter_dir(old), ignore_errors=True)
+        # holdings changed
+        self._publish_holdings()
+
+    # -- find_latest -------------------------------------------------------
+
+    def find_latest(self, gather_timeout: float = 60.0) -> Optional[int]:
+        """Highest iteration whose union of holders covers every rank."""
+        self.wait()
+        if self.store is None or self.world_size == 1:
+            local = self._holdings()
+            mine = [
+                it for it, ranks in local.items() if set(range(self.world_size)) <= set(ranks)
+            ]
+            return max(mine) if mine else None
+        self._publish_holdings()
+        gen = self._find_gen
+        self._find_gen += 1
+        barrier(
+            self.store, f"localckpt/find_latest/{gen}",
+            self.world_size, timeout=gather_timeout,
+        )
+        coverage: Dict[int, Set[int]] = {}
+        for r in range(self.world_size):
+            raw = self.store.try_get(f"localckpt/holdings/{r}")
+            if raw is None:
+                continue
+            for it_s, data_ranks in json.loads(raw).items():
+                coverage.setdefault(int(it_s), set()).update(data_ranks)
+        full = [
+            it for it, ranks in coverage.items() if set(range(self.world_size)) <= ranks
+        ]
+        return max(full) if full else None
+
+    # -- load --------------------------------------------------------------
+
+    def _exchange_plan(
+        self, iteration: int, all_holdings: Dict[int, Dict[int, List[int]]]
+    ) -> Tuple[List[Tuple[int, int]], Optional[int]]:
+        """Deterministic sender election (reference sender election
+        ``strategies.py:142-179``).  Returns (my_sends as (to_rank, data_rank)
+        list, my_source holder rank or None if local)."""
+        my_sends: List[Tuple[int, int]] = []
+        my_source: Optional[int] = None
+        for r in range(self.world_size):
+            holders = sorted(
+                h
+                for h, holds in all_holdings.items()
+                if r in holds.get(iteration, [])
+            )
+            if not holders:
+                raise FileNotFoundError(
+                    f"iteration {iteration}: no holder for rank {r}'s data"
+                )
+            if r in holders:
+                source = None  # r has its own data
+            else:
+                source = holders[0]
+            if r == self.rank:
+                my_source = source
+            if source == self.rank:
+                my_sends.append((r, r))
+        return my_sends, my_source
+
+    def load(self, template, iteration: Optional[int] = None):
+        """Load (iteration or latest). Returns (tree, iteration)."""
+        record_event(ProfilingEvent.CHECKPOINT_LOAD_STARTED, kind="local")
+        if iteration is None:
+            iteration = self.find_latest()
+            if iteration is None:
+                raise FileNotFoundError("no fully-covered local checkpoint")
+        path = self._blob_path(iteration, self.rank)
+        blob: Optional[bytes] = None
+        if os.path.exists(path) and os.path.exists(path + ".done"):
+            with open(path, "rb") as f:
+                blob = f.read()
+        if blob is None:
+            blob = self._retrieve_from_peers(iteration)
+        elif self.store is not None and self.replication is not None:
+            # still participate in the exchange plan as a sender
+            self._retrieve_from_peers(iteration, have_own=True)
+        tat = TensorAwareTree.from_bytes(blob)
+        tree = tat.to_tree_like(template)
+        record_event(
+            ProfilingEvent.CHECKPOINT_LOAD_COMPLETED, kind="local", iteration=iteration
+        )
+        return tree, iteration
+
+    def _retrieve_from_peers(self, iteration: int, have_own: bool = False) -> Optional[bytes]:
+        if self.store is None or self.replication is None:
+            raise FileNotFoundError(
+                f"rank {self.rank}: no local blob for iteration {iteration} "
+                "and no replication configured"
+            )
+        # Republish holdings and fence: a rank restored on a fresh node must
+        # not be elected to serve blobs it no longer has (stale store state).
+        self._publish_holdings()
+        gen = self._load_gen
+        self._load_gen += 1
+        barrier(
+            self.store, f"localckpt/load/{gen}", self.world_size, timeout=120.0
+        )
+        all_holdings: Dict[int, Dict[int, List[int]]] = {}
+        for r in range(self.world_size):
+            raw = self.store.try_get(f"localckpt/holdings/{r}")
+            holdings = json.loads(raw) if raw else {}
+            all_holdings[r] = {int(k): v for k, v in holdings.items()}
+        my_sends, my_source = self._exchange_plan(iteration, all_holdings)
+        sends = []
+        for to_rank, data_rank in my_sends:
+            with open(self._blob_path(iteration, data_rank), "rb") as f:
+                sends.append((to_rank, (iteration & 0x3FFFFFF) | 0x4000000, f.read()))
+        recvs = []
+        if not have_own and my_source is not None:
+            recvs.append((my_source, (iteration & 0x3FFFFFF) | 0x4000000))
+        received = self.replication.execute_plan(sends, recvs)
+        if not have_own and my_source is not None:
+            return received[(my_source, (iteration & 0x3FFFFFF) | 0x4000000)]
+        if have_own:
+            return None
+        # my_source None means our own blob should exist — but it didn't
+        raise FileNotFoundError(
+            f"rank {self.rank}: expected local blob for iteration {iteration}"
+        )

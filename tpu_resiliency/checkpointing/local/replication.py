@@ -1,0 +1,211 @@
+"""Clique replication of local checkpoints over DCN.
+
+Capability parity with ``CliqueReplicationStrategy``
+(``checkpointing/local/replication/strategies.py:76-288`` + ``group_utils.py``):
+ranks form cliques of ``replication_factor`` members spaced
+``replication_jump`` apart — the jump matches the failure blast radius, so a
+whole lost TPU host/slice never takes all copies of any rank's state with it.
+
+Transport re-design: the reference all_gathers tensors over NCCL.  Device
+collectives are the training program's resource on TPU, and local-checkpoint
+blobs live on the host — so replication rides a rank↔rank TCP mesh over DCN
+(:class:`PeerExchange`, addresses published in the KV store), leaving ICI
+untouched.  An on-device ICI replication fast path can slot in behind the
+same interface later.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...utils.logging import get_logger
+
+log = get_logger("local_ckpt.replication")
+
+_U64 = struct.Struct("<Q")
+_TAG = struct.Struct("<I")
+
+
+def clique_members(rank: int, world_size: int, factor: int, jump: int = 1) -> List[int]:
+    """Ranks holding replicas of each other's state (includes ``rank``).
+
+    With jump=1: contiguous groups of ``factor``.  With jump=J: group i of a
+    J*F block contains ranks {base + (rank mod J) + k*J}, i.e. members are J
+    apart (different failure domains when J = ranks-per-host/slice).
+    """
+    if factor <= 1:
+        return [rank]
+    block = factor * jump
+    base = (rank // block) * block
+    lane = (rank - base) % jump
+    members = [base + lane + k * jump for k in range(factor)]
+    return [m for m in members if m < world_size]
+
+
+class PeerExchange:
+    """Tagged blob exchange between ranks over TCP, discovered via the store."""
+
+    def __init__(self, store, rank: int, namespace: str = "peerx"):
+        self.store = store
+        self.rank = rank
+        self.ns = namespace
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind(("0.0.0.0", 0))
+        self._server.listen(64)
+        self._server.settimeout(0.25)
+        self.port = self._server.getsockname()[1]
+        self._inbox: Dict[Tuple[int, int], bytes] = {}
+        self._inbox_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name=f"tpurx-peerx-{rank}", daemon=True
+        )
+        self._thread.start()
+        host = socket.gethostname()
+        addr = socket.gethostbyname(host) if host else "127.0.0.1"
+        self.store.set(f"{self.ns}/addr/{rank}", f"{addr}:{self.port}")
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(60.0)
+            hdr = self._recv_exact(conn, 16)
+            if hdr is None:
+                return
+            (sender,) = _U64.unpack(hdr[:8])
+            (n,) = _U64.unpack(hdr[8:])
+            tag_raw = self._recv_exact(conn, 4)
+            (tag,) = _TAG.unpack(tag_raw)
+            payload = self._recv_exact(conn, n)
+            if payload is None:
+                return
+            with self._inbox_cv:
+                self._inbox[(int(sender), int(tag))] = payload
+                self._inbox_cv.notify_all()
+        except (OSError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(min(1 << 20, n - len(buf)))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _peer_addr(self, rank: int, timeout: float = 30.0) -> Tuple[str, int]:
+        raw = self.store.get(f"{self.ns}/addr/{rank}", timeout=timeout)
+        host, _, port = raw.decode().rpartition(":")
+        return host, int(port)
+
+    def send(self, to_rank: int, tag: int, payload: bytes, timeout: float = 60.0) -> None:
+        host, port = self._peer_addr(to_rank, timeout)
+        with socket.create_connection((host, port), timeout=timeout) as conn:
+            conn.sendall(_U64.pack(self.rank) + _U64.pack(len(payload)) + _TAG.pack(tag))
+            conn.sendall(payload)
+
+    def recv(self, from_rank: int, tag: int, timeout: float = 60.0) -> bytes:
+        deadline = time.monotonic() + timeout
+        with self._inbox_cv:
+            while (from_rank, tag) not in self._inbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"rank {self.rank}: no blob from {from_rank} tag {tag}"
+                    )
+                self._inbox_cv.wait(timeout=min(0.5, remaining))
+            return self._inbox.pop((from_rank, tag))
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+
+
+class CliqueReplication:
+    """Exchange serialized local checkpoints within the clique."""
+
+    def __init__(
+        self,
+        exchange: PeerExchange,
+        world_size: int,
+        replication_factor: int = 2,
+        replication_jump: int = 1,
+    ):
+        self.exchange = exchange
+        self.world_size = world_size
+        self.factor = replication_factor
+        self.jump = replication_jump
+
+    def members(self) -> List[int]:
+        return clique_members(
+            self.exchange.rank, self.world_size, self.factor, self.jump
+        )
+
+    def replicate(self, blob: bytes, tag: int) -> Dict[int, bytes]:
+        """Send own blob to clique peers; receive theirs.  ``tag`` must be
+        unique per (iteration) — it fences late arrivals from old saves.
+        Returns {rank: blob} including self."""
+        me = self.exchange.rank
+        peers = [m for m in self.members() if m != me]
+        threads = [
+            threading.Thread(
+                target=self.exchange.send, args=(p, tag, blob), daemon=True
+            )
+            for p in peers
+        ]
+        for t in threads:
+            t.start()
+        received = {me: blob}
+        for p in peers:
+            received[p] = self.exchange.recv(p, tag, timeout=120.0)
+        for t in threads:
+            t.join(timeout=120.0)
+        return received
+
+    def execute_plan(
+        self,
+        sends: List[Tuple[int, int, bytes]],
+        recvs: List[Tuple[int, int]],
+        timeout: float = 120.0,
+    ) -> Dict[Tuple[int, int], bytes]:
+        """Run a retrieval exchange plan (reference ``ExchangePlan``,
+        ``group_utils.py``): ``sends`` = (to_rank, tag, blob); ``recvs`` =
+        (from_rank, tag).  Returns received blobs keyed by (from_rank, tag)."""
+        threads = [
+            threading.Thread(
+                target=self.exchange.send, args=(to, tag, blob), daemon=True
+            )
+            for to, tag, blob in sends
+        ]
+        for t in threads:
+            t.start()
+        out = {}
+        for frm, tag in recvs:
+            out[(frm, tag)] = self.exchange.recv(frm, tag, timeout=timeout)
+        for t in threads:
+            t.join(timeout=timeout)
+        return out
