@@ -10,9 +10,9 @@ Save pipeline per request:
                        coordinator merges process indices -> metadata.json
                        (atomic commit), everyone unlinks shm
 
-Plan caching analog (reference ``CheckpointMetadataCache``): the tree
-structure (treedef + leaf paths) of the previous save is remembered; when
-unchanged, validation work is skipped and the same leaf ordering is reused.
+The metadata-read side has a cache (:class:`CachedMetadataReader`, the
+reference's ``CachedMetadataFileSystemReader`` analog); the save side
+recomputes its plan each time — staging is O(bytes), planning is O(leaves).
 """
 
 from __future__ import annotations
@@ -63,7 +63,6 @@ class AsyncCheckpointer:
             except Exception:  # noqa: BLE001
                 process_index = 0
         self.process_index = process_index
-        self._cached_structure: Optional[tuple] = None
 
     # -- save --------------------------------------------------------------
 
@@ -92,9 +91,6 @@ class AsyncCheckpointer:
             if stale and os.path.exists(stale):
                 os.unlink(stale)
         staged = stage_pytree(tree, process_index=self.process_index)
-        structure = (staged.treedef_repr, tuple(staged.leaf_paths))
-        if self._cached_structure != structure:
-            self._cached_structure = structure
         payloads = [shard_payload(s) for s in staged.shards]
 
         finalize_fns: List[Callable] = []

@@ -30,7 +30,7 @@ import numpy as np
 
 
 def shard_filename(leaf_idx: int, shard_idx: int) -> str:
-    return f"shard_{leaf_idx}_{shard_idx}.npy"
+    return f"shard_{leaf_idx}_{shard_idx}.bin"
 
 
 def write_process_shards(
@@ -52,13 +52,14 @@ def write_process_shards(
     def _write(payload: Dict[str, Any]) -> None:
         shm = shared_memory.SharedMemory(name=payload["shm_name"])
         try:
-            arr = np.ndarray(
-                tuple(payload["shape"]), dtype=np.dtype(payload["dtype"]), buffer=shm.buf
-            )
+            # raw bytes, not np.save: non-native dtypes (bfloat16/fp8) would
+            # be written as unloadable void records; shape/dtype live in the
+            # index metadata
+            nbytes = payload["nbytes"]
             path = os.path.join(pdir, shard_filename(payload["leaf_idx"], payload["shard_idx"]))
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
-                np.save(f, arr)
+                f.write(shm.buf[:nbytes])
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
@@ -122,16 +123,19 @@ def read_metadata(ckpt_dir: str) -> Dict[str, Any]:
 
 def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
     """Assemble a full global array for one leaf from its shards."""
+    from ...utils.dtypes import from_bytes, resolve_dtype
+
     shards = [s for s in meta["shards"] if s["leaf_idx"] == leaf_idx]
     if not shards:
         raise KeyError(f"leaf {leaf_idx} has no shards in checkpoint")
     global_shape = tuple(shards[0]["global_shape"])
-    dtype = np.dtype(shards[0]["dtype"])
+    dtype = resolve_dtype(shards[0]["dtype"])
     out = np.empty(global_shape, dtype=dtype)
     covered = np.zeros(global_shape, dtype=bool) if global_shape else None
     for s in shards:
         pdir = os.path.join(ckpt_dir, f"process_{s['process_index']}")
-        arr = np.load(os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])))
+        with open(os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])), "rb") as f:
+            arr = from_bytes(f.read(), s["dtype"], s["shape"])
         slices = tuple(slice(a, b) for a, b in s["index"])
         out[slices] = arr
         if covered is not None:

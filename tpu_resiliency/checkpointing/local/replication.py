@@ -66,9 +66,34 @@ class PeerExchange:
             target=self._serve, name=f"tpurx-peerx-{rank}", daemon=True
         )
         self._thread.start()
-        host = socket.gethostname()
-        addr = socket.gethostbyname(host) if host else "127.0.0.1"
-        self.store.set(f"{self.ns}/addr/{rank}", f"{addr}:{self.port}")
+        self.store.set(f"{self.ns}/addr/{rank}", f"{self._my_addr()}:{self.port}")
+
+    def _my_addr(self) -> str:
+        """The address peers can reach us at.  gethostbyname(hostname) maps to
+        loopback on stock Debian (/etc/hosts 127.0.1.1) — instead take the
+        source address of the route toward the store host, which is exactly
+        the interface peers share with us.  Env TPURX_PEER_ADDR overrides."""
+        import os
+
+        override = os.environ.get("TPURX_PEER_ADDR")
+        if override:
+            return override
+        target = getattr(self.store, "host", None) or getattr(
+            getattr(self.store, "base", None), "host", None
+        )
+        if target and target not in ("127.0.0.1", "localhost", "0.0.0.0"):
+            try:
+                probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                probe.connect((target, 9))  # no traffic; just routes
+                addr = probe.getsockname()[0]
+                probe.close()
+                return addr
+            except OSError:
+                pass
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
 
     def _serve(self) -> None:
         while not self._stop.is_set():
@@ -91,6 +116,8 @@ class PeerExchange:
             (sender,) = _U64.unpack(hdr[:8])
             (n,) = _U64.unpack(hdr[8:])
             tag_raw = self._recv_exact(conn, 4)
+            if tag_raw is None:
+                return
             (tag,) = _TAG.unpack(tag_raw)
             payload = self._recv_exact(conn, n)
             if payload is None:

@@ -25,6 +25,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
+from ...utils.dtypes import resolve_dtype
+
 _MAGIC = b"TPURXLC2"
 _U64 = struct.Struct("<Q")
 
@@ -209,7 +211,7 @@ class TensorAwareTree:
         for shape, dtype in zip(header["array_shapes"], header["array_dtypes"]):
             (n,) = _U64.unpack(view[off : off + 8])
             off += 8
-            arr = np.frombuffer(view[off : off + n], dtype=np.dtype(dtype))
+            arr = np.frombuffer(view[off : off + n], dtype=resolve_dtype(dtype))
             arrays.append(arr.reshape(shape).copy())
             off += n
         return cls(
@@ -228,7 +230,7 @@ def _maybe_whole(meta: LeafMeta, shards) -> Optional[np.ndarray]:
             return arr
     # multiple shards that jointly cover everything (single-host resharded)
     covered = np.zeros(meta.global_shape, dtype=bool)
-    out = np.empty(meta.global_shape, dtype=np.dtype(meta.dtype))
+    out = np.empty(meta.global_shape, dtype=resolve_dtype(meta.dtype))
     for index, arr in shards:
         slices = tuple(slice(a, b) for a, b in index)
         out[slices] = arr

@@ -57,6 +57,9 @@ class _RankState:
     open_sections: Dict[str, float] = dataclasses.field(default_factory=dict)
     last_section_activity: Optional[float] = None
     seen_section_msgs: bool = False
+    # id of the connection that INITed this state: a lingering old worker's
+    # late EOF must not clobber the state of the new cycle's worker
+    owner_conn: Optional[int] = None
 
     def reset(self) -> None:
         self.pid = None
@@ -66,6 +69,7 @@ class _RankState:
         self.open_sections.clear()
         self.last_section_activity = None
         self.seen_section_msgs = False
+        self.owner_conn = None
 
 
 class RankMonitorServer:
@@ -161,7 +165,9 @@ class RankMonitorServer:
 
     # -- message handling --------------------------------------------------
 
-    def _handle_msg(self, msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _handle_msg(
+        self, msg: Dict[str, Any], conn_id: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
         try:
             mtype = MsgType(msg["type"])
         except (ValueError, KeyError):
@@ -174,6 +180,7 @@ class RankMonitorServer:
             st.pid = msg.get("pid")
             st.rank = msg.get("rank")
             st.connected_at = now
+            st.owner_conn = conn_id
             # restore persisted calculated timeouts if client carries them
             if msg.get("hb_timeouts"):
                 restored = heartbeat_timeouts_from_dict(msg["hb_timeouts"])
@@ -217,19 +224,26 @@ class RankMonitorServer:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        conn_id = id(writer)
         try:
             while True:
                 header = await reader.readexactly(4)
                 (ln,) = _U32.unpack(header)
                 raw = await reader.readexactly(ln)
                 msg = json.loads(raw.decode())
-                reply = self._handle_msg(msg)
+                reply = self._handle_msg(msg, conn_id=conn_id)
                 if reply is not None and not msg.get("noack"):
                     out = json.dumps(reply).encode()
                     writer.write(_U32.pack(len(out)) + out)
                     await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):
-            if self.state.connected_at is not None:
+            # Only the connection that INITed the current state may reset it:
+            # a lingering previous worker's late EOF must not disable hang
+            # detection for the new cycle's worker.
+            if (
+                self.state.connected_at is not None
+                and self.state.owner_conn == conn_id
+            ):
                 log.info("rank %s disconnected from monitor", self.state.rank)
                 self.state.reset()
         finally:

@@ -1,0 +1,11 @@
+"""Reference workloads the resiliency layer wraps and benchmarks against."""
+
+from .transformer import TransformerConfig, init_params, forward, loss_fn, make_train_step
+
+__all__ = [
+    "TransformerConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+]

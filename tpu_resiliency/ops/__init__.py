@@ -1,0 +1,5 @@
+"""Device-side kernels for resiliency hot paths."""
+
+from .quorum import QuorumMonitor, quorum_reduce
+
+__all__ = ["QuorumMonitor", "quorum_reduce"]

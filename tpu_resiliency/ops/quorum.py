@@ -1,0 +1,208 @@
+"""On-device ICI quorum heartbeat — the sub-millisecond hang-detection path.
+
+North-star design (BASELINE.json): the reference's hang detection is a
+host-side socket loop with seconds-scale latency (heartbeat timeout check
+interval 5s — ``fault_tolerance/config.py:115-121``).  On TPU the pod's ICI
+fabric itself can carry the liveness signal: every chip contributes a
+monotonically increasing heartbeat stamp, one all-reduce-min over the mesh
+returns the *oldest* stamp anywhere in the pod, and any chip observing
+``now - min_stamp > budget`` knows some rank stalled — one collective
+(~µs over ICI at pod scale), no host round-trips on the hot path.
+
+Two layers:
+
+- :func:`make_quorum_fn` — the jitted collective: per-device stamps →
+  pod-wide min stamp.  The local reduce body is a Pallas kernel on TPU
+  (``_local_min_kernel``) feeding a ``lax.pmin`` over the mesh axis; a
+  pure-jnp fallback covers CPU test meshes.  Identifying WHICH rank is stale
+  happens on the rare stale path via a host gather — keeping the hot path to
+  a single f32 all-reduce (and avoiding int64, which TPUs lack natively).
+- :class:`QuorumMonitor` — host-side driver: publishes this process's stamp,
+  runs the collective on a cadence, reports stale devices.  The host monitor
+  path (RankMonitorServer) remains the source of truth: the kernel can only
+  run while the program can still run collectives, so a wedged chip is
+  detected by the *other* chips observing its stale stamp — and a wedged
+  fabric falls through to the host path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger("quorum")
+
+
+def _on_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+_WRAP = 2 ** 31
+_I32_MAX = 2 ** 31 - 1
+
+
+def now_stamp_ms() -> int:
+    """Wall-clock ms folded into int32 — wall clock so every process shares
+    the epoch (pod hosts are NTP-synced to ~ms, far inside any budget);
+    int32 because f32 lacks ms precision at unix-epoch magnitude and TPUs
+    have no native int64.  Wraps every ~24.8 days; age math is wrap-safe."""
+    return int(time.time() * 1000.0) % _WRAP
+
+
+def stamp_age_ms(now: int, then: int) -> int:
+    return (now - then) % _WRAP
+
+
+def make_local_min(use_pallas: bool) -> Callable:
+    import jax
+    import jax.numpy as jnp
+
+    if not use_pallas:
+        return jnp.min
+
+    from jax.experimental import pallas as pl
+
+    def kernel(stamps_ref, out_ref):
+        # scalar stores to VMEM are rejected; write the (1,1) tile
+        out_ref[:] = jnp.min(stamps_ref[:]).reshape(1, 1)
+
+    def local_min(x):
+        # pad to the int32 min tile (8, 128)
+        n = x.shape[0]
+        pad = (-n) % (8 * 128)
+        x2 = jnp.pad(x, (0, pad), constant_values=_I32_MAX).reshape(-1, 128)
+        rows = x2.shape[0]
+        row_pad = (-rows) % 8
+        x2 = jnp.pad(x2, ((0, row_pad), (0, 0)), constant_values=_I32_MAX)
+        out = pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((1, 1), x.dtype),
+        )(x2)
+        return out[0, 0]
+
+    return local_min
+
+
+def make_quorum_fn(mesh, axis_name: Optional[str] = None, use_pallas: Optional[bool] = None) -> Callable:
+    """Build the jitted quorum collective over ``mesh``.
+
+    Returns fn(stamps_ms: i32[n_total_devices]) -> min_stamp_ms (int).
+    Stamps come from :func:`now_stamp_ms` (shared wall-clock epoch).
+    All processes must call it together (it is a collective)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = axis_name or mesh.axis_names[0]
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    local_min = make_local_min(use_pallas)
+
+    def _body(stamps):
+        return jax.lax.pmin(local_min(stamps), axis)
+
+    smapped = jax.shard_map(
+        _body,
+        mesh=mesh,
+        in_specs=P(axis),
+        out_specs=P(),
+        check_vma=False,  # the pallas local-reduce's out vma is opaque to the checker
+    )
+    sharding = NamedSharding(mesh, P(axis))
+    # single dispatch: jit owns the host->device transfer of the tiny stamp
+    # vector (an explicit device_put would add a round trip per tick)
+    jitted = jax.jit(smapped, in_shardings=sharding)
+    n_total = int(np.prod(mesh.devices.shape))
+
+    def run(stamps_ms) -> int:
+        stamps = np.asarray(stamps_ms, dtype=np.int32).reshape(n_total)
+        return int(jitted(stamps))
+
+    return run
+
+
+class QuorumMonitor:
+    """Host driver for the on-device quorum tripwire.
+
+    The workload calls :meth:`beat` every step (a host int write).  A daemon
+    thread ticks the collective every ``interval`` seconds and calls
+    ``on_stale(age_ms)`` when the pod-wide oldest stamp exceeds
+    ``budget_ms``.  Ticks interleave with training steps on the device
+    stream, so keep ``interval`` ≳ a step time.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        budget_ms: float = 1000.0,
+        interval: float = 0.1,
+        on_stale: Optional[Callable[[float], None]] = None,
+        use_pallas: Optional[bool] = None,
+    ):
+        self.mesh = mesh
+        self.budget_ms = budget_ms
+        self.interval = interval
+        self.on_stale = on_stale or (
+            lambda age: log.error("pod heartbeat stale by %.1fms", age)
+        )
+        self._fn = make_quorum_fn(mesh, use_pallas=use_pallas)
+        self._last_beat_ms = now_stamp_ms()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpurx-quorum", daemon=True
+        )
+        self.last_min_stamp: Optional[int] = None
+
+    def beat(self) -> None:
+        self._last_beat_ms = now_stamp_ms()
+
+    def tick(self) -> Tuple[int, int]:
+        """One collective; returns (min_stamp_ms, age_ms)."""
+        n_total = int(np.prod(self.mesh.devices.shape))
+        stamps = np.full(n_total, self._last_beat_ms, dtype=np.int32)
+        min_stamp = self._fn(stamps)
+        age = stamp_age_ms(now_stamp_ms(), min_stamp)
+        self.last_min_stamp = min_stamp
+        if age > self.budget_ms:
+            self.on_stale(age)
+        return min_stamp, age
+
+    def start(self) -> "QuorumMonitor":
+        self.beat()
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as exc:  # noqa: BLE001
+                log.warning("quorum tick failed: %s", exc)
+                return
+            self._stop.wait(self.interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def quorum_reduce(mesh, stamps_ms) -> float:
+    """One-shot quorum collective (builds + caches the fn per mesh)."""
+    key = id(mesh)
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        fn = make_quorum_fn(mesh)
+        _FN_CACHE[key] = fn
+    return fn(stamps_ms)
+
+
+_FN_CACHE: dict = {}

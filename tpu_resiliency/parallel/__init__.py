@@ -1,0 +1,11 @@
+"""Mesh and collective helpers used by the resiliency layer and workloads."""
+
+from .mesh import make_mesh, mesh_axis_sizes
+from .collectives import device_max_reduce, make_timeouts_reduce_fn
+
+__all__ = [
+    "make_mesh",
+    "mesh_axis_sizes",
+    "device_max_reduce",
+    "make_timeouts_reduce_fn",
+]

@@ -1,0 +1,41 @@
+"""On-device collective helpers for the resiliency layer's tiny syncs.
+
+The reference all-reduces timeout stats over NCCL/Gloo
+(``fault_tolerance/timeouts_calc.py:74-91``).  The TPU fast path gathers each
+process's host-side stats through one tiny device all-gather over ICI/DCN
+(``multihost_utils.process_allgather`` — a (nproc, k) float32 array, one
+collective, microseconds) and reduces on host.  It composes with the DCN
+store path (used when ranks hold no devices or the mesh is down).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def device_max_reduce(values: List[float]) -> List[float]:
+    """Element-wise max of each process's value vector, via one device
+    all-gather.  Must be called by every process (collective)."""
+    from jax.experimental import multihost_utils
+
+    x = np.asarray(values, dtype=np.float32)
+    gathered = multihost_utils.process_allgather(x)  # (nproc, k) or (k,)
+    gathered = np.atleast_2d(gathered)
+    return [float(v) for v in gathered.max(axis=0)]
+
+
+def make_timeouts_reduce_fn():
+    """Adapter for :meth:`TimeoutsCalc.synchronize_all`'s ``reduce_fn``:
+    takes/returns the {stat_key: value} dict, reducing values on device.
+
+    Keys must match across processes (guaranteed when ranks run the same
+    section schedule; for divergent section sets use the store path)."""
+
+    def reduce_fn(vals: Dict[str, float]) -> Dict[str, float]:
+        keys = sorted(vals)
+        merged = device_max_reduce([vals[k] for k in keys])
+        return dict(zip(keys, merged))
+
+    return reduce_fn

@@ -1,0 +1,47 @@
+"""Device mesh construction.
+
+The resiliency layer is parallelism-agnostic (like the reference, SURVEY.md
+§2.8) but needs topology awareness: the slice structure feeds rendezvous
+segment keys, and its own tiny syncs ride the same mesh as the workload.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("data", "model"),
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices=None,
+):
+    """Build a Mesh over all (or given) devices.
+
+    With ``axis_sizes=None`` the last axis gets 1 and the first absorbs all
+    devices.  ``-1`` in axis_sizes means "infer".
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    sizes = list(axis_sizes)
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one -1 axis")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh {sizes} != {n} devices")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(axis_names))
+
+
+def mesh_axis_sizes(mesh) -> Tuple[int, ...]:
+    return tuple(mesh.devices.shape)

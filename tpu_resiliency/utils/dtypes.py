@@ -1,0 +1,35 @@
+"""Dtype name resolution that survives non-native numpy dtypes.
+
+``str(np.dtype)`` of a bfloat16/fp8 array is e.g. "bfloat16", but
+``np.dtype("bfloat16")`` raises — those dtypes live in ml_dtypes.  Every
+checkpoint metadata path resolves dtype names through here, and raw-byte
+serialization uses views so ``np.save`` never sees a non-native descr
+(it would silently write '|V2' void records that cannot be cast back).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+def to_bytes_view(arr: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of an array (zero-copy when contiguous)."""
+    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+
+
+def from_bytes(raw, dtype_name: str, shape) -> np.ndarray:
+    return (
+        np.frombuffer(raw, dtype=resolve_dtype(dtype_name))
+        .reshape(shape)
+        .copy()
+    )
