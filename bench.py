@@ -9,8 +9,8 @@ ours/61000ms (<1 is better).
 
 Method (end-to-end, on the real device): the flagship transformer trains on
 the TPU; every step beats the on-device quorum tripwire
-(:class:`tpu_resiliency.ops.quorum.QuorumMonitor` — heartbeat stamps reduced
-by a pod-wide ``pmin`` collective).  The detection budget is derived from
+(:class:`tpu_resiliency.ops.quorum.QuorumMonitor` — heartbeat ages reduced
+by a pod-wide ``pmax`` collective).  The detection budget is derived from
 observed beat intervals exactly like production (safety_factor × max
 observed).  A hang is injected by stopping the beats; latency = time from
 the hang until the monitor's stale trip.  Median over repeats.
@@ -61,7 +61,6 @@ def main() -> None:
     params, opt, loss = step(params, opt, batch)
     jax.block_until_ready(loss)
 
-    detections = []
     monitor_holder = {}
 
     def on_stale(age_ms: float) -> None:
@@ -102,7 +101,6 @@ def main() -> None:
         if "t_detect" in monitor_holder:
             raw_ms = (monitor_holder["t_detect"] - monitor_holder["t_hang"]) * 1000.0
             latencies_ms.append(raw_ms)
-            detections.append({"rep": rep, "latency_ms": raw_ms, "budget_ms": budget_ms})
 
     assert latencies_ms, "hang was never detected"
     median_ms = float(np.median(latencies_ms))

@@ -1,0 +1,208 @@
+"""In-process restart wrapper tests.
+
+Reference analog: ``tests/inprocess/test_wrap.py`` + ``common.py``'s
+MultiProcessTestCase: real OS processes, real store, injected faults.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_resiliency.inprocess.rank_assignment import (
+    ActivateAllRanks,
+    ActiveWorldSizeDivisibleBy,
+    FillGaps,
+    MaxActiveWorldSize,
+    RankAssignmentCtx,
+    RankDiscontinued,
+    ShiftRanks,
+)
+from tpu_resiliency.inprocess.state import Mode, State
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = str(REPO / "tests" / "workloads" / "inproc_worker.py")
+
+
+# ---- pure policy tests (reference test_rank_assignment.py) -----------------
+
+def _state(rank, world):
+    return State(rank=rank, world_size=world)
+
+
+class TestRankAssignment:
+    def test_shift_ranks(self):
+        ctx = RankAssignmentCtx(_state(3, 4), {1})
+        ShiftRanks()(ctx)
+        assert ctx.state.rank == 2
+        assert ctx.state.world_size == 3
+        assert ctx.state.mode == Mode.ACTIVE
+
+    def test_shift_ranks_discontinued(self):
+        with pytest.raises(RankDiscontinued):
+            ShiftRanks()(RankAssignmentCtx(_state(1, 4), {1}))
+
+    def test_fill_gaps_keeps_survivors(self):
+        # world 4, rank 1 dies: rank 3 moves into slot 1; 0 and 2 unchanged
+        ctx = RankAssignmentCtx(_state(2, 4), {1})
+        FillGaps()(ctx)
+        assert ctx.state.rank == 2
+        ctx3 = RankAssignmentCtx(_state(3, 4), {1})
+        FillGaps()(ctx3)
+        assert ctx3.state.rank == 1
+        assert ctx3.state.world_size == 3
+
+    def test_max_active_world_size(self):
+        ctx = RankAssignmentCtx(_state(2, 3), set())
+        MaxActiveWorldSize(2)(ctx)
+        assert ctx.state.mode == Mode.INACTIVE
+        assert ctx.state.active_world_size == 2
+        ctx0 = RankAssignmentCtx(_state(0, 3), set())
+        MaxActiveWorldSize(2)(ctx0)
+        assert ctx0.state.mode == Mode.ACTIVE
+
+    def test_divisible_by(self):
+        ctx = RankAssignmentCtx(_state(6, 7), set())
+        ActiveWorldSizeDivisibleBy(4)(ctx)
+        assert ctx.state.active_world_size == 4
+        assert ctx.state.mode == Mode.INACTIVE
+        ctx2 = RankAssignmentCtx(_state(2, 7), set())
+        ActiveWorldSizeDivisibleBy(4)(ctx2)
+        assert ctx2.state.mode == Mode.ACTIVE
+
+    def test_activate_all(self):
+        ctx = RankAssignmentCtx(_state(1, 2), set())
+        ActivateAllRanks()(ctx)
+        assert ctx.state.mode == Mode.ACTIVE
+
+
+# ---- multiprocess wrapper tests --------------------------------------------
+
+def run_scenario(store_server, scenario, world=2, extra_env=None, timeout=90):
+    procs = []
+    for rank in range(world):
+        env = dict(os.environ)
+        env.update(
+            {
+                "TPURX_REPO": str(REPO),
+                "TPURX_RANK": str(rank),
+                "TPURX_WORLD_SIZE": str(world),
+                "TPURX_STORE_ADDR": "127.0.0.1",
+                "TPURX_STORE_PORT": str(store_server.port),
+                "SCENARIO": scenario,
+                "JAX_PLATFORMS": "cpu",
+            }
+        )
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=str(REPO),
+            )
+        )
+    outs = {}
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            out += "\n<TIMEOUT>"
+        outs[rank] = out
+    return procs, outs
+
+
+def _dump(outs):
+    for r, out in outs.items():
+        print(f"===== rank {r} =====\n{out[-2500:]}")
+
+
+def test_clean_run(store_server):
+    procs, outs = run_scenario(store_server, "clean", world=2)
+    if any(p.returncode != 0 for p in procs):
+        _dump(outs)
+    for rank, p in enumerate(procs):
+        assert p.returncode == 0
+        assert "RESULT" in outs[rank]
+        assert "ret=ok@0" in outs[rank]
+        assert "calls=1" in outs[rank]
+
+
+def test_exception_restarts_all_ranks(store_server):
+    procs, outs = run_scenario(store_server, "exception", world=2)
+    if any(p.returncode != 0 for p in procs):
+        _dump(outs)
+    for rank, p in enumerate(procs):
+        assert p.returncode == 0, f"rank {rank}"
+        # both ranks ran the fn twice (iteration 0 faulted, iteration 1 ok)
+        assert "calls=2" in outs[rank]
+        assert "ret=ok@1" in outs[rank]
+    assert "injected exception" in outs[1]
+
+
+def test_crash_shrinks_world(store_server):
+    procs, outs = run_scenario(store_server, "crash", world=3, timeout=120)
+    if procs[0].returncode != 0 or procs[2].returncode != 0:
+        _dump(outs)
+    # rank 1 died hard
+    assert procs[1].returncode == 31
+    # survivors restarted and finished with world 2
+    for rank in (0, 2):
+        assert procs[rank].returncode == 0, f"rank {rank}"
+        assert "ret=ok@1" in outs[rank]
+        assert "world=2 iter=1" in outs[rank]
+    # rank 2 shifted into rank 1's slot
+    assert "train start rank=1 world=2 iter=1" in outs[2]
+
+
+def test_hang_detected_and_killed(store_server):
+    procs, outs = run_scenario(
+        store_server, "hang", world=2, timeout=150,
+        extra_env={"SOFT_TIMEOUT": "1.0", "HARD_TIMEOUT": "2.5"},
+    )
+    if procs[0].returncode != 0:
+        _dump(outs)
+    # hung rank was killed by its monitor process
+    assert procs[1].returncode != 0
+    # survivor restarted alone and completed
+    assert procs[0].returncode == 0
+    assert "ret=ok@1" in outs[0]
+    assert "world=1 iter=1" in outs[0]
+
+
+def test_spare_rank_activated_on_failure(store_server):
+    procs, outs = run_scenario(
+        store_server, "spare", world=3, timeout=120,
+        extra_env={"MAX_ACTIVE": "2", "FAIL_RANK": "1", "SCENARIO2": ""},
+    )
+    # scenario "spare" with FAIL_RANK crashing? spare scenario only changes
+    # assignment; make rank 1 crash via env:
+    # (covered by the dedicated run below)
+    for rank, p in enumerate(procs):
+        if p.returncode != 0:
+            _dump(outs)
+        assert p.returncode == 0
+    # rank 2 was INACTIVE initially, and the job completed
+    assert "inactive" in outs[2].lower() or "RESULT" in outs[2]
+
+
+def test_spare_promoted_after_crash(store_server):
+    env = {"MAX_ACTIVE": "2", "FAIL_RANK": "1"}
+    procs, outs = run_scenario(
+        store_server, "spare_crash", world=3, timeout=150, extra_env=env
+    )
+    if procs[0].returncode != 0 or procs[2].returncode != 0:
+        _dump(outs)
+    assert procs[1].returncode == 31      # crashed
+    assert procs[0].returncode == 0
+    assert procs[2].returncode == 0
+    # spare (initial rank 2) became active rank 1 in iteration 1
+    assert "train start rank=1 world=2 iter=1" in outs[2]
+    assert "ret=ok@1" in outs[0]

@@ -14,7 +14,7 @@ from tpu_resiliency.models.transformer import (
     make_batch,
     make_train_step,
 )
-from tpu_resiliency.ops.quorum import QuorumMonitor, make_quorum_fn
+from tpu_resiliency.ops.quorum import QuorumMonitor, make_quorum_fn, now_stamp_ms
 from tpu_resiliency.parallel.collectives import device_max_reduce, make_timeouts_reduce_fn
 from tpu_resiliency.parallel.mesh import make_mesh
 
@@ -61,11 +61,33 @@ def test_device_max_reduce_single_process():
     assert fn({"a": 3.0, "b": 7.0}) == {"a": 3.0, "b": 7.0}
 
 
-def test_quorum_reduce_min():
+def test_quorum_reduce_max_age():
     mesh = make_mesh(("all",), (8,))
     fn = make_quorum_fn(mesh, use_pallas=False)
-    stamps = np.array([10, 20, 3, 40, 50, 60, 70, 80], dtype=np.float32)
-    assert fn(stamps) == 3.0
+    now = now_stamp_ms()
+    stamps = np.full(8, now, dtype=np.int64)
+    stamps[3] = now - 5000  # one device 5s stale
+    age = fn(stamps)
+    assert 5000 <= age < 7000, age
+
+
+def test_quorum_age_wrap_safe():
+    """A hung rank's pre-wrap stamp must dominate fresh post-wrap stamps."""
+    mesh = make_mesh(("all",), (8,))
+    fn = make_quorum_fn(mesh, use_pallas=False)
+    import tpu_resiliency.ops.quorum as q
+    now = 100  # just after the 2^31 wrap
+    hung = (2 ** 31) - 4000  # beat 4.1s ago, before the wrap
+    orig = q.now_stamp_ms
+    q.now_stamp_ms = lambda: now
+    try:
+        fn2 = make_quorum_fn(mesh, use_pallas=False)
+        stamps = np.full(8, now - 10, dtype=np.int64)
+        stamps[5] = hung
+        age = fn2(stamps)
+        assert 4000 <= age < 6000, age
+    finally:
+        q.now_stamp_ms = orig
 
 
 def test_quorum_monitor_detects_stale():

@@ -1,0 +1,86 @@
+"""Worker for in-process restart tests (reference analog: tests/inprocess/app.py).
+
+Env:
+  TPURX_RANK / TPURX_WORLD_SIZE   identity
+  TPURX_STORE_ADDR / PORT         store
+  SCENARIO                        clean | exception | crash | hang | spare
+  FAIL_RANK                       rank that faults (default 1)
+  STEPS                           steps per fn run (default 30)
+Prints "RESULT rank=<r> iters=<n> world=<w> ret=<ret>" on success.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "/root/repo"))
+
+from tpu_resiliency.inprocess import (
+    Compose,
+    MaxActiveWorldSize,
+    ShiftRanks,
+    Wrapper,
+)
+
+SCENARIO = os.environ.get("SCENARIO", "clean")
+FAIL_RANK = int(os.environ.get("FAIL_RANK", "1"))
+STEPS = int(os.environ.get("STEPS", "60"))
+INITIAL_RANK = int(os.environ["TPURX_RANK"])
+
+calls = {"n": 0}
+
+
+def train(call_wrapper=None):
+    calls["n"] += 1
+    it = call_wrapper.iteration
+    state = call_wrapper.state
+    rank = state.active_rank
+    world = state.active_world_size
+    print(
+        f"train start rank={rank} world={world} iter={it} call={calls['n']}",
+        flush=True,
+    )
+    for step in range(STEPS):
+        call_wrapper.ping()
+        time.sleep(0.05)
+        if it == 0 and INITIAL_RANK == FAIL_RANK and step == 3:
+            if "exception" in SCENARIO:
+                raise RuntimeError("injected exception")
+            if "crash" in SCENARIO:
+                print("crashing", flush=True)
+                os._exit(31)
+            if "hang" in SCENARIO:
+                print("hanging", flush=True)
+                time.sleep(3600)  # stops pinging; GIL released
+    return f"ok@{it}"
+
+
+def main():
+    assignment = (
+        Compose(ShiftRanks(), MaxActiveWorldSize(int(os.environ.get("MAX_ACTIVE", "2"))))
+        if SCENARIO.startswith("spare")
+        else ShiftRanks()
+    )
+    wrapper = Wrapper(
+        rank_assignment=assignment,
+        soft_timeout=float(os.environ.get("SOFT_TIMEOUT", "1.0")),
+        hard_timeout=float(os.environ.get("HARD_TIMEOUT", "2.5")),
+        monitor_process_interval=0.2,
+        monitor_thread_interval=0.1,
+        last_call_wait=0.2,
+        heartbeat_interval=0.2,
+        sibling_timeout=2.0,
+        barrier_timeout=30.0,
+    )
+    wrapped = wrapper(train)
+    ret = wrapped()
+    final_rank = os.environ.get("TPURX_RANK")
+    print(
+        f"RESULT rank={INITIAL_RANK} calls={calls['n']} "
+        f"final_rank={final_rank} ret={ret}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

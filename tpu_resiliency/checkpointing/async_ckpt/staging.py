@@ -147,10 +147,10 @@ def _stage_ndarray(
     index: Tuple[Tuple[int, int], ...],
     owner: bool,
 ) -> ShardInfo:
-    nbytes = max(1, arr.nbytes)
+    nbytes = arr.nbytes  # true size; 0 for empty leaves (shm pads to 1)
     shm_name = ""
     if owner:
-        shm = shared_memory.SharedMemory(create=True, size=nbytes)
+        shm = shared_memory.SharedMemory(create=True, size=max(1, nbytes))
         dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
         np.copyto(dst, arr, casting="no")
         staged._shms.append(shm)

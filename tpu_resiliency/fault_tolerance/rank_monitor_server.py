@@ -197,17 +197,23 @@ class RankMonitorServer:
                 "section_timeouts": section_timeouts_to_dict(self.section_timeouts),
                 "cycle": self.cycle,
             }
-        if mtype == MsgType.HEARTBEAT:
-            st.last_hb = now
-            return {"type": MsgType.OK.value}
-        if mtype == MsgType.SECTION_START:
-            st.seen_section_msgs = True
-            st.open_sections[msg["name"]] = now
-            return {"type": MsgType.OK.value}
-        if mtype == MsgType.SECTION_END:
-            st.seen_section_msgs = True
-            st.open_sections.pop(msg["name"], None)
-            st.last_section_activity = now
+        if mtype in (MsgType.HEARTBEAT, MsgType.SECTION_START, MsgType.SECTION_END):
+            if st.owner_conn is not None and conn_id != st.owner_conn:
+                # a lingering previous worker must not refresh the new
+                # worker's liveness state (it would mask a real hang)
+                return {
+                    "type": MsgType.ERROR.value,
+                    "error": "stale connection: another worker owns this monitor",
+                }
+            if mtype == MsgType.HEARTBEAT:
+                st.last_hb = now
+            elif mtype == MsgType.SECTION_START:
+                st.seen_section_msgs = True
+                st.open_sections[msg["name"]] = now
+            else:
+                st.seen_section_msgs = True
+                st.open_sections.pop(msg["name"], None)
+                st.last_section_activity = now
             return {"type": MsgType.OK.value}
         if mtype == MsgType.UPDATE_TIMEOUTS:
             if msg.get("hb_timeouts"):

@@ -1,0 +1,63 @@
+"""In-process restart ring (reference: ``inprocess/``).
+
+Wrap a training function so faults (exceptions, hangs, dead peers) restart it
+inside the same Python process — no scheduler round-trip, no process spawn,
+no JAX runtime re-init when avoidable.  The fastest of the three restart
+rings; composes under the in-job launcher ring (SURVEY.md §1).
+
+    @tpu_resiliency.inprocess.Wrapper(store_factory=...)
+    def train(call_wrapper=None): ...
+
+TPU re-design notes: the reference's NCCL ``backend.abort()`` has no JAX
+equivalent — the Abort stage here cancels *our* auxiliary engines (checkpoint
+workers, peer exchanges, quorum monitors) and drops compiled-call caches;
+in-flight XLA collectives are bounded by the monitor process's hard-timeout
+kill (a wedged device program cannot be cancelled from Python — the kill ring
+below this one handles it, which is exactly how the rings compose).
+"""
+
+from .attribution import Interruption, InterruptionRecord
+from .compose import Compose
+from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
+from .health_check import DeviceProbeHealthCheck, FaultCounterExceeded, FaultCounter
+from .monitor_thread import MonitorThread
+from .monitor_process import MonitorProcess
+from .progress_watchdog import ProgressWatchdog
+from .rank_assignment import (
+    ActivateAllRanks,
+    ActiveWorldSizeDivisibleBy,
+    FillGaps,
+    MaxActiveWorldSize,
+    RankAssignmentCtx,
+    ShiftRanks,
+)
+from .sibling_monitor import SiblingMonitor
+from .state import FrozenState, Mode, State
+from .wrap import CallWrapper, Wrapper
+
+__all__ = [
+    "Wrapper",
+    "CallWrapper",
+    "State",
+    "FrozenState",
+    "Mode",
+    "Interruption",
+    "InterruptionRecord",
+    "RankShouldRestart",
+    "RestartAbort",
+    "HealthCheckError",
+    "Compose",
+    "MonitorThread",
+    "MonitorProcess",
+    "ProgressWatchdog",
+    "SiblingMonitor",
+    "DeviceProbeHealthCheck",
+    "FaultCounter",
+    "FaultCounterExceeded",
+    "RankAssignmentCtx",
+    "ActivateAllRanks",
+    "MaxActiveWorldSize",
+    "ActiveWorldSizeDivisibleBy",
+    "FillGaps",
+    "ShiftRanks",
+]

@@ -1,0 +1,75 @@
+"""Abort plugins: tear down auxiliary engines before a restart.
+
+Reference analog: ``inprocess/abort.py`` — ``AbortTorchDistributed`` aborts
+every NCCL backend in parallel threads.  JAX exposes no collective-abort API
+(SURVEY.md §7 hard part (a)), and in-flight XLA programs cannot be cancelled
+from Python; the design consequence is explicit: the **monitor process's
+hard-timeout kill is the backstop** for wedged device programs, and the
+in-process Abort stage handles what Python *can* release:
+
+- :class:`AbortCheckpointWorkers` — kill persistent async-ckpt writers
+  (reference ``AbortPersistentCheckpointProcesses`` ``:194``).
+- :class:`AbortPeerExchange` — close local-ckpt replication sockets.
+- :class:`AbortQuorumMonitor` — stop the device-quorum tick thread (it would
+  otherwise keep dispatching collectives into a broken mesh).
+- :class:`ClearJaxCaches` — drop compiled-executable caches so the next
+  iteration re-traces against the new topology when world size changed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("inproc.abort")
+
+
+class AbortCheckpointWorkers:
+    def __init__(self, *queues):
+        self.queues = queues
+
+    def __call__(self, state=None):
+        for q in self.queues:
+            try:
+                q.abort()
+            except Exception:  # noqa: BLE001
+                log.exception("failed aborting checkpoint queue")
+        return state
+
+
+class AbortPeerExchange:
+    def __init__(self, *exchanges):
+        self.exchanges = exchanges
+
+    def __call__(self, state=None):
+        for ex in self.exchanges:
+            try:
+                ex.close()
+            except Exception:  # noqa: BLE001
+                log.exception("failed closing peer exchange")
+        return state
+
+
+class AbortQuorumMonitor:
+    def __init__(self, *monitors):
+        self.monitors = monitors
+
+    def __call__(self, state=None):
+        for m in self.monitors:
+            try:
+                m.stop()
+            except Exception:  # noqa: BLE001
+                log.exception("failed stopping quorum monitor")
+        return state
+
+
+class ClearJaxCaches:
+    def __call__(self, state=None):
+        try:
+            import jax
+
+            jax.clear_caches()
+        except Exception:  # noqa: BLE001
+            log.exception("jax.clear_caches failed")
+        return state

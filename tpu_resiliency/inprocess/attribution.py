@@ -1,0 +1,44 @@
+"""Interruption records (reference ``inprocess/attribution.py:25-67``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+
+
+class Interruption(str, enum.Enum):
+    EXCEPTION = "exception"
+    SOFT_TIMEOUT = "soft_timeout"          # progress stalled; process alive
+    HARD_TIMEOUT = "hard_timeout"          # process wedged; was killed
+    TERMINATED = "terminated"              # process died
+    SIBLING_TIMEOUT = "sibling_timeout"    # detected by the neighbor rank
+    MONITOR_LOST = "monitor_lost"          # monitor process itself vanished
+
+
+@dataclasses.dataclass
+class InterruptionRecord:
+    rank: int
+    interruption: Interruption
+    message: str = ""
+    origin_rank: int = -1   # who recorded it (-1 = self)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "rank": self.rank,
+                "interruption": self.interruption.value,
+                "message": self.message,
+                "origin_rank": self.origin_rank,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw) -> "InterruptionRecord":
+        d = json.loads(raw if isinstance(raw, str) else raw.decode())
+        return cls(
+            rank=d["rank"],
+            interruption=Interruption(d["interruption"]),
+            message=d.get("message", ""),
+            origin_rank=d.get("origin_rank", -1),
+        )

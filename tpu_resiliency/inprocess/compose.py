@@ -1,0 +1,24 @@
+"""Plugin composition (reference ``inprocess/compose.py:39``)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class Compose:
+    """Chain single-argument plugins left-to-right: Compose(f, g)(x) == g(f(x)).
+
+    (The reference applies rightmost-first for its ABC chains; here the
+    pipeline reads in execution order, which is what every call site wants.)
+    """
+
+    def __init__(self, *fns: Callable):
+        self.fns = fns
+
+    def __call__(self, arg):
+        for fn in self.fns:
+            arg = fn(arg)
+        return arg
+
+    def __repr__(self) -> str:
+        return f"Compose({', '.join(repr(f) for f in self.fns)})"

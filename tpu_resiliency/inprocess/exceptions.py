@@ -1,0 +1,16 @@
+"""Exception types for the in-process restart protocol."""
+
+
+class RankShouldRestart(BaseException):
+    """Asynchronously raised into the main thread to interrupt the wrapped
+    function (reference ``monitor_thread.py`` async raise).  Derives from
+    BaseException so generic ``except Exception`` handlers in user training
+    loops cannot swallow a restart."""
+
+
+class RestartAbort(BaseException):
+    """Unrecoverable condition: leave the restart loop entirely."""
+
+
+class HealthCheckError(Exception):
+    """Raised by health-check plugins; marks this rank unfit to continue."""

@@ -1,0 +1,97 @@
+"""Restart health checks (run between restart iterations).
+
+Capability parity with ``inprocess/health_check.py:73-228``:
+
+- :class:`DeviceProbeHealthCheck` — JAX analog of ``CudaHealthCheck``'s
+  threaded double ``cuda.synchronize``: run a tiny computation and
+  ``block_until_ready`` it on a worker thread with a wall-clock timeout.  A
+  healthy chip answers in ms; a wedged runtime hangs the probe thread (not
+  the restart loop) and the check fails.
+- :class:`FaultCounter` — abort after N faults on this rank (``:128``).
+- Chaining via :class:`tpu_resiliency.inprocess.compose.Compose`; the
+  node-level checks from :mod:`tpu_resiliency.health` can be adapted with
+  :class:`NodeHealthCheckAdapter`.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Optional
+
+from ..utils.logging import get_logger
+from .exceptions import HealthCheckError, RestartAbort
+from .state import FrozenState
+
+log = get_logger("inproc.health")
+
+
+class FaultCounterExceeded(RestartAbort):
+    pass
+
+
+class DeviceProbeHealthCheck:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpurx-devprobe"
+        )
+
+    @staticmethod
+    def _probe() -> float:
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.ones((128, 128))
+        y = (x @ x).sum()
+        jax.block_until_ready(y)
+        return float(y)
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        future = self._pool.submit(self._probe)
+        try:
+            val = future.result(timeout=self.timeout)
+        except concurrent.futures.TimeoutError as exc:
+            # the probe thread is stuck on the device — replace the pool so a
+            # later check doesn't queue behind the wedged probe
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpurx-devprobe"
+            )
+            raise HealthCheckError(
+                f"device probe hung > {self.timeout}s (runtime wedged)"
+            ) from exc
+        except Exception as exc:  # noqa: BLE001
+            raise HealthCheckError(f"device probe failed: {exc}") from exc
+        if val != 128.0 * 128 * 128:
+            raise HealthCheckError(f"device probe wrong result: {val}")
+        return state
+
+
+class FaultCounter:
+    """Abort the restart loop after ``max_faults`` interruptions of this rank
+    (a chip that keeps falling over should leave the job to the in-job ring)."""
+
+    def __init__(self, max_faults: int = 3):
+        self.max_faults = max_faults
+        self.count = 0
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        # called on the restart path => one more fault observed
+        self.count += 1
+        if self.count > self.max_faults:
+            raise FaultCounterExceeded(
+                f"rank {state.rank}: {self.count} faults > {self.max_faults}"
+            )
+        return state
+
+
+class NodeHealthCheckAdapter:
+    """Wrap a :class:`tpu_resiliency.health.HealthCheck` as a restart check."""
+
+    def __init__(self, check):
+        self.check = check
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        result = self.check.run()
+        if not result.healthy:
+            raise HealthCheckError(f"{result.name}: {result.message}")
+        return state

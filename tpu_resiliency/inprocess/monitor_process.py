@@ -1,0 +1,180 @@
+"""Monitor process: an external watchdog for one training rank.
+
+Capability parity with ``inprocess/monitor_process.py:55-437``: a daemonized
+process (double-fork, so it survives the parent's crash and is reparented to
+init) that watches the training PID and the progress-watchdog timestamp:
+
+- soft timeout (no progress): record a SOFT_TIMEOUT interruption in the store
+  so every rank's MonitorThread trips and restarts — the process lives;
+- hard timeout (still no progress after the kill budget): SIGTERM then
+  SIGKILL the rank (a GIL-holding or device-wedged process cannot restart
+  itself) and record HARD_TIMEOUT + terminated;
+- process death: record TERMINATED + mark the rank terminated.
+
+The monitor connects to the store with its own client (it must not share the
+parent's socket).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+from ..utils.logging import get_logger, setup_logger
+from .attribution import Interruption, InterruptionRecord
+from .store_ops import InprocStore
+
+log = get_logger("monitor_process")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    # a zombie (dead, unreaped by a slow parent) must count as dead — the
+    # interpreter is gone even though the pid still answers signal 0
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(")", 1)[1].split()[0]
+        return state != "Z"
+    except (OSError, IndexError):
+        return False
+
+
+def _terminate_process(pid: int, grace: float) -> None:
+    try:
+        os.kill(pid, signal.SIGCONT)
+        os.kill(pid, signal.SIGTERM)
+    except OSError:
+        return
+    deadline = time.monotonic() + grace
+    while time.monotonic() < deadline:
+        if not _pid_alive(pid):
+            return
+        time.sleep(0.1)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except OSError:
+        pass
+
+
+class MonitorProcess:
+    def __init__(
+        self,
+        store_factory,                 # () -> StoreClient (fresh connection)
+        group: str,
+        rank: int,
+        timestamp,                     # mp.Value('d') from ProgressWatchdog
+        soft_timeout: float = 60.0,
+        hard_timeout: float = 90.0,
+        interval: float = 1.0,
+        termination_grace: float = 5.0,
+    ):
+        self.store_factory = store_factory
+        self.group = group
+        self.rank = rank
+        self.timestamp = timestamp
+        self.soft_timeout = soft_timeout
+        self.hard_timeout = hard_timeout
+        self.interval = interval
+        self.termination_grace = termination_grace
+        self._iter_value = mp.Value("i", 0, lock=False)
+        self._enabled = mp.Value("i", 1, lock=False)
+        self._proc: Optional[mp.Process] = None
+        self.parent_pid = os.getpid()
+
+    # -- parent-side control ----------------------------------------------
+
+    def start(self) -> "MonitorProcess":
+        ctx = mp.get_context("fork")
+        self._proc = ctx.Process(
+            target=self._daemon_main,
+            name=f"tpurx-inproc-monitor-{self.rank}",
+            daemon=True,
+        )
+        self._proc.start()
+        return self
+
+    def set_iteration(self, iteration: int) -> None:
+        self._iter_value.value = iteration
+
+    def set_enabled(self, enabled: bool) -> None:
+        """Disable hang protection during known-long phases (reference
+        ``disable_hang_protection``)."""
+        self._enabled.value = 1 if enabled else 0
+
+    def stop(self) -> None:
+        if self._proc is not None and self._proc.is_alive():
+            self._proc.terminate()
+            self._proc.join(timeout=5)
+        self._proc = None
+
+    # -- monitor-side loop -------------------------------------------------
+
+    def _daemon_main(self) -> None:
+        # "double fork" effect: detach from the parent's process group so a
+        # killpg of the rank does not take the monitor with it
+        try:
+            os.setsid()
+        except OSError:
+            pass
+        setup_logger()
+        try:
+            store = self.store_factory()
+        except Exception as exc:  # noqa: BLE001
+            log.error("monitor %s: cannot reach store: %s", self.rank, exc)
+            return
+        ops = InprocStore(store, self.group)
+        soft_reported_at: Optional[float] = None
+        while True:
+            time.sleep(self.interval)
+            pid = self.parent_pid
+            iteration = self._iter_value.value
+            if not _pid_alive(pid):
+                log.error("monitor: rank %s (pid %s) died", self.rank, pid)
+                self._record(ops, iteration, Interruption.TERMINATED, "process died")
+                ops.mark_terminated(self.rank)
+                return
+            if not self._enabled.value:
+                soft_reported_at = None
+                continue
+            age = time.time() - self.timestamp.value
+            if age > self.hard_timeout:
+                log.error(
+                    "monitor: rank %s wedged for %.1fs (> hard %.1fs) — killing",
+                    self.rank, age, self.hard_timeout,
+                )
+                self._record(
+                    ops, iteration, Interruption.HARD_TIMEOUT, f"no progress {age:.1f}s"
+                )
+                ops.mark_terminated(self.rank)
+                _terminate_process(pid, self.termination_grace)
+                return
+            if age > self.soft_timeout:
+                if soft_reported_at is None or soft_reported_at < self.timestamp.value:
+                    log.warning(
+                        "monitor: rank %s stalled %.1fs (> soft %.1fs)",
+                        self.rank, age, self.soft_timeout,
+                    )
+                    self._record(
+                        ops, iteration, Interruption.SOFT_TIMEOUT, f"no progress {age:.1f}s"
+                    )
+                    soft_reported_at = time.time()
+            else:
+                soft_reported_at = None
+
+    def _record(self, ops: InprocStore, iteration: int, kind: Interruption, msg: str) -> None:
+        try:
+            ops.record_interruption(
+                iteration,
+                InterruptionRecord(rank=self.rank, interruption=kind, message=msg),
+            )
+        except Exception as exc:  # noqa: BLE001
+            log.error("monitor: failed to record interruption: %s", exc)

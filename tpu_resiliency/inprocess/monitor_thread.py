@@ -1,0 +1,106 @@
+"""Monitor thread: trip on any-rank interruption and restart the main thread.
+
+Capability parity with ``inprocess/monitor_thread.py:58-213``: a daemon
+thread per iteration that blocks on the iteration's interruption-log key; on
+a record appearing it
+
+1. waits ``last_call_wait`` so concurrent faults on other ranks coalesce into
+   one restart (reference ``wrap.py:162`` semantics),
+2. runs the Abort plugin (cancel aux engines — the JAX analog of NCCL abort),
+3. asynchronously raises :class:`RankShouldRestart` into the main thread via
+   ``PyThreadState_SetAsyncExc``, repeatedly, until the wrapper catches it
+   (the raise only lands at a bytecode boundary; a long device wait delays
+   it, which is why the monitor *process* holds the hard-kill backstop).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+from typing import Callable, Optional
+
+from ..utils.logging import get_logger
+from .exceptions import RankShouldRestart
+from .store_ops import InprocStore
+
+log = get_logger("monitor_thread")
+
+
+def async_raise(tid: int, exc_type: type) -> None:
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exc_type)
+    )
+    if res > 1:  # pragma: no cover
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid), None)
+
+
+class MonitorThread:
+    def __init__(
+        self,
+        ops: InprocStore,
+        iteration: int,
+        main_tid: int,
+        abort_fn: Optional[Callable] = None,
+        last_call_wait: float = 0.2,
+        poll_interval: float = 1.0,
+        on_trip: Optional[Callable] = None,
+    ):
+        self.ops = ops.__class__(ops.store.clone(), ops.ns.split("/", 1)[1])
+        self.iteration = iteration
+        self.main_tid = main_tid
+        self.abort_fn = abort_fn
+        self.last_call_wait = last_call_wait
+        self.poll_interval = poll_interval
+        self.on_trip = on_trip
+        self._stop = threading.Event()
+        self._caught = threading.Event()
+        self.tripped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpurx-inproc-monitor-thread-{iteration}", daemon=True
+        )
+
+    def start(self) -> "MonitorThread":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.ops.wait_any_interruption(self.iteration, timeout=self.poll_interval):
+                break
+        if self._stop.is_set():
+            return
+        # coalesce concurrent faults
+        time.sleep(self.last_call_wait)
+        records = self.ops.get_interruptions(self.iteration)
+        log.warning(
+            "iteration %s interrupted: %s",
+            self.iteration,
+            [(r.rank, r.interruption.value) for r in records],
+        )
+        self.tripped.set()
+        if self.on_trip:
+            try:
+                self.on_trip()
+            except Exception:  # noqa: BLE001
+                log.exception("on_trip callback failed")
+        if self.abort_fn is not None:
+            try:
+                self.abort_fn()
+            except Exception:  # noqa: BLE001
+                log.exception("abort plugin failed")
+        # raise into the main thread until the wrapper acknowledges
+        while not self._caught.wait(timeout=0.5):
+            if self._stop.is_set():
+                return
+            async_raise(self.main_tid, RankShouldRestart)
+
+    def mark_caught(self) -> None:
+        """Called by the wrapper once RankShouldRestart reached its handler."""
+        self._caught.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._caught.set()
+        self._thread.join(timeout=5)
+        self.ops.store.close()

@@ -1,0 +1,88 @@
+"""Progress watchdog: liveness timestamps for the monitor process.
+
+Capability parity with ``inprocess/progress_watchdog.py:49-196``: a hybrid of
+manual ``ping()`` calls from the training loop and **automatic** timestamps
+proving the interpreter's main thread still executes bytecode even when user
+code doesn't ping.  The reference injects a C callback with
+``Py_AddPendingCall``; we do the same through ctypes — the pending call runs
+on the main thread at a bytecode boundary, so a GIL-holding C extension or a
+wedged device wait stops the auto-timestamps (exactly the hangs we must
+catch), while a merely-slow loop keeps them flowing.
+
+Timestamps are written to a multiprocessing shared value read by the
+MonitorProcess (no queue: a wedged consumer must not block the producer).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+import threading
+import time
+
+from ..utils.logging import get_logger
+
+log = get_logger("progress_watchdog")
+
+_PENDING_CALLBACK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+
+class ProgressWatchdog:
+    def __init__(self, interval: float = 1.0):
+        self.interval = interval
+        # 'd' = double epoch seconds; lock-free single-writer
+        self.timestamp = mp.Value("d", time.time(), lock=False)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # keep the callback object alive (ctypes would GC it)
+        self._cb = _PENDING_CALLBACK(self._pending_call)
+        self._pending_scheduled = threading.Event()
+
+    # -- main-thread proof-of-life ----------------------------------------
+
+    def _pending_call(self, _arg) -> int:
+        # Runs on the MAIN thread at a bytecode boundary.
+        self.timestamp.value = time.time()
+        self._pending_scheduled.clear()
+        return 0
+
+    def _schedule_pending(self) -> None:
+        if self._pending_scheduled.is_set():
+            return  # previous one not consumed yet — main thread busy/stuck
+        self._pending_scheduled.set()
+        res = ctypes.pythonapi.Py_AddPendingCall(self._cb, None)
+        if res != 0:  # queue full — fine, we try again next tick
+            self._pending_scheduled.clear()
+
+    # -- API ---------------------------------------------------------------
+
+    def ping(self) -> None:
+        """Manual liveness signal from the training loop."""
+        self.timestamp.value = time.time()
+
+    def age(self) -> float:
+        return time.time() - self.timestamp.value
+
+    def start(self) -> "ProgressWatchdog":
+        self.ping()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpurx-progress-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._schedule_pending()
+
+    def pause(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    resume = start
+
+    def stop(self) -> None:
+        self.pause()

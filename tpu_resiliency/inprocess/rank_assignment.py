@@ -1,0 +1,139 @@
+"""Rank (re)assignment policies after faults.
+
+Capability parity with ``inprocess/rank_assignment.py:46-1022``: pure policy
+objects computing each surviving rank's new (active_rank, active_world_size,
+mode) given the terminated set.  Policies chain with
+:class:`tpu_resiliency.inprocess.compose.Compose`.
+
+- :class:`ActivateAllRanks` — everyone alive is ACTIVE (``:126``).
+- :class:`MaxActiveWorldSize` — cap actives; the rest park INACTIVE (``:149``).
+- :class:`ActiveWorldSizeDivisibleBy` — keep active count a multiple of N
+  (TPU: N = chips per slice keeps the mesh shape legal) (``:198``).
+- :class:`FillGaps` — dead ranks' slots are back-filled by the highest
+  surviving ranks; survivors otherwise keep their rank (``:786``).
+- :class:`ShiftRanks` — survivors shift down preserving order (``:843``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set
+
+from .exceptions import RestartAbort
+from .state import Mode, State
+
+
+@dataclasses.dataclass
+class RankAssignmentCtx:
+    state: State
+    terminated_ranks: Set[int]
+
+
+class RankAssignment:
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        raise NotImplementedError
+
+
+class RankDiscontinued(RestartAbort):
+    """This rank has no seat anymore (it was terminated)."""
+
+
+def _surviving(ctx: RankAssignmentCtx) -> List[int]:
+    world = ctx.state.initial_world_size
+    return [r for r in range(world) if r not in ctx.terminated_ranks]
+
+
+class ShiftRanks(RankAssignment):
+    """Survivors are re-numbered 0..n-1 preserving order."""
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        if state.initial_rank in ctx.terminated_ranks:
+            raise RankDiscontinued(f"rank {state.initial_rank} terminated")
+        survivors = _surviving(ctx)
+        state.rank = survivors.index(state.initial_rank)
+        state.world_size = len(survivors)
+        state.active_rank = state.rank
+        state.active_world_size = state.world_size
+        state.mode = Mode.ACTIVE
+        return ctx
+
+
+class FillGaps(RankAssignment):
+    """Dead slots are filled by the highest-numbered survivors; everyone else
+    keeps their rank (minimizes re-sharding movement)."""
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        if state.initial_rank in ctx.terminated_ranks:
+            raise RankDiscontinued(f"rank {state.initial_rank} terminated")
+        survivors = _surviving(ctx)
+        new_world = len(survivors)
+        gaps = sorted(r for r in ctx.terminated_ranks if r < new_world)
+        movers = sorted((r for r in survivors if r >= new_world))
+        mapping = dict(zip(movers, gaps))
+        new_rank = mapping.get(state.initial_rank, state.initial_rank)
+        state.rank = new_rank
+        state.world_size = new_world
+        state.active_rank = new_rank
+        state.active_world_size = new_world
+        state.mode = Mode.ACTIVE
+        return ctx
+
+
+class ActivateAllRanks(RankAssignment):
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        state.active_rank = state.rank
+        state.active_world_size = state.world_size
+        state.mode = Mode.ACTIVE
+        return ctx
+
+
+class MaxActiveWorldSize(RankAssignment):
+    """First ``max_active`` ranks run; the rest are INACTIVE hot spares that
+    re-enter on the next restart if an active rank dies."""
+
+    def __init__(self, max_active: Optional[int] = None):
+        self.max_active = max_active
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        cap = self.max_active if self.max_active is not None else state.world_size
+        cap = min(cap, state.world_size)
+        if state.rank < cap:
+            state.active_rank = state.rank
+            state.active_world_size = cap
+            state.mode = Mode.ACTIVE
+        else:
+            state.active_rank = None
+            state.active_world_size = cap
+            state.mode = Mode.INACTIVE
+        return ctx
+
+
+class ActiveWorldSizeDivisibleBy(RankAssignment):
+    """Largest active world size divisible by ``divisor`` (e.g. hosts per
+    slice / chips per host, so the device mesh stays rectangular)."""
+
+    def __init__(self, divisor: int):
+        if divisor < 1:
+            raise ValueError("divisor must be >= 1")
+        self.divisor = divisor
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        cap = (state.world_size // self.divisor) * self.divisor
+        if cap == 0:
+            raise RestartAbort(
+                f"world size {state.world_size} < divisor {self.divisor}"
+            )
+        if state.rank < cap:
+            state.active_rank = state.rank
+            state.active_world_size = cap
+            state.mode = Mode.ACTIVE
+        else:
+            state.active_rank = None
+            state.active_world_size = cap
+            state.mode = Mode.INACTIVE
+        return ctx

@@ -1,0 +1,83 @@
+"""Sibling heartbeat monitor: distributed detection of silently-dead nodes.
+
+Capability parity with ``inprocess/sibling_monitor.py:28-154``: every rank
+heartbeats into the store; rank i watches rank (i+1) % W.  A node that loses
+power (its monitor process dies with it) is detected by its *sibling*, which
+records the interruption on its behalf — no centralized scanner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+from .attribution import Interruption, InterruptionRecord
+from .store_ops import InprocStore
+
+log = get_logger("sibling_monitor")
+
+
+class SiblingMonitor:
+    def __init__(
+        self,
+        ops: InprocStore,
+        rank: int,
+        ranks: List[int],             # active ranks, sorted
+        iteration: int,
+        heartbeat_interval: float = 1.0,
+        timeout: float = 10.0,
+    ):
+        self.ops = ops.__class__(ops.store.clone(), ops.ns.split("/", 1)[1])
+        self.rank = rank
+        self.ranks = sorted(ranks)
+        self.iteration = iteration
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        idx = self.ranks.index(rank)
+        self.sibling = self.ranks[(idx + 1) % len(self.ranks)]
+        self._stop = threading.Event()
+        self._reported = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"tpurx-sibling-{rank}", daemon=True
+        )
+
+    def start(self) -> "SiblingMonitor":
+        self.ops.heartbeat(self.rank)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.ops.heartbeat(self.rank)
+                if self.sibling == self.rank or self._reported:
+                    continue
+                last = self.ops.last_heartbeat(self.sibling)
+                if last is None:
+                    continue  # sibling not started yet
+                age = time.time() - last
+                if age > self.timeout:
+                    log.error(
+                        "rank %s: sibling %s heartbeat stale %.1fs — recording",
+                        self.rank, self.sibling, age,
+                    )
+                    self.ops.record_interruption(
+                        self.iteration,
+                        InterruptionRecord(
+                            rank=self.sibling,
+                            interruption=Interruption.SIBLING_TIMEOUT,
+                            message=f"heartbeat stale {age:.1f}s",
+                            origin_rank=self.rank,
+                        ),
+                    )
+                    self.ops.mark_terminated(self.sibling)
+                    self._reported = True
+            except Exception as exc:  # noqa: BLE001
+                log.warning("sibling monitor error: %s", exc)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.ops.store.close()

@@ -1,0 +1,85 @@
+"""Restart-loop state (reference ``inprocess/state.py:23-124``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+from typing import Optional
+
+
+class Mode(str, enum.Enum):
+    INITIALIZED = "initialized"
+    ACTIVE = "active"          # runs the wrapped fn
+    INACTIVE = "inactive"      # healthy spare parked in reserve
+    TERMINATED = "terminated"  # out of the job
+
+
+@dataclasses.dataclass
+class State:
+    rank: int
+    world_size: int
+    active_rank: Optional[int] = None
+    active_world_size: Optional[int] = None
+    initial_rank: Optional[int] = None
+    initial_world_size: Optional[int] = None
+    iteration: int = 0
+    mode: Mode = Mode.INITIALIZED
+    fn_exception: Optional[BaseException] = None
+
+    def __post_init__(self):
+        if self.initial_rank is None:
+            self.initial_rank = self.rank
+        if self.initial_world_size is None:
+            self.initial_world_size = self.world_size
+        if self.active_rank is None:
+            self.active_rank = self.rank
+        if self.active_world_size is None:
+            self.active_world_size = self.world_size
+
+    @classmethod
+    def from_env(cls) -> "State":
+        rank = int(os.environ.get("TPURX_RANK", os.environ.get("RANK", "0")))
+        world = int(
+            os.environ.get("TPURX_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1"))
+        )
+        return cls(rank=rank, world_size=world)
+
+    def set_distributed_vars(self) -> None:
+        """Export active rank/world for the wrapped fn's ecosystem
+        (reference ``state.py:94``)."""
+        if self.mode == Mode.ACTIVE and self.active_rank is not None:
+            os.environ["TPURX_RANK"] = str(self.active_rank)
+            os.environ["TPURX_WORLD_SIZE"] = str(self.active_world_size)
+            os.environ["RANK"] = str(self.active_rank)
+            os.environ["WORLD_SIZE"] = str(self.active_world_size)
+
+    def advance(self) -> None:
+        self.iteration += 1
+        self.fn_exception = None
+
+    def freeze(self) -> "FrozenState":
+        return FrozenState(
+            rank=self.rank,
+            world_size=self.world_size,
+            active_rank=self.active_rank,
+            active_world_size=self.active_world_size,
+            initial_rank=self.initial_rank,
+            initial_world_size=self.initial_world_size,
+            iteration=self.iteration,
+            mode=self.mode,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FrozenState:
+    """Immutable snapshot handed to plugins (reference ``FrozenState``)."""
+
+    rank: int
+    world_size: int
+    active_rank: Optional[int]
+    active_world_size: Optional[int]
+    initial_rank: Optional[int]
+    initial_world_size: Optional[int]
+    iteration: int
+    mode: Mode

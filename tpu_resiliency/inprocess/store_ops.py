@@ -1,0 +1,104 @@
+"""Store key schema + operations for the in-process restart protocol.
+
+Reference analog: ``inprocess/store.py:50-321`` (``StoreMixin``: interruption
+records + lock, terminated ranks, heartbeats, per-iteration PrefixStore
+namespaces, barriers).  Differences by design: interruption records are an
+append-only log (our store's APPEND is atomic, so no record lock is needed),
+and iteration fencing uses key prefixes exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..store.barrier import reentrant_barrier
+from .attribution import InterruptionRecord
+
+NS = "inproc"
+
+
+class InprocStore:
+    """Typed operations over the shared KV store for one wrapper group."""
+
+    def __init__(self, store, group: str = "default"):
+        self.store = store
+        self.ns = f"{NS}/{group}"
+
+    # -- interruption records ---------------------------------------------
+
+    def k_interruptions(self, iteration: int) -> str:
+        return f"{self.ns}/iter/{iteration}/interruptions"
+
+    def record_interruption(self, iteration: int, rec: InterruptionRecord) -> None:
+        self.store.append(self.k_interruptions(iteration), rec.to_json() + "\n")
+
+    def any_interruption(self, iteration: int) -> bool:
+        raw = self.store.try_get(self.k_interruptions(iteration))
+        return bool(raw)
+
+    def wait_any_interruption(self, iteration: int, timeout: float) -> bool:
+        from ..store.client import StoreTimeout
+
+        try:
+            self.store.wait([self.k_interruptions(iteration)], timeout=timeout)
+            return True
+        except StoreTimeout:
+            return False
+
+    def get_interruptions(self, iteration: int) -> List[InterruptionRecord]:
+        raw = self.store.try_get(self.k_interruptions(iteration))
+        if not raw:
+            return []
+        return [
+            InterruptionRecord.from_json(line)
+            for line in raw.decode().splitlines()
+            if line.strip()
+        ]
+
+    # -- terminated ranks --------------------------------------------------
+
+    def mark_terminated(self, rank: int) -> None:
+        self.store.set(f"{self.ns}/terminated/{rank}", b"1")
+
+    def terminated_ranks(self) -> List[int]:
+        keys = self.store.list_keys(f"{self.ns}/terminated/")
+        return sorted(int(k.decode().rsplit("/", 1)[1]) for k in keys)
+
+    # -- sibling heartbeats ------------------------------------------------
+
+    def heartbeat(self, rank: int) -> None:
+        self.store.set(f"{self.ns}/hb/{rank}", str(time.time()))
+
+    def last_heartbeat(self, rank: int) -> Optional[float]:
+        raw = self.store.try_get(f"{self.ns}/hb/{rank}")
+        return float(raw) if raw else None
+
+    # -- completion / barriers --------------------------------------------
+
+    def k_completed(self, iteration: int) -> str:
+        return f"{self.ns}/iter/{iteration}/any_completed"
+
+    def mark_completed(self, iteration: int) -> None:
+        self.store.set(self.k_completed(iteration), b"1")
+
+    def any_completed(self, iteration: int) -> bool:
+        return self.store.check([self.k_completed(iteration)])
+
+    def iteration_barrier(
+        self, iteration: int, rank: int, ranks: List[int], timeout: float
+    ) -> None:
+        """Reentrant: a rank interrupted mid-barrier re-enters safely."""
+        reentrant_barrier(
+            self.store,
+            f"{self.ns}/iter/{iteration}/barrier",
+            rank,
+            len(ranks),
+            timeout=timeout,
+            ranks=ranks,
+        )
+
+    def initial_barrier(self, rank: int, world_size: int, timeout: float) -> None:
+        reentrant_barrier(
+            self.store, f"{self.ns}/initial_barrier", rank, world_size, timeout=timeout
+        )

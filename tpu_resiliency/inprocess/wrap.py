@@ -1,0 +1,363 @@
+"""The in-process restart wrapper.
+
+Capability parity with ``inprocess/wrap.py:81-682`` (``Wrapper`` /
+``CallWrapper``).  Restart iteration (reference call stack SURVEY.md §3.3):
+
+    rank assignment → monitor thread → initialize → [ACTIVE: run fn |
+    INACTIVE: park as reserve] → on fault: record → abort aux engines →
+    async-raise RankShouldRestart → finalize → restart health check →
+    iteration barrier (survivors) → read terminated → reassign → loop
+
+Faults handled: exceptions in fn (recorded, coalesced), soft/hard hangs (via
+MonitorProcess watching the ProgressWatchdog), silent node death (via
+SiblingMonitor), peer faults (any rank's record trips every rank's
+MonitorThread).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gc
+import inspect
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..store.barrier import BarrierTimeout
+from ..store.client import StoreClient, store_from_env
+from ..utils.logging import get_logger
+from ..utils.profiling import ProfilingEvent, record_event
+from .attribution import Interruption, InterruptionRecord
+from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
+from .monitor_process import MonitorProcess
+from .monitor_thread import MonitorThread
+from .progress_watchdog import ProgressWatchdog
+from .rank_assignment import RankAssignmentCtx, ShiftRanks
+from .sibling_monitor import SiblingMonitor
+from .state import Mode, State
+from .store_ops import InprocStore
+
+log = get_logger("inproc.wrap")
+
+
+class Wrapper:
+    """Decorator adding in-process restart to a training function.
+
+    The wrapped function may declare a ``call_wrapper`` keyword parameter to
+    receive the :class:`CallWrapper` (``ping()``, ``atomic()``, ``state``).
+    """
+
+    def __init__(
+        self,
+        store_factory: Optional[Callable[[], StoreClient]] = None,
+        group: str = "default",
+        initialize: Optional[Callable] = None,
+        abort: Optional[Callable] = None,
+        finalize: Optional[Callable] = None,
+        health_check: Optional[Callable] = None,
+        rank_assignment: Optional[Callable] = None,
+        max_iterations: Optional[int] = None,
+        soft_timeout: float = 60.0,
+        hard_timeout: float = 90.0,
+        monitor_process_interval: float = 1.0,
+        monitor_thread_interval: float = 0.25,
+        last_call_wait: float = 0.2,
+        heartbeat_interval: float = 1.0,
+        sibling_timeout: float = 10.0,
+        barrier_timeout: float = 120.0,
+        enable_monitor_process: bool = True,
+        enable_sibling_monitor: bool = True,
+    ):
+        self.store_factory = store_factory or store_from_env
+        self.group = group
+        self.initialize = initialize
+        self.abort = abort
+        self.finalize = finalize
+        self.health_check = health_check
+        self.rank_assignment = rank_assignment or ShiftRanks()
+        self.max_iterations = max_iterations
+        self.soft_timeout = soft_timeout
+        self.hard_timeout = hard_timeout
+        self.monitor_process_interval = monitor_process_interval
+        self.monitor_thread_interval = monitor_thread_interval
+        self.last_call_wait = last_call_wait
+        self.heartbeat_interval = heartbeat_interval
+        self.sibling_timeout = sibling_timeout
+        self.barrier_timeout = barrier_timeout
+        self.enable_monitor_process = enable_monitor_process
+        self.enable_sibling_monitor = enable_sibling_monitor
+
+    def __call__(self, fn: Callable) -> Callable:
+        def wrapped(*args, **kwargs):
+            with CallWrapper(self, fn) as cw:
+                return cw.run(*args, **kwargs)
+
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapped
+
+
+class CallWrapper:
+    def __init__(self, wrapper: Wrapper, fn: Callable):
+        self.w = wrapper
+        self.fn = fn
+        self.state = State.from_env()
+        self.atomic_lock = threading.Lock()
+        self._store: Optional[StoreClient] = None
+        self.ops: Optional[InprocStore] = None
+        self.watchdog: Optional[ProgressWatchdog] = None
+        self.monitor_process: Optional[MonitorProcess] = None
+        self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
+
+    # -- public API for the wrapped fn ------------------------------------
+
+    def ping(self) -> None:
+        if self.watchdog:
+            self.watchdog.ping()
+
+    @contextlib.contextmanager
+    def atomic(self):
+        """Critical section: restart raises are deferred until exit."""
+        with self.atomic_lock:
+            yield
+
+    @contextlib.contextmanager
+    def disable_hang_protection(self):
+        """For known-long phases (huge compiles, first checkpoint load)."""
+        if self.monitor_process:
+            self.monitor_process.set_enabled(False)
+        try:
+            yield
+        finally:
+            if self.monitor_process:
+                self.monitor_process.set_enabled(True)
+
+    @property
+    def iteration(self) -> int:
+        return self.state.iteration
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "CallWrapper":
+        self._store = self.w.store_factory()
+        self.ops = InprocStore(self._store, self.w.group)
+        self.watchdog = ProgressWatchdog(interval=self.w.monitor_process_interval)
+        # the watchdog must run BEFORE hang protection arms: the initial
+        # barrier blocks for peers, and its store-wait loop only keeps the
+        # liveness timestamp fresh via the watchdog's pending calls
+        self.watchdog.start()
+        if self.w.enable_monitor_process:
+            self.monitor_process = MonitorProcess(
+                store_factory=self.w.store_factory,
+                group=self.w.group,
+                rank=self.state.initial_rank,
+                timestamp=self.watchdog.timestamp,
+                soft_timeout=self.w.soft_timeout,
+                hard_timeout=self.w.hard_timeout,
+                interval=self.w.monitor_process_interval,
+            ).start()
+        self.ops.initial_barrier(
+            self.state.initial_rank, self.state.initial_world_size,
+            timeout=self.w.barrier_timeout,
+        )
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.watchdog:
+            self.watchdog.stop()
+        if self.monitor_process:
+            self.monitor_process.stop()
+        if self._store:
+            self._store.close()
+
+    # -- restart loop ------------------------------------------------------
+
+    def run(self, *args, **kwargs) -> Any:
+        w = self.w
+        state = self.state
+        main_tid = threading.get_ident()
+        # initial assignment
+        terminated = set(self.ops.terminated_ranks())
+        w.rank_assignment(RankAssignmentCtx(state, terminated))
+
+        while True:
+            iteration = state.iteration
+            if w.max_iterations is not None and iteration >= w.max_iterations:
+                raise RestartAbort(f"max_iterations {w.max_iterations} reached")
+            if self.monitor_process:
+                self.monitor_process.set_iteration(iteration)
+            survivors = [
+                r
+                for r in range(state.initial_world_size)
+                if r not in set(self.ops.terminated_ranks())
+            ]
+            monitor = MonitorThread(
+                self.ops,
+                iteration,
+                main_tid,
+                abort_fn=self._abort_fn,
+                last_call_wait=w.last_call_wait,
+                poll_interval=w.monitor_thread_interval,
+            )
+            sibling = None
+            if w.enable_sibling_monitor and len(survivors) > 1:
+                sibling = SiblingMonitor(
+                    self.ops,
+                    state.initial_rank,
+                    survivors,
+                    iteration,
+                    heartbeat_interval=w.heartbeat_interval,
+                    timeout=w.sibling_timeout,
+                )
+            restart = False
+            ret = None
+            try:
+                monitor.start()
+                if sibling:
+                    sibling.start()
+                if w.initialize:
+                    w.initialize(state.freeze())
+                state.set_distributed_vars()
+                self.watchdog.ping()
+                record_event(
+                    ProfilingEvent.INPROCESS_RESTART_COMPLETED
+                    if iteration
+                    else ProfilingEvent.WORKER_STARTED,
+                    iteration=iteration, rank=state.initial_rank,
+                )
+                if state.mode == Mode.ACTIVE:
+                    if self._accepts_cw:
+                        kwargs = {**kwargs, "call_wrapper": self}
+                    ret = self.fn(*args, **kwargs)
+                    self.ops.mark_completed(iteration)
+                    return ret
+                else:
+                    ret = self._reserve_wait(iteration)
+                    if ret == "completed":
+                        return None
+                    # fall through only via RankShouldRestart
+            except RankShouldRestart:
+                monitor.mark_caught()
+                restart = True
+                log.warning(
+                    "rank %s: restart signal at iteration %s",
+                    state.initial_rank, iteration,
+                )
+            except RestartAbort:
+                raise
+            except Exception as exc:  # noqa: BLE001 - fn fault
+                monitor.mark_caught()  # stop any pending async raise first
+                state.fn_exception = exc
+                log.warning(
+                    "rank %s: exception in wrapped fn at iteration %s: %r",
+                    state.initial_rank, iteration, exc,
+                )
+                record_event(
+                    ProfilingEvent.INPROCESS_INTERRUPTED,
+                    iteration=iteration, rank=state.initial_rank, error=repr(exc),
+                )
+                self.ops.record_interruption(
+                    iteration,
+                    InterruptionRecord(
+                        rank=state.initial_rank,
+                        interruption=Interruption.EXCEPTION,
+                        message=repr(exc),
+                    ),
+                )
+                restart = True
+            finally:
+                if not restart:
+                    monitor.stop()
+                    if sibling:
+                        sibling.stop()
+
+            # ---- restart path ----
+            record_event(
+                ProfilingEvent.INPROCESS_RESTART_STARTED,
+                iteration=iteration, rank=state.initial_rank,
+            )
+            self.watchdog.ping()
+            # let the monitor thread finish abort duties, then silence it
+            monitor.tripped.wait(timeout=w.last_call_wait + 5.0)
+            monitor.mark_caught()
+            monitor.stop()
+            if sibling:
+                sibling.stop()
+            self._drain_pending_restart()
+            if w.finalize:
+                w.finalize(state.freeze())
+            try:
+                if w.health_check:
+                    w.health_check(state.freeze())
+            except HealthCheckError as exc:
+                log.error("rank %s failed restart health check: %s", state.initial_rank, exc)
+                self.ops.mark_terminated(state.initial_rank)
+                self.ops.record_interruption(
+                    iteration,
+                    InterruptionRecord(
+                        rank=state.initial_rank,
+                        interruption=Interruption.TERMINATED,
+                        message=f"health check: {exc}",
+                    ),
+                )
+                raise RestartAbort(str(exc)) from exc
+            self._iteration_barrier(iteration)
+            terminated = set(self.ops.terminated_ranks())
+            state.rank = state.initial_rank
+            state.world_size = state.initial_world_size
+            w.rank_assignment(RankAssignmentCtx(state, terminated))
+            state.advance()
+            self.watchdog.ping()
+            gc.collect()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _abort_fn(self) -> None:
+        if self.w.abort:
+            with self.atomic_lock:  # never abort inside a user atomic section
+                self.w.abort(self.state.freeze())
+
+    def _reserve_wait(self, iteration: int) -> str:
+        """INACTIVE spare: park until the job completes or a fault restarts
+        us (via RankShouldRestart from the monitor thread)."""
+        log.info(
+            "rank %s inactive at iteration %s; waiting in reserve",
+            self.state.initial_rank, iteration,
+        )
+        while True:
+            if self.ops.any_completed(iteration):
+                return "completed"
+            self.watchdog.ping()
+            time.sleep(0.2)
+
+    def _drain_pending_restart(self) -> None:
+        """Absorb an async RankShouldRestart that may already be scheduled."""
+        try:
+            time.sleep(0.05)
+        except RankShouldRestart:
+            pass
+
+    def _iteration_barrier(self, iteration: int) -> None:
+        """Barrier among survivors; re-computes the survivor set when peers
+        die mid-barrier (their monitor marks them terminated)."""
+        deadline = time.monotonic() + self.w.barrier_timeout
+        while True:
+            survivors = [
+                r
+                for r in range(self.state.initial_world_size)
+                if r not in set(self.ops.terminated_ranks())
+            ]
+            try:
+                self.ops.iteration_barrier(
+                    iteration,
+                    self.state.initial_rank,
+                    survivors,
+                    timeout=min(10.0, max(1.0, deadline - time.monotonic())),
+                )
+                return
+            except BarrierTimeout:
+                if time.monotonic() >= deadline:
+                    raise
+                log.warning(
+                    "iteration %s barrier retry (survivors may have changed)",
+                    iteration,
+                )

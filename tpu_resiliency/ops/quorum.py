@@ -4,19 +4,20 @@ North-star design (BASELINE.json): the reference's hang detection is a
 host-side socket loop with seconds-scale latency (heartbeat timeout check
 interval 5s — ``fault_tolerance/config.py:115-121``).  On TPU the pod's ICI
 fabric itself can carry the liveness signal: every chip contributes a
-monotonically increasing heartbeat stamp, one all-reduce-min over the mesh
-returns the *oldest* stamp anywhere in the pod, and any chip observing
-``now - min_stamp > budget`` knows some rank stalled — one collective
-(~µs over ICI at pod scale), no host round-trips on the hot path.
+heartbeat *age* (now - last_beat, wrap-safe int32 ms on a shared wall-clock
+epoch), one all-reduce-max over the mesh returns the staleness of the oldest
+heartbeat anywhere in the pod, and any chip observing ``max_age > budget``
+knows some rank stalled — one collective (~µs over ICI at pod scale), no
+host round-trips on the hot path.
 
 Two layers:
 
-- :func:`make_quorum_fn` — the jitted collective: per-device stamps →
-  pod-wide min stamp.  The local reduce body is a Pallas kernel on TPU
-  (``_local_min_kernel``) feeding a ``lax.pmin`` over the mesh axis; a
-  pure-jnp fallback covers CPU test meshes.  Identifying WHICH rank is stale
-  happens on the rare stale path via a host gather — keeping the hot path to
-  a single f32 all-reduce (and avoiding int64, which TPUs lack natively).
+- :func:`make_quorum_fn` — the jitted collective: per-device ages →
+  pod-wide max age.  The local reduce body is a Pallas kernel on TPU feeding
+  a ``lax.pmax`` over the mesh axis; a pure-jnp fallback covers CPU test
+  meshes.  Identifying WHICH rank is stale happens on the rare stale path
+  via a host gather — keeping the hot path to a single int32 all-reduce
+  (TPUs lack native int64, and f32 lacks ms precision at epoch magnitude).
 - :class:`QuorumMonitor` — host-side driver: publishes this process's stamp,
   runs the collective on a cadence, reports stale devices.  The host monitor
   path (RankMonitorServer) remains the source of truth: the kernel can only
@@ -63,52 +64,58 @@ def stamp_age_ms(now: int, then: int) -> int:
     return (now - then) % _WRAP
 
 
-def make_local_min(use_pallas: bool) -> Callable:
+def make_local_max(use_pallas: bool) -> Callable:
     import jax
     import jax.numpy as jnp
 
     if not use_pallas:
-        return jnp.min
+        return jnp.max
 
     from jax.experimental import pallas as pl
 
-    def kernel(stamps_ref, out_ref):
+    def kernel(ages_ref, out_ref):
         # scalar stores to VMEM are rejected; write the (1,1) tile
-        out_ref[:] = jnp.min(stamps_ref[:]).reshape(1, 1)
+        out_ref[:] = jnp.max(ages_ref[:]).reshape(1, 1)
 
-    def local_min(x):
-        # pad to the int32 min tile (8, 128)
+    def local_max(x):
+        # pad to the int32 tile (8, 128)
         n = x.shape[0]
         pad = (-n) % (8 * 128)
-        x2 = jnp.pad(x, (0, pad), constant_values=_I32_MAX).reshape(-1, 128)
+        x2 = jnp.pad(x, (0, pad), constant_values=0).reshape(-1, 128)
         rows = x2.shape[0]
         row_pad = (-rows) % 8
-        x2 = jnp.pad(x2, ((0, row_pad), (0, 0)), constant_values=_I32_MAX)
+        x2 = jnp.pad(x2, ((0, row_pad), (0, 0)), constant_values=0)
         out = pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((1, 1), x.dtype),
         )(x2)
         return out[0, 0]
 
-    return local_min
+    return local_max
 
 
 def make_quorum_fn(mesh, axis_name: Optional[str] = None, use_pallas: Optional[bool] = None) -> Callable:
     """Build the jitted quorum collective over ``mesh``.
 
-    Returns fn(stamps_ms: i32[n_total_devices]) -> min_stamp_ms (int).
-    Stamps come from :func:`now_stamp_ms` (shared wall-clock epoch).
-    All processes must call it together (it is a collective)."""
+    Returns fn(stamps_ms: i32[n_local_devices]) -> max_age_ms (int): the
+    staleness of the OLDEST heartbeat anywhere on the mesh.  The reduction
+    runs over wrap-safe *ages* (now - stamp, mod 2^31), not raw stamps — a
+    pmin over raw wrapped stamps would let a fresh post-wrap stamp mask a
+    pre-wrap hung rank for ~24.8 days.
+
+    Each process passes stamps for its OWN devices; the input global array is
+    assembled with ``make_array_from_process_local_data`` so the call works on
+    multi-host meshes.  All processes must call it together (collective)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     axis = axis_name or mesh.axis_names[0]
     if use_pallas is None:
         use_pallas = _on_tpu()
-    local_min = make_local_min(use_pallas)
+    local_max = make_local_max(use_pallas)
 
-    def _body(stamps):
-        return jax.lax.pmin(local_min(stamps), axis)
+    def _body(ages):
+        return jax.lax.pmax(local_max(ages), axis)
 
     smapped = jax.shard_map(
         _body,
@@ -118,14 +125,23 @@ def make_quorum_fn(mesh, axis_name: Optional[str] = None, use_pallas: Optional[b
         check_vma=False,  # the pallas local-reduce's out vma is opaque to the checker
     )
     sharding = NamedSharding(mesh, P(axis))
-    # single dispatch: jit owns the host->device transfer of the tiny stamp
-    # vector (an explicit device_put would add a round trip per tick)
-    jitted = jax.jit(smapped, in_shardings=sharding)
+    jitted = jax.jit(smapped)
     n_total = int(np.prod(mesh.devices.shape))
+    n_local = len(mesh.local_devices) if hasattr(mesh, "local_devices") else n_total
+    single_process = n_local == n_total
 
-    def run(stamps_ms) -> int:
-        stamps = np.asarray(stamps_ms, dtype=np.int32).reshape(n_total)
-        return int(jitted(stamps))
+    def run(local_stamps_ms) -> int:
+        now = now_stamp_ms()
+        local = np.asarray(local_stamps_ms, dtype=np.int64).reshape(n_local)
+        ages = ((now - local) % _WRAP).astype(np.int32)
+        if single_process:
+            # jit owns the tiny host->device transfer (one dispatch)
+            global_ages = ages
+        else:
+            global_ages = jax.make_array_from_process_local_data(
+                sharding, ages, (n_total,)
+            )
+        return int(jitted(global_ages))
 
     return run
 
@@ -160,21 +176,24 @@ class QuorumMonitor:
         self._thread = threading.Thread(
             target=self._loop, name="tpurx-quorum", daemon=True
         )
-        self.last_min_stamp: Optional[int] = None
+        self.last_max_age: Optional[int] = None
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
 
-    def tick(self) -> Tuple[int, int]:
-        """One collective; returns (min_stamp_ms, age_ms)."""
-        n_total = int(np.prod(self.mesh.devices.shape))
-        stamps = np.full(n_total, self._last_beat_ms, dtype=np.int32)
-        min_stamp = self._fn(stamps)
-        age = stamp_age_ms(now_stamp_ms(), min_stamp)
-        self.last_min_stamp = min_stamp
+    def tick(self) -> int:
+        """One collective; returns the pod-wide max heartbeat age (ms)."""
+        n_local = (
+            len(self.mesh.local_devices)
+            if hasattr(self.mesh, "local_devices")
+            else int(np.prod(self.mesh.devices.shape))
+        )
+        stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
+        age = self._fn(stamps)
+        self.last_max_age = age
         if age > self.budget_ms:
             self.on_stale(age)
-        return min_stamp, age
+        return age
 
     def start(self) -> "QuorumMonitor":
         self.beat()
@@ -195,8 +214,9 @@ class QuorumMonitor:
         self._thread.join(timeout=5)
 
 
-def quorum_reduce(mesh, stamps_ms) -> float:
-    """One-shot quorum collective (builds + caches the fn per mesh)."""
+def quorum_reduce(mesh, stamps_ms) -> int:
+    """One-shot quorum collective: max heartbeat age (ms) across the mesh
+    (builds + caches the fn per mesh)."""
     key = id(mesh)
     fn = _FN_CACHE.get(key)
     if fn is None:

@@ -22,11 +22,6 @@ def resolve_dtype(name: str) -> np.dtype:
     return np.dtype(getattr(ml_dtypes, name))
 
 
-def to_bytes_view(arr: np.ndarray) -> np.ndarray:
-    """Flat uint8 view of an array (zero-copy when contiguous)."""
-    return np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
-
-
 def from_bytes(raw, dtype_name: str, shape) -> np.ndarray:
     return (
         np.frombuffer(raw, dtype=resolve_dtype(dtype_name))
