@@ -1,0 +1,475 @@
+// tpurx native KV store server.
+//
+// Drop-in replacement for the Python asyncio server
+// (tpu_resiliency/store/server.py) speaking the same wire protocol
+// (tpu_resiliency/store/protocol.py):
+//
+//   request:  u8 opcode | u32 nargs | { u32 len | bytes }*
+//   response: u8 status | u32 nargs | { u32 len | bytes }*
+//
+// Architecture: single-threaded epoll event loop — every mutation is atomic
+// with respect to every other request (the same serializability argument the
+// asyncio server makes), no locks, no GIL.  Blocking ops (GET/WAIT) park a
+// waiter on the key; SET-like ops notify waiters; expiry runs off a deadline
+// heap driving the epoll timeout.
+//
+// Reference analog: the role torch's C++ TCPStore daemon plays under NVRx's
+// control plane (rendezvous CAS/counters, barriers, heartbeats) — the hot
+// spot where Python-loop latency costs pod-scale restart time.
+//
+// Build: g++ -O2 -std=c++17 -o tpurx-store-server store_server.cpp
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <deque>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  OP_SET = 1, OP_GET = 2, OP_TRY_GET = 3, OP_ADD = 4, OP_APPEND = 5,
+  OP_COMPARE_SET = 6, OP_WAIT = 7, OP_CHECK = 8, OP_DELETE = 9,
+  OP_NUM_KEYS = 10, OP_PING = 11, OP_LIST_KEYS = 12, OP_MULTI_SET = 13,
+  OP_MULTI_GET = 14,
+};
+
+enum Status : uint8_t {
+  ST_OK = 0, ST_KEY_MISS = 1, ST_TIMEOUT = 2, ST_ERROR = 3, ST_CAS_FAIL = 4,
+};
+
+using Clock = std::chrono::steady_clock;
+using Ms = std::chrono::milliseconds;
+
+struct Conn;
+
+struct Waiter {
+  Conn* conn;                       // null once cancelled
+  std::vector<std::string> keys;    // keys still missing
+  Clock::time_point deadline;
+  uint8_t op;                       // OP_GET or OP_WAIT
+  std::string get_key;              // for OP_GET
+  uint64_t id;
+};
+
+struct Conn {
+  int fd = -1;
+  std::string in;                   // read buffer
+  std::string out;                  // pending writes
+  std::unordered_set<uint64_t> waiting_ids;
+  bool closed = false;
+};
+
+struct Store {
+  std::unordered_map<std::string, std::string> data;
+  // key -> waiter ids parked on it
+  std::unordered_map<std::string, std::vector<uint64_t>> key_waiters;
+  std::unordered_map<uint64_t, Waiter> waiters;
+  std::priority_queue<
+      std::pair<Clock::time_point, uint64_t>,
+      std::vector<std::pair<Clock::time_point, uint64_t>>,
+      std::greater<>>
+      deadlines;
+  uint64_t next_waiter_id = 1;
+};
+
+Store g_store;
+int g_epfd = -1;
+
+void append_u32(std::string* s, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);  // little-endian hosts only (x86/arm64 LE)
+  s->append(b, 4);
+}
+
+void encode_response(std::string* out, uint8_t status,
+                     const std::vector<std::string>& args) {
+  out->push_back(static_cast<char>(status));
+  append_u32(out, static_cast<uint32_t>(args.size()));
+  for (const auto& a : args) {
+    append_u32(out, static_cast<uint32_t>(a.size()));
+    out->append(a);
+  }
+}
+
+void arm_write(Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->out.empty() ? 0 : EPOLLOUT);
+  ev.data.ptr = c;
+  epoll_ctl(g_epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void reply(Conn* c, uint8_t status, const std::vector<std::string>& args) {
+  encode_response(&c->out, status, args);
+  arm_write(c);
+}
+
+void notify_key(const std::string& key);
+
+void do_set(const std::string& key, const std::string& value) {
+  g_store.data[key] = value;
+  notify_key(key);
+}
+
+// ---- waiters ---------------------------------------------------------------
+
+void complete_waiter(uint64_t id, bool timed_out) {
+  auto it = g_store.waiters.find(id);
+  if (it == g_store.waiters.end()) return;
+  Waiter w = std::move(it->second);
+  g_store.waiters.erase(it);
+  if (!w.conn || w.conn->closed) return;
+  w.conn->waiting_ids.erase(id);
+  if (timed_out) {
+    reply(w.conn, ST_TIMEOUT, {});
+  } else if (w.op == OP_GET) {
+    auto d = g_store.data.find(w.get_key);
+    if (d == g_store.data.end())
+      reply(w.conn, ST_ERROR, {"key vanished"});
+    else
+      reply(w.conn, ST_OK, {d->second});
+  } else {
+    reply(w.conn, ST_OK, {});
+  }
+}
+
+void notify_key(const std::string& key) {
+  auto kit = g_store.key_waiters.find(key);
+  if (kit == g_store.key_waiters.end()) return;
+  std::vector<uint64_t> ids = std::move(kit->second);
+  g_store.key_waiters.erase(kit);
+  for (uint64_t id : ids) {
+    auto wit = g_store.waiters.find(id);
+    if (wit == g_store.waiters.end()) continue;
+    Waiter& w = wit->second;
+    // drop this key; if all satisfied, complete
+    auto& ks = w.keys;
+    for (size_t i = 0; i < ks.size();) {
+      if (g_store.data.count(ks[i]))
+        ks.erase(ks.begin() + i);
+      else
+        ++i;
+    }
+    if (ks.empty()) complete_waiter(id, /*timed_out=*/false);
+    else {
+      // re-park on a remaining missing key
+      g_store.key_waiters[ks.front()].push_back(id);
+    }
+  }
+}
+
+void park_waiter(Conn* c, uint8_t op, std::vector<std::string> missing,
+                 const std::string& get_key, int64_t timeout_ms) {
+  uint64_t id = g_store.next_waiter_id++;
+  Waiter w;
+  w.conn = c;
+  w.keys = std::move(missing);
+  w.deadline = Clock::now() + Ms(timeout_ms);
+  w.op = op;
+  w.get_key = get_key;
+  w.id = id;
+  g_store.key_waiters[w.keys.front()].push_back(id);
+  g_store.deadlines.emplace(w.deadline, id);
+  c->waiting_ids.insert(id);
+  g_store.waiters.emplace(id, std::move(w));
+}
+
+int next_timeout_ms() {
+  while (!g_store.deadlines.empty()) {
+    auto [dl, id] = g_store.deadlines.top();
+    if (!g_store.waiters.count(id)) {
+      g_store.deadlines.pop();
+      continue;
+    }
+    auto now = Clock::now();
+    if (dl <= now) return 0;
+    return static_cast<int>(
+        std::chrono::duration_cast<Ms>(dl - now).count() + 1);
+  }
+  return 1000;
+}
+
+void expire_waiters() {
+  auto now = Clock::now();
+  while (!g_store.deadlines.empty()) {
+    auto [dl, id] = g_store.deadlines.top();
+    if (dl > now) break;
+    g_store.deadlines.pop();
+    if (g_store.waiters.count(id)) complete_waiter(id, /*timed_out=*/true);
+  }
+}
+
+// ---- request handling ------------------------------------------------------
+
+bool parse_int(const std::string& s, long long* out) {
+  if (s.empty()) return false;
+  errno = 0;
+  char* end = nullptr;
+  long long v = strtoll(s.c_str(), &end, 10);
+  if (errno || end != s.c_str() + s.size()) return false;
+  *out = v;
+  return true;
+}
+
+void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
+  auto& data = g_store.data;
+  switch (op) {
+    case OP_SET: {
+      if (args.size() != 2) return reply(c, ST_ERROR, {"SET wants 2 args"});
+      do_set(args[0], args[1]);
+      return reply(c, ST_OK, {});
+    }
+    case OP_TRY_GET: {
+      if (args.size() != 1) return reply(c, ST_ERROR, {"TRY_GET wants 1 arg"});
+      auto it = data.find(args[0]);
+      if (it == data.end()) return reply(c, ST_KEY_MISS, {});
+      return reply(c, ST_OK, {it->second});
+    }
+    case OP_GET: {
+      long long timeout_ms;
+      if (args.size() != 2 || !parse_int(args[1], &timeout_ms))
+        return reply(c, ST_ERROR, {"GET wants key,timeout_ms"});
+      auto it = data.find(args[0]);
+      if (it != data.end()) return reply(c, ST_OK, {it->second});
+      park_waiter(c, OP_GET, {args[0]}, args[0], timeout_ms);
+      return;
+    }
+    case OP_ADD: {
+      long long amount, cur = 0;
+      if (args.size() != 2 || !parse_int(args[1], &amount))
+        return reply(c, ST_ERROR, {"ADD wants key,amount"});
+      auto it = data.find(args[0]);
+      if (it != data.end() && !parse_int(it->second, &cur))
+        return reply(c, ST_ERROR, {"value not an integer"});
+      long long nv = cur + amount;
+      do_set(args[0], std::to_string(nv));
+      return reply(c, ST_OK, {std::to_string(nv)});
+    }
+    case OP_APPEND: {
+      if (args.size() != 2) return reply(c, ST_ERROR, {"APPEND wants 2 args"});
+      std::string& v = data[args[0]];
+      v.append(args[1]);
+      std::string nlen = std::to_string(v.size());
+      notify_key(args[0]);
+      return reply(c, ST_OK, {nlen});
+    }
+    case OP_COMPARE_SET: {
+      if (args.size() != 3) return reply(c, ST_ERROR, {"CAS wants 3 args"});
+      auto it = data.find(args[0]);
+      bool absent_ok = (it == data.end() && args[1].empty());
+      if (absent_ok || (it != data.end() && it->second == args[1])) {
+        do_set(args[0], args[2]);
+        return reply(c, ST_OK, {args[2]});
+      }
+      return reply(c, ST_CAS_FAIL, {it == data.end() ? "" : it->second});
+    }
+    case OP_WAIT: {
+      long long timeout_ms;
+      if (args.empty() || !parse_int(args[0], &timeout_ms))
+        return reply(c, ST_ERROR, {"WAIT wants timeout_ms,keys..."});
+      std::vector<std::string> missing;
+      for (size_t i = 1; i < args.size(); ++i)
+        if (!data.count(args[i])) missing.push_back(args[i]);
+      if (missing.empty()) return reply(c, ST_OK, {});
+      park_waiter(c, OP_WAIT, std::move(missing), "", timeout_ms);
+      return;
+    }
+    case OP_CHECK: {
+      for (const auto& k : args)
+        if (!data.count(k)) return reply(c, ST_OK, {"0"});
+      return reply(c, ST_OK, {"1"});
+    }
+    case OP_DELETE: {
+      if (args.size() != 1) return reply(c, ST_ERROR, {"DELETE wants 1 arg"});
+      bool existed = data.erase(args[0]) > 0;
+      return reply(c, ST_OK, {existed ? "1" : "0"});
+    }
+    case OP_NUM_KEYS:
+      return reply(c, ST_OK, {std::to_string(data.size())});
+    case OP_PING:
+      return reply(c, ST_OK, {"pong"});
+    case OP_LIST_KEYS: {
+      std::string prefix = args.empty() ? "" : args[0];
+      std::vector<std::string> keys;
+      for (const auto& [k, _] : data)
+        if (k.rfind(prefix, 0) == 0) keys.push_back(k);
+      return reply(c, ST_OK, keys);
+    }
+    case OP_MULTI_SET: {
+      if (args.size() % 2) return reply(c, ST_ERROR, {"MULTI_SET wants pairs"});
+      for (size_t i = 0; i + 1 < args.size(); i += 2) do_set(args[i], args[i + 1]);
+      return reply(c, ST_OK, {});
+    }
+    case OP_MULTI_GET: {
+      std::vector<std::string> vals;
+      for (const auto& k : args) {
+        auto it = data.find(k);
+        if (it == data.end()) return reply(c, ST_KEY_MISS, {k});
+        vals.push_back(it->second);
+      }
+      return reply(c, ST_OK, vals);
+    }
+    default:
+      return reply(c, ST_ERROR, {"unknown op"});
+  }
+}
+
+// Try to parse one complete frame from c->in; returns false if incomplete.
+bool try_parse_frame(Conn* c) {
+  const std::string& b = c->in;
+  if (b.size() < 5) return false;
+  uint8_t op = static_cast<uint8_t>(b[0]);
+  uint32_t nargs;
+  memcpy(&nargs, b.data() + 1, 4);
+  if (nargs > 1u << 20) {  // sanity cap
+    c->closed = true;
+    return false;
+  }
+  size_t off = 5;
+  std::vector<std::string> args;
+  args.reserve(nargs);
+  for (uint32_t i = 0; i < nargs; ++i) {
+    if (b.size() < off + 4) return false;
+    uint32_t len;
+    memcpy(&len, b.data() + off, 4);
+    if (len > 1u << 30) {
+      c->closed = true;
+      return false;
+    }
+    off += 4;
+    if (b.size() < off + len) return false;
+    args.emplace_back(b.data() + off, len);
+    off += len;
+  }
+  c->in.erase(0, off);
+  if (op < OP_SET || op > OP_MULTI_GET) {
+    // unparseable stream from here on: drop the connection (matches the
+    // Python server's behavior)
+    c->closed = true;
+    return false;
+  }
+  handle_request(c, op, std::move(args));
+  return true;
+}
+
+void close_conn(Conn* c) {
+  for (uint64_t id : c->waiting_ids) {
+    auto it = g_store.waiters.find(id);
+    if (it != g_store.waiters.end()) it->second.conn = nullptr;
+  }
+  epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  delete c;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* host = "0.0.0.0";
+  int port = 29500;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (!strcmp(argv[i], "--host")) host = argv[++i];
+    else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host, &addr.sin_addr);
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(lfd, 1024) != 0) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  fprintf(stderr, "tpurx-store-server (native) listening on %s:%d\n", host,
+          ntohs(addr.sin_port));
+  fflush(stderr);
+
+  g_epfd = epoll_create1(0);
+  epoll_event lev{};
+  lev.events = EPOLLIN;
+  lev.data.ptr = nullptr;  // marks the listener
+  epoll_ctl(g_epfd, EPOLL_CTL_ADD, lfd, &lev);
+
+  std::vector<epoll_event> events(256);
+  while (true) {
+    int n = epoll_wait(g_epfd, events.data(), static_cast<int>(events.size()),
+                       next_timeout_ms());
+    expire_waiters();
+    for (int i = 0; i < n; ++i) {
+      if (events[i].data.ptr == nullptr) {
+        while (true) {
+          int cfd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          Conn* c = new Conn();
+          c->fd = cfd;
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.ptr = c;
+          epoll_ctl(g_epfd, EPOLL_CTL_ADD, cfd, &ev);
+        }
+        continue;
+      }
+      Conn* c = static_cast<Conn*>(events[i].data.ptr);
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) {
+        close_conn(c);
+        continue;
+      }
+      if (events[i].events & EPOLLIN) {
+        char buf[1 << 16];
+        while (true) {
+          ssize_t r = read(c->fd, buf, sizeof(buf));
+          if (r > 0) {
+            c->in.append(buf, static_cast<size_t>(r));
+          } else if (r == 0) {
+            c->closed = true;
+            break;
+          } else {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            c->closed = true;
+            break;
+          }
+        }
+        while (!c->closed && try_parse_frame(c)) {
+        }
+      }
+      if (!c->closed && (events[i].events & EPOLLOUT)) arm_write(c);
+      // flush pending output
+      if (!c->closed && !c->out.empty()) {
+        ssize_t wr = write(c->fd, c->out.data(), c->out.size());
+        if (wr > 0) c->out.erase(0, static_cast<size_t>(wr));
+        else if (wr < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+          c->closed = true;
+        arm_write(c);
+      }
+      if (c->closed) close_conn(c);
+    }
+  }
+  return 0;
+}

@@ -34,6 +34,15 @@ def store_server():
 
 
 @pytest.fixture
+def native_store_server():
+    from tpu_resiliency.store.native import NativeStoreServer
+
+    server = NativeStoreServer(host="127.0.0.1", port=0).start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture
 def store(store_server):
     from tpu_resiliency.store import StoreClient
 

@@ -1,0 +1,233 @@
+"""Attribution, callbacks, log funnel, state machine, control plane tests."""
+
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_resiliency.attribution import FailureCategory, LogAnalyzer
+from tpu_resiliency.fault_tolerance.state_machine import (
+    RestarterState,
+    RestartStateMachine,
+)
+from tpu_resiliency.integrations import (
+    CallbackRunner,
+    FaultToleranceCallback,
+    StragglerDetectionCallback,
+)
+from tpu_resiliency.straggler import Detector
+from tpu_resiliency.utils.log_funnel import LogForwarder, RootLogServer
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestLogAnalyzer:
+    def test_hbm_oom_no_resume(self):
+        text = (
+            "step 100 loss 3.2\n"
+            "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+            "Out of memory while trying to allocate 12884901888 bytes in hbm\n"
+        )
+        v = LogAnalyzer().analyze_text(text)
+        assert v.category == FailureCategory.OOM_HBM
+        assert v.should_resume is False
+        assert v.confidence >= 0.9
+
+    def test_device_error_resumes(self):
+        text = "[r3] INTERNAL: TPU initialization failed: device unhealthy\n"
+        v = LogAnalyzer().analyze_text(text)
+        assert v.category == FailureCategory.DEVICE_ERROR
+        assert v.should_resume is True
+        assert v.culprit_ranks == [3]
+
+    def test_hang_kill_detected(self):
+        text = "[tpurx.rank_monitor] hang detected (cycle=1 rank=2 pid=9): heartbeat gap exceeded 5.0s — terminating rank\n"
+        v = LogAnalyzer().analyze_text(text)
+        assert v.category == FailureCategory.HANG_KILL
+        assert v.should_resume is True
+
+    def test_nan_no_resume(self):
+        v = LogAnalyzer().analyze_text("Fatal: loss is NaN at step 521\n")
+        assert v.category == FailureCategory.NUMERICS
+        assert v.should_resume is False
+
+    def test_unknown_resumes(self):
+        v = LogAnalyzer().analyze_text("everything is fine\nreally\n")
+        assert v.category == FailureCategory.UNKNOWN
+        assert v.should_resume is True
+
+    def test_llm_fallback_used_when_rules_miss(self):
+        calls = []
+
+        def fake_llm(prompt):
+            calls.append(prompt)
+            return "thermal_throttle|yes|chip running hot"
+
+        # "error" keyword makes it a candidate but no rule matches
+        v = LogAnalyzer(llm_fn=fake_llm).analyze_text("weird error xyzzy-42\n")
+        assert calls
+        assert v.should_resume is True
+
+
+class TestStateMachine:
+    def test_valid_path(self):
+        sm = RestartStateMachine()
+        for s in (
+            RestarterState.INITIALIZED,
+            RestarterState.HANDLING_START,
+            RestarterState.PROCESSING,
+            RestarterState.COMPLETED,
+            RestarterState.FINALIZED,
+        ):
+            assert sm.transition(s)
+        assert sm.state == RestarterState.FINALIZED
+
+    def test_invalid_refused_not_raised(self):
+        sm = RestartStateMachine()
+        assert not sm.transition(RestarterState.PROCESSING)
+        assert sm.state == RestarterState.UNINITIALIZED
+
+    def test_in_restart(self):
+        sm = RestartStateMachine()
+        sm.transition(RestarterState.INITIALIZED)
+        sm.transition(RestarterState.HANDLING_START)
+        assert sm.in_restart
+
+
+class _FakeClient:
+    def __init__(self):
+        self.heartbeats = 0
+        self.is_initialized = False
+        self.updates = 0
+
+    def init_workload_monitoring(self):
+        self.is_initialized = True
+
+    def send_heartbeat(self):
+        self.heartbeats += 1
+
+    def calculate_and_set_hb_timeouts(self):
+        self.updates += 1
+
+    def state_dict(self):
+        return {"hb_timeouts": None, "section_timeouts": None}
+
+    def load_state_dict(self, s):
+        pass
+
+    def shutdown_workload_monitoring(self):
+        self.is_initialized = False
+
+
+def test_fault_tolerance_callback(tmp_path):
+    client = _FakeClient()
+    cb = FaultToleranceCallback(
+        client=client, state_path=str(tmp_path / "ft.json"),
+        warmup_steps=3, update_interval=4,
+    )
+    runner = CallbackRunner([cb])
+    runner.on_train_start()
+    assert client.is_initialized
+    for step in range(10):
+        runner.on_step_end(step=step)
+    assert client.heartbeats >= 10
+    assert client.updates >= 1
+    runner.on_train_end()
+    assert (tmp_path / "ft.json").exists()
+    assert not client.is_initialized
+
+
+def test_straggler_callback_reports():
+    flagged = []
+    cb = StragglerDetectionCallback(
+        detector=Detector(report_interval=4),
+        on_straggler=lambda v: flagged.append(v.rank),
+    )
+    runner = CallbackRunner([cb])
+    runner.on_train_start()
+    for step in range(8):
+        runner.on_step_start(step=step)
+        time.sleep(0.001)
+        runner.on_step_end(step=step)
+    assert cb.last_report is not None  # single rank: report exists, no flags
+
+
+def test_callback_exceptions_do_not_kill_training():
+    class Bad(FaultToleranceCallback):
+        def __init__(self):
+            pass
+
+        def on_step_end(self, **ctx):
+            raise RuntimeError("boom")
+
+    runner = CallbackRunner([Bad()])
+    runner.on_step_end(step=1)  # must not raise
+
+
+def test_log_funnel_roundtrip(tmp_path):
+    root = RootLogServer(str(tmp_path / "cluster.log"), host="127.0.0.1")
+    import logging
+
+    logger = logging.getLogger("funnel-test")
+    logger.setLevel(logging.INFO)
+    fwd = LogForwarder("127.0.0.1", root.port, source="nodeA", batch_age=0.1)
+    fwd.setFormatter(logging.Formatter("%(message)s"))
+    logger.addHandler(fwd)
+    for i in range(25):
+        logger.info("line %d", i)
+    time.sleep(0.5)
+    fwd.close()
+    logger.removeHandler(fwd)
+    root.close()
+    content = (tmp_path / "cluster.log").read_text()
+    assert "[nodeA] line 0" in content
+    assert "[nodeA] line 24" in content
+
+
+def test_control_plane_with_external_launchers(tmp_path):
+    """Launchers as pure store clients against a standalone control plane."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    import os
+
+    env = dict(os.environ)
+    env.update({"TPURX_REPO": str(REPO), "TOY_ITERS": "6",
+                "TOY_CKPT": str(tmp_path / "p.txt"),
+                "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0"})
+    cp = subprocess.Popen(
+        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.control_plane",
+         "--host", "127.0.0.1", "--port", str(port), "--min-nodes", "2",
+         "--settle-time", "0.3"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(1.5)
+    launchers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+             "--nnodes", "2", "--nproc-per-node", "1",
+             "--rdzv-endpoint", f"127.0.0.1:{port}",
+             "--node-id", f"n{i}", "--monitor-interval", "0.05",
+             str(REPO / "tests" / "workloads" / "toy_train.py")],
+            cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in launchers:
+        out, _ = p.communicate(timeout=90)
+        outs.append(out)
+    cp_out, _ = cp.communicate(timeout=30)
+    if any(p.returncode != 0 for p in launchers) or cp.returncode != 0:
+        print("CP:", cp_out[-2000:])
+        for i, o in enumerate(outs):
+            print(f"L{i}:", o[-2000:])
+    assert all(p.returncode == 0 for p in launchers)
+    assert cp.returncode == 0
+    assert int((tmp_path / "p.txt").read_text()) == 6

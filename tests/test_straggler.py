@@ -1,0 +1,125 @@
+"""Straggler detection tests (reference analog: tests/straggler/unit/* with
+synthetic timing data + a live multi-threaded gather)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_resiliency.straggler import Detector, Report
+from tpu_resiliency.straggler.timers import DurationStore, SectionStats
+from tpu_resiliency.store import StoreClient
+
+
+def make_stats(name, base, n=11):
+    return SectionStats.from_samples(name, [base * (1 + 0.01 * i) for i in range(n)])
+
+
+class TestScoring:
+    def test_section_stats(self):
+        st = SectionStats.from_samples("s", [1.0, 2.0, 3.0, 4.0, 5.0])
+        assert st.count == 5
+        assert st.median == 3.0
+        assert st.min == 1.0 and st.max == 5.0
+        assert st.avg == 3.0
+
+    def test_relative_scores_flag_slow_rank(self):
+        # rank 2 is 2x slower on the dominant op
+        per_rank = {
+            0: {"step": make_stats("step", 0.10), "io": make_stats("io", 0.01)},
+            1: {"step": make_stats("step", 0.11), "io": make_stats("io", 0.01)},
+            2: {"step": make_stats("step", 0.20), "io": make_stats("io", 0.01)},
+        }
+        report = Report(0, section_stats={}, device_stats=per_rank)
+        scores = report.relative_device_scores()
+        assert scores[0] > 0.95
+        assert scores[2] < 0.6
+        verdicts = report.identify_stragglers(relative_threshold=0.7)
+        flagged = [v.rank for v in verdicts if v.is_straggler]
+        assert flagged == [2]
+
+    def test_weighting_by_total_time(self):
+        # rank 1 slow only on a negligible op -> not a straggler
+        per_rank = {
+            0: {"step": make_stats("step", 0.10), "tiny": make_stats("tiny", 0.001)},
+            1: {"step": make_stats("step", 0.10), "tiny": make_stats("tiny", 0.01)},
+        }
+        report = Report(0, {}, per_rank)
+        scores = report.relative_device_scores()
+        assert scores[1] > 0.85
+
+    def test_individual_scores(self):
+        current = {"step": make_stats("step", 0.2)}
+        history = {"step": 0.1}
+        score = Report.individual_scores(current, history)
+        assert score == pytest.approx(0.5, rel=0.05)
+        assert Report.individual_scores({}, {}) is None
+
+    def test_disjoint_names_across_ranks(self):
+        per_rank = {
+            0: {"a": make_stats("a", 0.1)},
+            1: {"b": make_stats("b", 0.1)},
+        }
+        report = Report(0, {}, per_rank)
+        scores = report.relative_device_scores()
+        assert scores[0] == pytest.approx(1.0)
+        assert scores[1] == pytest.approx(1.0)
+
+
+def test_detector_sections_and_device_wrap():
+    det = Detector(report_interval=4)
+    det.initialize()
+
+    @jax.jit
+    def fn(x):
+        return (x @ x).sum()
+
+    wrapped = det.wrap_callables({"matmul": fn})["matmul"]
+    x = jnp.ones((64, 64))
+    report = None
+    for i in range(8):
+        with det.detection_section("host_work"):
+            time.sleep(0.002)
+        wrapped(x)
+        report = report or det.maybe_report()
+    assert report is not None
+    assert "host_work" in report.section_stats[0]
+    assert "matmul" in report.device_stats[0]
+    assert report.device_stats[0]["matmul"].count >= 4
+    assert det.individual_score() is not None
+
+
+def test_multi_rank_gather_flags_straggler(store_server):
+    world = 3
+    results = {}
+
+    def member(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=20.0)
+        det = Detector(
+            store=store, rank=rank, world_size=world,
+            report_interval=5, gather_on_rank0=True,
+        )
+        det.initialize()
+        delay = 0.03 if rank == 1 else 0.01   # rank 1 is the straggler
+        report = None
+        for _ in range(5):
+            with det.detection_section("step"):
+                time.sleep(delay)
+            r = det.maybe_report()
+            report = r or report
+        results[rank] = report
+        store.close()
+
+    threads = [threading.Thread(target=member, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert results[1] is None and results[2] is None  # gather_on_rank0
+    report = results[0]
+    assert report is not None
+    verdicts = report.identify_stragglers(relative_threshold=0.7)
+    flagged = [v.rank for v in verdicts if v.is_straggler]
+    assert flagged == [1]

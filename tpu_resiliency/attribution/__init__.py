@@ -1,0 +1,20 @@
+"""Failure attribution (reference: ``attribution/`` minus straggler).
+
+- :class:`AttributionPipeline` — composable preprocess → attribute →
+  postprocess pipeline (reference ``base.py:95-300``).
+- :mod:`log_analyzer` — rule-based error extraction + root-cause + resume
+  verdict from worker/cycle logs (the reference's LogSage/LLM analyzer is an
+  optional extra there too; the rule engine is the always-on layer, and an
+  LLM backend can be injected as a callable).
+"""
+
+from .base import AttributionPipeline, AttributionResult
+from .log_analyzer import LogAnalyzer, FailureCategory, AnalysisVerdict
+
+__all__ = [
+    "AttributionPipeline",
+    "AttributionResult",
+    "LogAnalyzer",
+    "FailureCategory",
+    "AnalysisVerdict",
+]

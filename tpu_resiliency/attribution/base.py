@@ -1,0 +1,54 @@
+"""Attribution pipeline framework (reference ``attribution/base.py:95-300``).
+
+A pipeline is preprocess* → attribute → postprocess*: callables chained over
+a typed payload, each stage able to annotate the shared context.  Stages are
+plain callables ``(payload, ctx) -> payload``; the attribute stage returns an
+:class:`AttributionResult`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("attribution")
+
+
+@dataclasses.dataclass
+class AttributionResult:
+    category: str
+    confidence: float
+    culprit_ranks: List[int] = dataclasses.field(default_factory=list)
+    summary: str = ""
+    evidence: List[str] = dataclasses.field(default_factory=list)
+    should_resume: bool = True
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class AttributionPipeline:
+    def __init__(
+        self,
+        attribute: Callable[[Any, Dict], AttributionResult],
+        preprocess: Optional[List[Callable[[Any, Dict], Any]]] = None,
+        postprocess: Optional[List[Callable[[AttributionResult, Dict], AttributionResult]]] = None,
+        name: str = "attribution",
+    ):
+        self.name = name
+        self.preprocess = preprocess or []
+        self.attribute = attribute
+        self.postprocess = postprocess or []
+
+    def run(self, payload: Any, ctx: Optional[Dict] = None) -> AttributionResult:
+        ctx = ctx if ctx is not None else {}
+        ctx.setdefault("pipeline", self.name)
+        ctx.setdefault("started_at", time.time())
+        for stage in self.preprocess:
+            payload = stage(payload, ctx)
+        result = self.attribute(payload, ctx)
+        for stage in self.postprocess:
+            result = stage(result, ctx)
+        ctx["finished_at"] = time.time()
+        return result

@@ -51,6 +51,8 @@ class FaultToleranceConfig:
     # --- progress tracking ---
     enable_progress_tracking: bool = True
     progress_iteration_file: Optional[str] = None
+    # --- attribution gate (restart decisions consult the log analyzer) ---
+    enable_attribution_gate: bool = False
     # --- logging / observability ---
     log_level: str = "INFO"
     per_cycle_log_dir: Optional[str] = None

@@ -1,0 +1,100 @@
+"""Standalone control-plane process (reference ``control_plane.py:266``,
+CLI ``nvrx-control``).
+
+Hosts the KV store + the rendezvous round loop outside any compute node, so
+launchers are pure store clients: the control plane survives every compute
+node dying, and job-level restarts (new SLURM/GKE job, same control plane)
+keep cycle numbering and rendezvous state.
+
+    python -m tpu_resiliency.fault_tolerance.control_plane \
+        --port 29500 --min-nodes 2 --max-nodes 4
+
+Launchers then run WITHOUT ``--host-store``, pointing at this endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+from ..store import StoreClient, StoreServer
+from ..utils.logging import get_logger, setup_logger
+from .launcher import HostRoundLoop
+from .rendezvous import K_SHUTDOWN, RendezvousHost
+
+log = get_logger("control_plane")
+
+
+def run(
+    host: str,
+    port: int,
+    min_nodes: int,
+    max_nodes: int | None,
+    round_timeout: float,
+    settle_time: float,
+    native: bool = False,
+) -> int:
+    if native:
+        from ..store.native import NativeStoreServer
+
+        server = NativeStoreServer(host=host, port=port).start()
+    else:
+        server = StoreServer(host=host, port=port).start_in_thread()
+    client = StoreClient("127.0.0.1", server.port, timeout=round_timeout)
+    rdzv = RendezvousHost(
+        client, min_nodes=min_nodes, max_nodes=max_nodes, settle_time=settle_time
+    )
+    loop = HostRoundLoop(rdzv, round_timeout)
+    loop.start()
+    log.info(
+        "control plane up on %s:%s (min_nodes=%s max_nodes=%s)",
+        host, server.port, min_nodes, max_nodes,
+    )
+    stop = {"flag": False}
+
+    def _sig(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            shutdown = client.try_get(K_SHUTDOWN)
+            if shutdown is not None:
+                log.info("workload shut down: %s", shutdown.decode())
+                # linger so late agents can observe the flag
+                time.sleep(5.0)
+                return 0 if shutdown == b"success" else 1
+            time.sleep(0.5)
+        return 0
+    finally:
+        loop.stop()
+        server.stop()
+
+
+def main(argv=None) -> None:
+    setup_logger()
+    p = argparse.ArgumentParser(prog="tpurx-control")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=29500)
+    p.add_argument("--min-nodes", type=int, default=1)
+    p.add_argument("--max-nodes", type=int, default=None)
+    p.add_argument("--round-timeout", type=float, default=600.0)
+    p.add_argument("--settle-time", type=float, default=2.0)
+    p.add_argument(
+        "--native-store", action="store_true",
+        help="serve the KV store from the C++ epoll server",
+    )
+    args = p.parse_args(argv)
+    sys.exit(
+        run(
+            args.host, args.port, args.min_nodes, args.max_nodes,
+            args.round_timeout, args.settle_time, native=args.native_store,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

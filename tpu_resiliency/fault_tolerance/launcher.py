@@ -172,9 +172,16 @@ class ElasticAgent:
 
     def _setup_store(self) -> None:
         if self.host_store:
-            self._store_server = StoreServer(
-                host="0.0.0.0", port=self.store_port
-            ).start_in_thread()
+            if os.environ.get("TPURX_NATIVE_STORE", "").lower() in ("1", "true", "yes"):
+                from ..store.native import NativeStoreServer
+
+                self._store_server = NativeStoreServer(
+                    host="0.0.0.0", port=self.store_port
+                ).start()
+            else:
+                self._store_server = StoreServer(
+                    host="0.0.0.0", port=self.store_port
+                ).start_in_thread()
             self.store_port = self._store_server.port
         self.store = StoreClient(
             self.store_addr, self.store_port, timeout=self.cfg.rdzv_round_timeout
@@ -406,6 +413,11 @@ class ElasticAgent:
                     cycle=result.cycle,
                     failed=[[r, c] for r, c in failed],
                 )
+                # Stop workers FIRST so the per-cycle pipe readers drain the
+                # dying ranks' final output (tracebacks) before the
+                # attribution gate reads the cycle log.
+                self._stop_workers()
+                time.sleep(0.2)  # reader threads flush after pipe EOF
                 if not self._restart_allowed():
                     self.store.set(K_SHUTDOWN, "restart budget exhausted")
                     return "shutdown"
@@ -423,11 +435,43 @@ class ElasticAgent:
                 self.progress.no_progress_cycles,
             )
             return False
+        if not self._attribution_gate_allows():
+            return False
         if self.max_restarts > 0:
             if self.remaining_restarts <= 0:
                 log.error("restart budget exhausted (%s)", self.max_restarts)
                 return False
             self.remaining_restarts -= 1
+        return True
+
+    def _attribution_gate_allows(self) -> bool:
+        """Consult the log analyzer before burning a restart on a failure
+        that cannot succeed (OOM, NaN, bad data) — reference
+        ``attribution_manager.py`` gate."""
+        if not self.cfg.enable_attribution_gate or not self.cfg.per_cycle_log_dir:
+            return True
+        cycle = self._result.cycle if self._result else 0
+        path = os.path.join(self.cfg.per_cycle_log_dir, f"cycle_{cycle}.log")
+        if not os.path.exists(path):
+            return True
+        try:
+            from ..attribution import LogAnalyzer
+
+            verdict = LogAnalyzer().analyze_file(path)
+        except Exception:  # noqa: BLE001 - the gate must never block recovery
+            log.exception("attribution gate failed; allowing restart")
+            return True
+        log.info(
+            "attribution: category=%s resume=%s confidence=%.2f (%s)",
+            verdict.category.value, verdict.should_resume,
+            verdict.confidence, verdict.summary,
+        )
+        if not verdict.should_resume and verdict.confidence >= 0.8:
+            log.error(
+                "attribution gate: %s is not survivable by restart — stopping",
+                verdict.category.value,
+            )
+            return False
         return True
 
     def _teardown(self) -> None:

@@ -1,0 +1,68 @@
+"""Restart-protocol state machine for layered (nested) restart observability.
+
+Capability parity with ``fault_tolerance/rank_monitor_state_machine.py:35-131``:
+tracks which phase of the in-process restart protocol a rank is in, so the
+launcher-ring monitor knows an in-process restart is underway and must NOT
+kill the rank for missing heartbeats mid-recovery.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, Set
+
+from ..utils.logging import get_logger
+
+log = get_logger("restart_state_machine")
+
+
+class RestarterState(str, enum.Enum):
+    UNINITIALIZED = "uninitialized"
+    INITIALIZED = "initialized"
+    HANDLING_START = "handling_start"   # fault observed, restart beginning
+    PROCESSING = "processing"           # abort/finalize/barrier in progress
+    COMPLETED = "completed"             # restart finished, fn re-entered
+    FINALIZED = "finalized"             # wrapper exited cleanly
+    ABORTED = "aborted"                 # wrapper gave up (RestartAbort)
+
+
+_TRANSITIONS: Dict[RestarterState, Set[RestarterState]] = {
+    RestarterState.UNINITIALIZED: {RestarterState.INITIALIZED},
+    RestarterState.INITIALIZED: {
+        RestarterState.HANDLING_START,
+        RestarterState.FINALIZED,
+        RestarterState.ABORTED,
+    },
+    RestarterState.HANDLING_START: {RestarterState.PROCESSING, RestarterState.ABORTED},
+    RestarterState.PROCESSING: {RestarterState.COMPLETED, RestarterState.ABORTED},
+    RestarterState.COMPLETED: {
+        RestarterState.HANDLING_START,
+        RestarterState.FINALIZED,
+        RestarterState.ABORTED,
+    },
+    RestarterState.FINALIZED: set(),
+    RestarterState.ABORTED: set(),
+}
+
+
+class RestartStateMachine:
+    def __init__(self):
+        self.state = RestarterState.UNINITIALIZED
+
+    def transition(self, new_state: RestarterState) -> bool:
+        """Apply a transition; invalid ones are logged and refused (a garbled
+        observability signal must never crash the monitored rank)."""
+        if new_state == self.state:
+            return True
+        if new_state not in _TRANSITIONS[self.state]:
+            log.warning(
+                "invalid restarter transition %s -> %s ignored",
+                self.state.value, new_state.value,
+            )
+            return False
+        self.state = new_state
+        return True
+
+    @property
+    def in_restart(self) -> bool:
+        return self.state in (RestarterState.HANDLING_START, RestarterState.PROCESSING)

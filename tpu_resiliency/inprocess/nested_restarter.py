@@ -1,0 +1,80 @@
+"""Nested-restart bridge: in-process events → launcher-ring monitor.
+
+Capability parity with ``inprocess/nested_restarter.py:28-107``: when the
+in-process wrapper runs UNDER the elastic launcher ("layered restart"), the
+rank monitor must learn that a restart is in progress so it does not treat
+the quiet heartbeat gap as a hang and kill the recovering rank.
+
+The bridge reports phase transitions as **section messages** on the existing
+RankMonitorClient channel: an open ``inprocess_restart`` section tells the
+monitor "busy recovering" and its (configurable) timeout bounds how long an
+in-process recovery may take before the in-job ring takes over — exactly the
+ring-composition contract from SURVEY.md §1.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..fault_tolerance.state_machine import RestarterState, RestartStateMachine
+from ..utils.logging import get_logger
+
+log = get_logger("nested_restarter")
+
+SECTION_NAME = "inprocess_restart"
+
+
+class NestedRestarterCallback:
+    """Attach to a Wrapper via its plugin hooks:
+
+        bridge = NestedRestarterCallback(rank_monitor_client)
+        Wrapper(initialize=bridge.on_initialize, abort=bridge.on_abort, ...)
+    """
+
+    def __init__(self, rank_monitor_client=None):
+        self.client = rank_monitor_client
+        self.machine = RestartStateMachine()
+        self._section_open = False
+
+    def _log_state(self) -> None:
+        # the reference emits a parseable log protocol; keep that contract
+        log.info("[NestedRestarter] name=[InProcess] state=%s", self.machine.state.value)
+
+    def _open_section(self) -> None:
+        if self.client is not None and not self._section_open:
+            try:
+                self.client.start_section(SECTION_NAME)
+                self._section_open = True
+            except Exception:  # noqa: BLE001
+                log.warning("could not open restart section on rank monitor")
+
+    def _close_section(self) -> None:
+        if self.client is not None and self._section_open:
+            try:
+                self.client.end_section(SECTION_NAME)
+            except Exception:  # noqa: BLE001
+                pass
+            self._section_open = False
+
+    # -- Wrapper plugin hooks ---------------------------------------------
+
+    def on_initialize(self, state):
+        if self.machine.state == RestarterState.UNINITIALIZED:
+            self.machine.transition(RestarterState.INITIALIZED)
+        else:
+            # re-initialize after a restart: recovery finished
+            self.machine.transition(RestarterState.COMPLETED)
+            self._close_section()
+        self._log_state()
+        return state
+
+    def on_abort(self, state):
+        self.machine.transition(RestarterState.HANDLING_START)
+        self._open_section()
+        self._log_state()
+        return state
+
+    def on_finalize(self, state):
+        self.machine.transition(RestarterState.PROCESSING)
+        self._log_state()
+        return state

@@ -1,0 +1,31 @@
+"""Training-loop integrations (reference: ``ptl_resiliency/``).
+
+The reference binds to PyTorch Lightning; JAX has no single dominant loop, so
+the integration surface is a small callback protocol (``on_train_start`` /
+``on_step_end`` / ``on_checkpoint`` / ``on_train_end``) that drops into any
+custom loop, plus prebuilt callbacks mirroring the reference's:
+
+- :class:`FaultToleranceCallback` — heartbeats + calculated timeouts
+  (``fault_tolerance_callback.py:169``)
+- :class:`FaultToleranceSectionsCallback` — section-based variant
+- :class:`StragglerDetectionCallback` — detector lifecycle + report logging
+- :class:`LocalCheckpointCallback` — hierarchical local/global save + resume
+"""
+
+from .callbacks import (
+    Callback,
+    CallbackRunner,
+    FaultToleranceCallback,
+    FaultToleranceSectionsCallback,
+    LocalCheckpointCallback,
+    StragglerDetectionCallback,
+)
+
+__all__ = [
+    "Callback",
+    "CallbackRunner",
+    "FaultToleranceCallback",
+    "FaultToleranceSectionsCallback",
+    "StragglerDetectionCallback",
+    "LocalCheckpointCallback",
+]

@@ -1,0 +1,238 @@
+"""Training-loop callbacks.
+
+Reference analogs live in ``ptl_resiliency/``: heartbeat callback (``:169``),
+sections callback, straggler callback, local-checkpoint callback — rebuilt on
+a loop-agnostic protocol.  Use:
+
+    runner = CallbackRunner([FaultToleranceCallback(), ...])
+    runner.on_train_start(step=start_step)
+    for step in range(start_step, total):
+        ...
+        runner.on_step_end(step=step)
+    runner.on_train_end()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("integrations")
+
+
+class Callback:
+    def on_train_start(self, **ctx) -> None: ...
+    def on_step_start(self, **ctx) -> None: ...
+    def on_step_end(self, **ctx) -> None: ...
+    def on_checkpoint_start(self, **ctx) -> None: ...
+    def on_checkpoint_end(self, **ctx) -> None: ...
+    def on_train_end(self, **ctx) -> None: ...
+    def on_exception(self, **ctx) -> None: ...
+
+
+class CallbackRunner:
+    def __init__(self, callbacks: List[Callback]):
+        self.callbacks = callbacks
+
+    def _fire(self, hook: str, **ctx) -> None:
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(**ctx)
+            except Exception:  # noqa: BLE001 - callbacks must not kill training
+                log.exception("callback %s.%s failed", type(cb).__name__, hook)
+
+    def __getattr__(self, name: str):
+        if name.startswith("on_"):
+            return lambda **ctx: self._fire(name, **ctx)
+        raise AttributeError(name)
+
+
+class _TrainingStateMachine:
+    """Decides when calculated timeouts may be updated (reference
+    ``fault_tolerance_callback.py:45-169``): only after a clean run of
+    ``warmup_steps`` steps with no fault in between — otherwise a slow
+    faulty epoch would inflate the learned timeouts."""
+
+    def __init__(self, warmup_steps: int = 16):
+        self.warmup_steps = warmup_steps
+        self.clean_steps = 0
+        self.seen_fault = False
+
+    def on_step(self) -> None:
+        self.clean_steps += 1
+
+    def on_fault(self) -> None:
+        self.seen_fault = True
+        self.clean_steps = 0
+
+    @property
+    def can_update_timeouts(self) -> bool:
+        return self.clean_steps >= self.warmup_steps
+
+
+class FaultToleranceCallback(Callback):
+    """Heartbeat on every step; push calculated timeouts after a clean warmup;
+    persist them next to checkpoints so restarts keep learned budgets."""
+
+    def __init__(
+        self,
+        client=None,
+        state_path: Optional[str] = None,
+        warmup_steps: int = 16,
+        update_interval: int = 64,
+    ):
+        from ..fault_tolerance import RankMonitorClient
+
+        self.client = client or RankMonitorClient()
+        self.state_path = state_path
+        self.machine = _TrainingStateMachine(warmup_steps)
+        self.update_interval = update_interval
+        self._last_update_step = -1
+
+    def on_train_start(self, **ctx) -> None:
+        if not self.client.is_initialized:
+            if self.state_path and os.path.exists(self.state_path):
+                import json
+
+                with open(self.state_path) as f:
+                    self.client.load_state_dict(json.load(f))
+            self.client.init_workload_monitoring()
+        self.client.send_heartbeat()
+
+    def on_step_end(self, step: int = 0, **ctx) -> None:
+        self.client.send_heartbeat()
+        self.machine.on_step()
+        if (
+            self.machine.can_update_timeouts
+            and step - self._last_update_step >= self.update_interval
+        ):
+            self._last_update_step = step
+            try:
+                self.client.calculate_and_set_hb_timeouts()
+                self._persist()
+            except Exception:  # noqa: BLE001
+                log.exception("timeout update failed")
+
+    def on_exception(self, **ctx) -> None:
+        self.machine.on_fault()
+
+    def on_train_end(self, **ctx) -> None:
+        self._persist()
+        self.client.shutdown_workload_monitoring()
+
+    def _persist(self) -> None:
+        if not self.state_path:
+            return
+        import json
+
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.client.state_dict(), f)
+        os.replace(tmp, self.state_path)
+
+
+class FaultToleranceSectionsCallback(Callback):
+    """Section-based variant: ``setup`` / ``step`` / ``checkpointing``
+    sections (reference ``fault_tolerance_sections_callback.py``)."""
+
+    def __init__(self, client=None):
+        from ..fault_tolerance import RankMonitorClient
+
+        self.client = client or RankMonitorClient()
+        self._in_setup = False
+
+    def on_train_start(self, **ctx) -> None:
+        if not self.client.is_initialized:
+            self.client.init_workload_monitoring()
+        self.client.start_section("setup")
+        self._in_setup = True
+
+    def on_step_start(self, **ctx) -> None:
+        if self._in_setup:
+            self.client.end_section("setup")
+            self._in_setup = False
+        self.client.start_section("step")
+
+    def on_step_end(self, **ctx) -> None:
+        self.client.end_section("step")
+
+    def on_checkpoint_start(self, **ctx) -> None:
+        self.client.start_section("checkpointing")
+
+    def on_checkpoint_end(self, **ctx) -> None:
+        self.client.end_section("checkpointing")
+
+    def on_train_end(self, **ctx) -> None:
+        if self._in_setup:
+            self.client.end_section("setup")
+        self.client.shutdown_workload_monitoring()
+
+
+class StragglerDetectionCallback(Callback):
+    """Detector lifecycle + report logging (reference
+    ``straggler_det_callback.py``)."""
+
+    def __init__(self, detector=None, relative_threshold: float = 0.7, on_straggler=None):
+        from ..straggler import Detector
+
+        self.detector = detector or Detector()
+        self.relative_threshold = relative_threshold
+        self.on_straggler = on_straggler
+        self.last_report = None
+
+    def on_train_start(self, **ctx) -> None:
+        self.detector.initialize()
+
+    def on_step_start(self, **ctx) -> None:
+        self._section = self.detector.detection_section("step")
+        self._section.__enter__()
+
+    def on_step_end(self, **ctx) -> None:
+        self._section.__exit__(None, None, None)
+        report = self.detector.maybe_report()
+        if report is not None:
+            self.last_report = report
+            verdicts = report.identify_stragglers(self.relative_threshold)
+            for v in verdicts:
+                if v.is_straggler:
+                    log.warning(
+                        "STRAGGLER: rank %s relative=%.3f individual=%s",
+                        v.rank, v.relative_score, v.individual_score,
+                    )
+                    if self.on_straggler:
+                        self.on_straggler(v)
+
+    def on_train_end(self, **ctx) -> None:
+        self.detector.shutdown()
+
+
+class LocalCheckpointCallback(Callback):
+    """Hierarchical checkpointing glue (reference
+    ``local_checkpoint_callback.py`` + ``HierarchicalCheckpointIO``): save
+    node-local every ``local_interval`` steps (fast, replicated), rely on the
+    caller's global saves for durability; ``resume()`` prefers the freshest
+    fully-covered local checkpoint over the global one."""
+
+    def __init__(self, manager, get_state, local_interval: int = 50):
+        self.manager = manager
+        self.get_state = get_state
+        self.local_interval = local_interval
+
+    def on_step_end(self, step: int = 0, **ctx) -> None:
+        if step > 0 and step % self.local_interval == 0:
+            self.manager.save(self.get_state(), iteration=step, is_async=True)
+
+    def on_train_end(self, **ctx) -> None:
+        self.manager.wait()
+
+    def resume(self, template, global_iteration: Optional[int] = None):
+        """Returns (tree, iteration, source) — local wins if fresher."""
+        local_it = self.manager.find_latest()
+        if local_it is not None and (
+            global_iteration is None or local_it > global_iteration
+        ):
+            tree, it = self.manager.load(template, iteration=local_it)
+            return tree, it, "local"
+        return None, global_iteration, "global"

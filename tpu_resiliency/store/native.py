@@ -1,0 +1,100 @@
+"""Native (C++) store server loader.
+
+Builds ``native/store_server.cpp`` on first use (cached binary) and runs it
+as a subprocess.  Same wire protocol, same client — the native server is a
+drop-in for the asyncio one where control-plane latency/fan-in matters
+(rendezvous CAS storms at pod scale).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import time
+from typing import List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("store.native")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+
+
+def native_binary_path() -> str:
+    return os.path.abspath(os.path.join(_NATIVE_DIR, "tpurx-store-server"))
+
+
+def build_native_server(force: bool = False) -> str:
+    """Compile the native server if needed; returns the binary path."""
+    binary = native_binary_path()
+    src = os.path.abspath(os.path.join(_NATIVE_DIR, "store_server.cpp"))
+    if (
+        not force
+        and os.path.exists(binary)
+        and os.path.getmtime(binary) >= os.path.getmtime(src)
+    ):
+        return binary
+    log.info("building native store server...")
+    subprocess.run(
+        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return binary
+
+
+class NativeStoreServer:
+    """Runs the C++ server as a child process (same surface as StoreServer)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self.host = host
+        self.port = port
+        self._proc: Optional[subprocess.Popen] = None
+
+    def start(self, timeout: float = 15.0) -> "NativeStoreServer":
+        import select
+
+        binary = build_native_server()
+        self._proc = subprocess.Popen(
+            [binary, "--host", self.host, "--port", str(self.port)],
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        try:
+            # the server prints "... listening on <host>:<port>" once bound;
+            # bound readline so a wedged child honors the timeout
+            ready, _, _ = select.select([self._proc.stderr], [], [], timeout)
+            line = self._proc.stderr.readline() if ready else ""
+            m = re.search(r"listening on \S+:(\d+)", line or "")
+            if not m:
+                raise RuntimeError(f"native store server failed to start: {line!r}")
+            self.port = int(m.group(1))
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if self._proc.poll() is not None:
+                    raise RuntimeError("native store server exited at startup")
+                try:
+                    from .client import StoreClient
+
+                    StoreClient("127.0.0.1", self.port, connect_timeout=1.0).close()
+                    return self
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.05)
+            raise RuntimeError("native store server did not accept connections")
+        except BaseException:
+            self.stop()  # never leak the child holding the port
+            raise
+
+    # parity with StoreServer
+    start_in_thread = start
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None

@@ -1,0 +1,19 @@
+"""Straggler detection (reference: ``attribution/straggler/``).
+
+Per-rank performance monitoring: time CPU sections and device-bound jitted
+callables, synchronize reports across ranks on a fixed cadence, score each
+rank relative to the fastest peer and to its own history, and flag
+stragglers.
+
+TPU re-design: the reference's CUPTI C++ kernel tracer becomes a
+**device-section timer** — wrapped jitted callables are timed to completion
+(``block_until_ready``) so the measurement is device time, not dispatch time
+(XLA's async dispatch makes raw wall timing meaningless).  The scoring and
+reporting semantics match ``reporting.py:219-253``.
+"""
+
+from .detector import Detector
+from .reporting import Report, StragglerVerdict
+from .timers import SectionStats
+
+__all__ = ["Detector", "Report", "StragglerVerdict", "SectionStats"]

@@ -1,0 +1,166 @@
+"""Straggler detector façade (reference ``straggler/straggler.py:86-368``).
+
+Usage:
+
+    det = Detector(store=..., rank=r, world_size=w, scores_to_compute=...)
+    det.initialize()
+    step_fn = det.wrap_callables({"train_step": step_fn})["train_step"]
+    for batch in data:
+        with det.detection_section("data"):
+            batch = next(it)
+        loss = step_fn(...)
+        report = det.maybe_report()      # None until the cadence fires
+        if report is not None and det.rank == 0:
+            for v in report.identify_stragglers():
+                ...
+
+Cross-rank gathering rides the KV store (one payload write per rank per
+round + reads by rank 0 — the reference gathers over NCCL/Gloo,
+``dist_utils.py:85``).  ``gather_on_rank0=False`` gives every rank the full
+report (all ranks read all payloads).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+from ..store.barrier import barrier
+from ..utils.logging import get_logger
+from ..utils.profiling import ProfilingEvent, record_event
+from .interval_tracker import ReportIntervalTracker
+from .reporting import Report
+from .timers import DeviceTimer, DurationStore
+from .name_mapper import NameMapper
+
+log = get_logger("straggler")
+
+
+class Detector:
+    def __init__(
+        self,
+        store=None,
+        rank: int = 0,
+        world_size: int = 1,
+        report_interval: int = 16,
+        time_interval_s: Optional[float] = None,
+        gather_on_rank0: bool = True,
+        history_maxlen: int = 1024,
+    ):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.gather_on_rank0 = gather_on_rank0
+        self.sections = DurationStore(maxlen=history_maxlen)
+        self.device = DurationStore(maxlen=history_maxlen)
+        self.device_timer = DeviceTimer(self.device)
+        self.tracker = ReportIntervalTracker(report_interval, time_interval_s)
+        self.names = NameMapper()
+        self._round = 0
+        # per-name best historical median (for individual scores)
+        self._best_medians: Dict[str, float] = {}
+        self._initialized = False
+
+    def initialize(self) -> None:
+        self._initialized = True
+
+    def shutdown(self) -> None:
+        self._initialized = False
+
+    # -- instrumentation ---------------------------------------------------
+
+    @contextlib.contextmanager
+    def detection_section(self, name: str):
+        """Time a CPU section (reference ``detection_section``)."""
+        self.names.intern(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.sections.record(name, time.perf_counter() - t0)
+            self._tick()
+
+    def wrap_callables(self, callables: Dict[str, Callable]) -> Dict[str, Callable]:
+        """Wrap jitted callables so their device time is captured
+        (reference monkey-patch profiling ``straggler.py:368``)."""
+        out = {}
+        for name, fn in callables.items():
+            self.names.intern(name)
+            out[name] = self.device_timer.wrap(fn, name)
+        return out
+
+    def _tick(self) -> None:
+        # accumulate: a due report must survive further ticks until consumed
+        if self.tracker.tick():
+            self._report_due = True
+
+    # -- reporting ---------------------------------------------------------
+
+    def maybe_report(self, timeout: float = 60.0) -> Optional[Report]:
+        if not getattr(self, "_report_due", False):
+            return None
+        self._report_due = False
+        return self.generate_report(timeout=timeout)
+
+    def generate_report(self, timeout: float = 60.0) -> Optional[Report]:
+        """Collective: every rank publishes local stats; rank 0 (or all, with
+        gather_on_rank0=False) assembles the report."""
+        record_event(ProfilingEvent.STRAGGLER_DETECTED, kind="report_round", round=self._round)
+        round_idx = self._round
+        self._round += 1
+        section_stats = self.sections.stats()
+        device_stats = self.device.stats()
+        # update own history
+        for name, st in {**section_stats, **device_stats}.items():
+            if st.median > 0:
+                best = self._best_medians.get(name)
+                if best is None or st.median < best:
+                    self._best_medians[name] = st.median
+
+        if self.store is None or self.world_size == 1:
+            return Report(
+                round_idx,
+                {self.rank: section_stats},
+                {self.rank: device_stats},
+            )
+
+        payload = Report.rank_payload(section_stats, device_stats)
+        key = f"straggler/round/{round_idx}/rank/{self.rank}"
+        self.store.set(key, payload)
+        barrier(
+            self.store, f"straggler/round/{round_idx}/gather",
+            self.world_size, timeout=timeout,
+        )
+        report = None
+        if not self.gather_on_rank0 or self.rank == 0:
+            payloads = {}
+            for r in range(self.world_size):
+                raw = self.store.get(
+                    f"straggler/round/{round_idx}/rank/{r}", timeout=timeout
+                )
+                payloads[r] = raw.decode()
+            report = Report.from_payloads(round_idx, payloads)
+        if not self.gather_on_rank0:
+            # everyone reads: fence before cleanup so no reader races a delete
+            barrier(
+                self.store, f"straggler/round/{round_idx}/read",
+                self.world_size, timeout=timeout,
+            )
+        if self.rank == 0:
+            # a multi-day run must not grow the store unboundedly: drop this
+            # round's payloads and barrier keys once consumed
+            for k in self.store.list_keys(f"straggler/round/{round_idx}/"):
+                self.store.delete(k)
+            for k in self.store.list_keys(f"barrier/straggler/round/{round_idx}/"):
+                self.store.delete(k)
+        return report
+
+    def individual_score(self) -> Optional[float]:
+        """This rank's current-vs-best score (device stats preferred)."""
+        stats = self.device.stats() or self.sections.stats()
+        return Report.individual_scores(stats, self._best_medians)
+
+    def reset(self) -> None:
+        self.sections.reset()
+        self.device.reset()

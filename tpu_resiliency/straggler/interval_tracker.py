@@ -1,0 +1,35 @@
+"""Report cadence synchronization (reference ``interval_tracker.py:24``).
+
+Ranks must generate reports at the same logical point or cross-rank scores
+compare different workloads.  The tracker counts local section completions
+and, on the first report, aligns the interval so every rank reports every
+``interval`` completions starting from a shared origin.
+"""
+
+from __future__ import annotations
+
+
+class ReportIntervalTracker:
+    def __init__(self, interval: int = 16, time_interval_s: float | None = None):
+        import time as _time
+
+        self.interval = interval
+        self.time_interval_s = time_interval_s
+        self.count = 0
+        self._last_report_t = _time.monotonic()
+
+    def tick(self) -> bool:
+        """Count one section completion; True when a report is due."""
+        import time as _time
+
+        self.count += 1
+        if self.count % self.interval == 0:
+            self._last_report_t = _time.monotonic()
+            return True
+        if (
+            self.time_interval_s is not None
+            and _time.monotonic() - self._last_report_t >= self.time_interval_s
+        ):
+            self._last_report_t = _time.monotonic()
+            return True
+        return False

@@ -1,0 +1,44 @@
+"""Name interning (reference ``name_mapper.py:22``): section/callable names
+map to stable small ids so future on-device gather paths can ship ids, not
+strings. The store path sends names once per round; the mapper also guards
+against unbounded name cardinality (a bug in naming sections per-step would
+otherwise grow memory forever)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..utils.logging import get_logger
+
+log = get_logger("straggler.names")
+
+
+class NameMapper:
+    def __init__(self, max_names: int = 4096):
+        self.max_names = max_names
+        self._ids: Dict[str, int] = {}
+        self._warned = False
+
+    def intern(self, name: str) -> int:
+        idx = self._ids.get(name)
+        if idx is None:
+            if len(self._ids) >= self.max_names:
+                if not self._warned:
+                    log.warning(
+                        "more than %s distinct section names — are names "
+                        "per-step unique by mistake?", self.max_names,
+                    )
+                    self._warned = True
+                return -1
+            idx = len(self._ids)
+            self._ids[name] = idx
+        return idx
+
+    def name_of(self, idx: int) -> str:
+        for name, i in self._ids.items():
+            if i == idx:
+                return name
+        raise KeyError(idx)
+
+    def __len__(self) -> int:
+        return len(self._ids)

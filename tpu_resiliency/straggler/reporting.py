@@ -1,0 +1,147 @@
+"""Straggler scoring and reports.
+
+Scoring semantics follow ``attribution/straggler/reporting.py:84-253``:
+
+- **relative scores**: for each timed name, a rank's score is
+  ``best_median / rank_median`` (1.0 = fastest rank, lower = slower); the
+  per-rank summary score weights names by their share of total time, so a
+  slow-but-rare section cannot dominate.
+- **individual scores**: ``best_historical_median / current_median`` per
+  rank — catches a rank degrading against itself even when the whole job
+  slows together (relative scores cannot see fleet-wide degradation).
+- ``identify_stragglers``: ranks under the threshold on either axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from .timers import SectionStats
+
+
+@dataclasses.dataclass
+class StragglerVerdict:
+    rank: int
+    relative_score: float
+    individual_score: Optional[float]
+    is_straggler: bool
+    detail: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Report:
+    """All-rank stats for one reporting round."""
+
+    round_idx: int
+    # {rank: {name: SectionStats}}
+    section_stats: Dict[int, Dict[str, SectionStats]]
+    device_stats: Dict[int, Dict[str, SectionStats]]
+
+    # -- serialization (store gather) -------------------------------------
+
+    @staticmethod
+    def rank_payload(
+        sections: Dict[str, SectionStats], device: Dict[str, SectionStats]
+    ) -> str:
+        return json.dumps(
+            {
+                "sections": {k: v.to_dict() for k, v in sections.items()},
+                "device": {k: v.to_dict() for k, v in device.items()},
+            }
+        )
+
+    @classmethod
+    def from_payloads(cls, round_idx: int, payloads: Dict[int, str]) -> "Report":
+        section_stats, device_stats = {}, {}
+        for rank, raw in payloads.items():
+            d = json.loads(raw)
+            section_stats[rank] = {
+                k: SectionStats.from_dict(v) for k, v in d["sections"].items()
+            }
+            device_stats[rank] = {
+                k: SectionStats.from_dict(v) for k, v in d["device"].items()
+            }
+        return cls(round_idx=round_idx, section_stats=section_stats, device_stats=device_stats)
+
+    # -- scoring -----------------------------------------------------------
+
+    @staticmethod
+    def _relative_scores(
+        per_rank: Dict[int, Dict[str, SectionStats]]
+    ) -> Dict[int, float]:
+        ranks = sorted(per_rank)
+        names = sorted({n for stats in per_rank.values() for n in stats})
+        if not names:
+            return {r: 1.0 for r in ranks}
+        best_median = {
+            n: min(
+                (per_rank[r][n].median for r in ranks if n in per_rank[r] and per_rank[r][n].median > 0),
+                default=0.0,
+            )
+            for n in names
+        }
+        out: Dict[int, float] = {}
+        for r in ranks:
+            weighted, weight_sum = 0.0, 0.0
+            for n in names:
+                st = per_rank[r].get(n)
+                if st is None or st.median <= 0 or best_median[n] <= 0:
+                    continue
+                weight = st.total
+                weighted += (best_median[n] / st.median) * weight
+                weight_sum += weight
+            out[r] = weighted / weight_sum if weight_sum else 1.0
+        return out
+
+    def relative_device_scores(self) -> Dict[int, float]:
+        return self._relative_scores(self.device_stats)
+
+    def relative_section_scores(self) -> Dict[int, float]:
+        return self._relative_scores(self.section_stats)
+
+    @staticmethod
+    def individual_scores(
+        current: Dict[str, SectionStats], best_history: Dict[str, float]
+    ) -> Optional[float]:
+        """current-vs-own-best for one rank; None with no history."""
+        weighted, weight_sum = 0.0, 0.0
+        for name, st in current.items():
+            best = best_history.get(name)
+            if best is None or st.median <= 0:
+                continue
+            weighted += (best / st.median) * st.total
+            weight_sum += st.total
+        if not weight_sum:
+            return None
+        return weighted / weight_sum
+
+    def identify_stragglers(
+        self,
+        relative_threshold: float = 0.7,
+        individual_threshold: float = 0.7,
+        individual: Optional[Dict[int, Optional[float]]] = None,
+    ) -> List[StragglerVerdict]:
+        rel_dev = self.relative_device_scores()
+        rel_sec = self.relative_section_scores()
+        verdicts = []
+        for rank in sorted(set(rel_dev) | set(rel_sec)):
+            # device timing is the primary signal when present
+            rel = rel_dev.get(rank) if self.device_stats.get(rank) else None
+            if rel is None:
+                rel = rel_sec.get(rank, 1.0)
+            ind = (individual or {}).get(rank)
+            is_straggler = rel < relative_threshold or (
+                ind is not None and ind < individual_threshold
+            )
+            verdicts.append(
+                StragglerVerdict(
+                    rank=rank,
+                    relative_score=rel,
+                    individual_score=ind,
+                    is_straggler=is_straggler,
+                    detail={"relative_section": rel_sec.get(rank, 1.0)},
+                )
+            )
+        return verdicts

@@ -1,0 +1,104 @@
+"""Timing backends: CPU sections and device-bound callables.
+
+Reference analogs: ``straggler.py:288-348`` (``detection_section`` CPU timing
++ CUPTI capture toggle) and the CUPTI per-kernel circular buffers
+(``CircularBuffer.h``).  Durations live in bounded deques — memory stays
+constant over arbitrarily long runs.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Deque, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class SectionStats:
+    name: str
+    count: int
+    total: float
+    avg: float
+    median: float
+    min: float
+    max: float
+    stddev: float
+
+    @classmethod
+    def from_samples(cls, name: str, samples: List[float]) -> "SectionStats":
+        n = len(samples)
+        if n == 0:
+            return cls(name, 0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0)
+        s = sorted(samples)
+        total = sum(s)
+        avg = total / n
+        median = s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+        var = sum((x - avg) ** 2 for x in s) / n
+        return cls(name, n, total, avg, median, s[0], s[-1], math.sqrt(var))
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "SectionStats":
+        return cls(**d)
+
+
+class DurationStore:
+    """Bounded per-name duration samples (CircularBuffer analog)."""
+
+    def __init__(self, maxlen: int = 1024):
+        self.maxlen = maxlen
+        self._samples: Dict[str, Deque[float]] = {}
+
+    def record(self, name: str, duration: float) -> None:
+        buf = self._samples.get(name)
+        if buf is None:
+            buf = self._samples[name] = collections.deque(maxlen=self.maxlen)
+        buf.append(duration)
+
+    def names(self) -> List[str]:
+        return sorted(self._samples)
+
+    def stats(self) -> Dict[str, SectionStats]:
+        return {
+            name: SectionStats.from_samples(name, list(buf))
+            for name, buf in self._samples.items()
+        }
+
+    def reset(self) -> None:
+        self._samples.clear()
+
+
+class DeviceTimer:
+    """Times a callable to device completion.
+
+    XLA dispatch is async: wall time around a jitted call measures the host,
+    not the chip.  ``block_until_ready`` on the outputs closes the gap — the
+    recorded duration is (queue + device execution), the same quantity the
+    reference derives from CUPTI kernel records at per-kernel granularity.
+    """
+
+    def __init__(self, store: DurationStore):
+        self.store = store
+        self.enabled = True
+
+    def wrap(self, fn, name: Optional[str] = None):
+        import jax
+
+        label = name or getattr(fn, "__name__", repr(fn))
+
+        def timed(*args, **kwargs):
+            if not self.enabled:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            self.store.record(label, time.perf_counter() - t0)
+            return out
+
+        timed.__name__ = f"straggler_timed[{label}]"
+        timed.__wrapped__ = fn
+        return timed

@@ -1,0 +1,211 @@
+"""Cluster-wide log funnel: many hosts → one consolidated stream.
+
+Reference analog: ``shared_utils/grpc_log_server.py`` + leaf servers (1324
+LoC, gRPC, two levels): leaf servers collect a node's lines and forward to a
+single root writer that batches into large sequential writes (1MB batches on
+Lustre) with backpressure.
+
+Re-design: the funnel rides plain TCP with the same length-prefixed JSON
+framing as the rest of tpurx (no proto toolchain), two levels preserved:
+
+- :class:`RootLogServer` — accepts batches, appends to one file with
+  large buffered writes; per-source sequence numbers detect gaps.
+- :class:`LogForwarder` — a ``logging.Handler`` that batches records
+  (by size or age) and ships them; drops-with-counter under backpressure
+  instead of blocking the training host (a slow funnel must never stall a
+  step).
+
+Discovery: the root publishes ``logfunnel/root`` = host:port in the KV store.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+_U32 = struct.Struct("<I")
+
+
+class RootLogServer:
+    def __init__(self, path: str, host: str = "0.0.0.0", port: int = 0,
+                 flush_bytes: int = 1 << 20, flush_age: float = 2.0):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "a", buffering=flush_bytes)
+        self._flush_age = flush_age
+        self._last_flush = time.monotonic()
+        self._lock = threading.Lock()
+        self._seqs: Dict[str, int] = {}
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(128)
+        self._server.settimeout(0.25)
+        self.port = self._server.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True, name="tpurx-logroot")
+        self._thread.start()
+
+    def register(self, store) -> None:
+        store.set("logfunnel/root", f"{socket.gethostname()}:{self.port}")
+
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._drain, args=(conn,), daemon=True).start()
+
+    def _drain(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            while True:
+                hdr = self._recv_exact(conn, 4)
+                if hdr is None:
+                    return
+                (n,) = _U32.unpack(hdr)
+                raw = self._recv_exact(conn, n)
+                if raw is None:
+                    return
+                batch = json.loads(raw.decode())
+                self._write_batch(batch)
+        except (OSError, ValueError):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn, n) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _write_batch(self, batch: Dict) -> None:
+        source = batch.get("source", "?")
+        seq = batch.get("seq", 0)
+        with self._lock:
+            expected = self._seqs.get(source)
+            if expected is not None and seq > expected + 1:
+                self._file.write(
+                    f"[logfunnel] GAP from {source}: missing batches "
+                    f"{expected + 1}..{seq - 1}\n"
+                )
+            self._seqs[source] = seq
+            dropped = batch.get("dropped", 0)
+            if dropped:
+                self._file.write(f"[logfunnel] {source} dropped {dropped} lines\n")
+            for line in batch.get("lines", ()):
+                self._file.write(f"[{source}] {line}\n")
+            if time.monotonic() - self._last_flush > self._flush_age:
+                self._file.flush()
+                self._last_flush = time.monotonic()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+        with self._lock:
+            self._file.flush()
+            self._file.close()
+
+
+class LogForwarder(logging.Handler):
+    """Batching, non-blocking forwarder (attach to any logger)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        source: Optional[str] = None,
+        batch_lines: int = 200,
+        batch_age: float = 1.0,
+        max_buffer: int = 10_000,
+    ):
+        super().__init__()
+        self.addr = (host, port)
+        self.source = source or f"{socket.gethostname()}:{os.getpid()}"
+        self.batch_lines = batch_lines
+        self.batch_age = batch_age
+        self.max_buffer = max_buffer
+        self._buf: List[str] = []
+        self._dropped = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._kick = threading.Event()  # size-triggered flush
+        self._thread = threading.Thread(target=self._pump, daemon=True, name="tpurx-logfwd")
+        self._thread.start()
+
+    @classmethod
+    def from_store(cls, store, **kwargs) -> "LogForwarder":
+        host, _, port = store.get("logfunnel/root").decode().rpartition(":")
+        return cls(host, int(port), **kwargs)
+
+    def emit(self, record: logging.LogRecord) -> None:
+        line = self.format(record)
+        with self._lock:
+            if len(self._buf) >= self.max_buffer:
+                self._dropped += 1  # never block the training host
+                return
+            self._buf.append(line)
+            if len(self._buf) >= self.batch_lines:
+                self._kick.set()  # flush by size, not just age
+
+    def _pump(self) -> None:
+        while not self._stop.is_set():
+            self._kick.wait(timeout=self.batch_age)
+            self._kick.clear()
+            self._flush_once()
+        self._flush_once()
+
+    def _flush_once(self) -> None:
+        with self._lock:
+            if not self._buf and not self._dropped:
+                return
+            lines, self._buf = self._buf, []
+            dropped, self._dropped = self._dropped, 0
+            self._seq += 1
+            seq = self._seq
+        payload = json.dumps(
+            {"source": self.source, "seq": seq, "lines": lines, "dropped": dropped}
+        ).encode()
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(self.addr, timeout=5.0)
+            self._sock.sendall(_U32.pack(len(payload)) + payload)
+        except OSError:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            with self._lock:
+                self._dropped += len(lines)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=3)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        super().close()
