@@ -1,0 +1,151 @@
+"""Services, trace analyzer, memory logger, cycle info tests."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_resiliency.attribution.trace_analyzer import (
+    ProgressMarker,
+    ProgressTraceRecorder,
+    analyze_markers,
+    collect_markers,
+)
+from tpu_resiliency.fault_tolerance.cycle_info import CycleInfoReporter
+from tpu_resiliency.services.attrsvc import serve as attrsvc_serve
+from tpu_resiliency.services.smonsvc import JobMonitor
+from tpu_resiliency.utils.memory import DeviceMemoryLogger, device_memory_stats
+
+
+class TestTraceAnalyzer:
+    def _markers(self, steps, now=1000.0, phases=None):
+        return {
+            r: ProgressMarker(rank=r, iteration=0, step=s, ts=now - 1.0,
+                              phase=(phases or {}).get(r, "step"))
+            if s is not None else None
+            for r, s in steps.items()
+        }
+
+    def test_lagging_rank_identified(self):
+        m = self._markers({0: 100, 1: 100, 2: 97, 3: 100})
+        res = analyze_markers(m, now=1000.0)
+        assert res.category == "lagging_rank"
+        assert res.culprit_ranks == [2]
+
+    def test_dead_rank_identified(self):
+        m = self._markers({0: 100, 1: None, 2: 100})
+        res = analyze_markers(m, now=1000.0)
+        assert res.category == "dead_rank"
+        assert res.culprit_ranks == [1]
+
+    def test_mismatched_phase(self):
+        m = self._markers({0: 100, 1: 100}, phases={0: "step", 1: "eval"})
+        res = analyze_markers(m, now=1000.0)
+        assert res.category == "mismatched_program"
+        assert res.should_resume is False
+
+    def test_collective_stall(self):
+        m = {r: ProgressMarker(rank=r, iteration=0, step=50, ts=900.0) for r in range(2)}
+        res = analyze_markers(m, stale_after_s=30.0, now=1000.0)
+        assert res.category == "collective_stall"
+        assert res.culprit_ranks == [0, 1]
+
+    def test_healthy(self):
+        m = self._markers({0: 10, 1: 10})
+        res = analyze_markers(m, now=1000.0)
+        assert res.category == "healthy"
+
+    def test_recorder_roundtrip(self, store):
+        rec = ProgressTraceRecorder(store, rank=3, every=2)
+        rec.record(step=4, iteration=1, phase="fwd")
+        rec.record(step=5)  # skipped (every=2)
+        markers = collect_markers(store, world_size=4)
+        assert markers[3].step == 4
+        assert markers[3].phase == "fwd"
+        assert markers[0] is None
+
+
+def test_cycle_info_reporter(tmp_path):
+    rep = CycleInfoReporter(str(tmp_path), job_name="testjob")
+    rep.start_cycle(0, 0, ["nodeA", "nodeB"], [], 8)
+    rep.end_cycle("worker_failure", failed_ranks=[3])
+    rep.start_cycle(1, 1, ["nodeA", "nodeB"], ["nodeC"], 8)
+    current = tmp_path / "cycle_info.testjob.current"
+    assert current.is_symlink()
+    info = json.loads(current.read_text())
+    assert info["cycle"] == 1
+    assert info["standby"] == ["nodeC"]
+    info0 = json.loads((tmp_path / "cycle_info.testjob.0.json").read_text())
+    assert info0["end_reason"] == "worker_failure"
+    assert info0["failed_ranks"] == [3]
+
+
+def test_device_memory_stats():
+    stats = device_memory_stats()
+    assert len(stats) >= 1
+    assert "device" in stats[0]
+    logger = DeviceMemoryLogger(interval=0.05)
+    sample = logger.sample()
+    assert sample is logger.last_sample
+
+
+@pytest.fixture
+def attrsvc():
+    server = attrsvc_serve(host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_attrsvc_analyze_and_cache(attrsvc):
+    with urllib.request.urlopen(attrsvc + "/health", timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+    text = "XlaRuntimeError: RESOURCE_EXHAUSTED: out of memory in hbm\n"
+    v1 = _post(attrsvc + "/analyze", {"text": text})
+    assert v1["category"] == "oom_hbm"
+    assert v1["should_resume"] is False
+    v2 = _post(attrsvc + "/analyze", {"text": text})
+    assert v2.get("cached") is True
+    trace = _post(
+        attrsvc + "/analyze_trace",
+        {"markers": {
+            "0": {"rank": 0, "iteration": 0, "step": 9, "ts": time.time()},
+            "1": {"rank": 1, "iteration": 0, "step": 7, "ts": time.time()},
+        }},
+    )
+    assert trace["category"] == "lagging_rank"
+    assert trace["culprit_ranks"] == [1]
+
+
+def test_smonsvc_watches_cycles(tmp_path, attrsvc):
+    cycles = tmp_path / "cycles"
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    rep = CycleInfoReporter(str(cycles), job_name="j")
+    (logs / "cycle_0.log").write_text(
+        "[r2] XlaRuntimeError: RESOURCE_EXHAUSTED: allocating 1GB in hbm\n"
+    )
+    mon = JobMonitor(
+        str(cycles), log_dir=str(logs), attrsvc_url=attrsvc, poll_interval=0.1
+    )
+    rep.start_cycle(0, 0, ["n0"], [], 4)
+    rep.end_cycle("worker_failure", failed_ranks=[2])
+    ended = mon.poll_once()
+    assert len(ended) == 1
+    assert mon.stats["cycles_failed"] == 1
+    assert mon.stats["verdicts"].get("oom_hbm") == 1
+    # second poll: no double counting
+    assert mon.poll_once() == []
+    assert mon.stats["cycles_observed"] == 1

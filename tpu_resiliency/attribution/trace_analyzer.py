@@ -1,0 +1,137 @@
+"""Collective-progress trace analysis: find the rank that stalled the job.
+
+Reference analog: ``attribution/trace_analyzer/fr_attribution.py`` (1578 LoC)
+— NVRx parses PyTorch Flight-Recorder NCCL traces and finds the ranks whose
+missing/mismatched collectives wedged everyone else.
+
+JAX exposes no per-collective recorder, so the TPU design records progress at
+the **step boundary**, which is where SPMD programs synchronize anyway: each
+rank periodically publishes a tiny ``ProgressMarker`` (iteration, step,
+phase, timestamp) through the store (or carries it in per-cycle logs).  When
+the job wedges, the analyzer compares markers:
+
+- a rank whose step lags the quorum → the straggler/wedged rank (everyone
+  else is parked inside the collective waiting for it);
+- ranks at the same step but a different phase → mismatched program
+  (the SPMD analog of NVRx's "mismatched collective" verdict);
+- a rank with no marker at all → died before reporting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .base import AttributionResult
+
+log = get_logger("trace_analyzer")
+
+
+@dataclasses.dataclass
+class ProgressMarker:
+    rank: int
+    iteration: int      # restart-loop iteration (in-process ring)
+    step: int           # training step
+    phase: str = "step" # current phase/section name
+    ts: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw) -> "ProgressMarker":
+        return cls(**json.loads(raw if isinstance(raw, str) else raw.decode()))
+
+
+class ProgressTraceRecorder:
+    """Rank-side: publish a marker every ``every`` steps (one tiny store
+    write; off the step critical path when called after dispatch)."""
+
+    def __init__(self, store, rank: int, namespace: str = "trace", every: int = 1):
+        self.store = store
+        self.rank = rank
+        self.ns = namespace
+        self.every = every
+
+    def record(self, step: int, iteration: int = 0, phase: str = "step") -> None:
+        if step % self.every:
+            return
+        marker = ProgressMarker(
+            rank=self.rank, iteration=iteration, step=step, phase=phase,
+            ts=time.time(),
+        )
+        self.store.set(f"{self.ns}/marker/{self.rank}", marker.to_json())
+
+
+def collect_markers(store, world_size: int, namespace: str = "trace") -> Dict[int, Optional[ProgressMarker]]:
+    out: Dict[int, Optional[ProgressMarker]] = {}
+    for r in range(world_size):
+        raw = store.try_get(f"{namespace}/marker/{r}")
+        out[r] = ProgressMarker.from_json(raw) if raw else None
+    return out
+
+
+def analyze_markers(
+    markers: Dict[int, Optional[ProgressMarker]],
+    stale_after_s: float = 30.0,
+    now: Optional[float] = None,
+) -> AttributionResult:
+    """Identify culprit ranks from a snapshot of progress markers."""
+    now = time.time() if now is None else now
+    present = {r: m for r, m in markers.items() if m is not None}
+    missing = sorted(r for r, m in markers.items() if m is None)
+    if not present:
+        return AttributionResult(
+            category="no_data", confidence=0.2, culprit_ranks=missing,
+            summary="no rank published progress markers", should_resume=True,
+        )
+    steps = Counter(m.step for m in present.values())
+    quorum_step, _ = steps.most_common(1)[0]
+    behind = sorted(r for r, m in present.items() if m.step < quorum_step)
+    stale = sorted(r for r, m in present.items() if now - m.ts > stale_after_s)
+    phases_at_quorum = {m.phase for m in present.values() if m.step == quorum_step}
+    evidence = [
+        f"r{r}: step={m.step} phase={m.phase} age={now - m.ts:.1f}s"
+        for r, m in sorted(present.items())
+    ][:32]
+
+    if missing:
+        return AttributionResult(
+            category="dead_rank", confidence=0.85,
+            culprit_ranks=missing,
+            summary=f"ranks {missing} never reported progress",
+            evidence=evidence, should_resume=True,
+        )
+    if behind:
+        return AttributionResult(
+            category="lagging_rank", confidence=0.9,
+            culprit_ranks=behind,
+            summary=(
+                f"ranks {behind} behind quorum step {quorum_step} — peers are "
+                "blocked in a collective waiting for them"
+            ),
+            evidence=evidence, should_resume=True,
+        )
+    if len(phases_at_quorum) > 1:
+        return AttributionResult(
+            category="mismatched_program", confidence=0.7,
+            culprit_ranks=[],
+            summary=f"ranks at step {quorum_step} disagree on phase: {sorted(phases_at_quorum)}",
+            evidence=evidence, should_resume=False,
+        )
+    if stale:
+        return AttributionResult(
+            category="collective_stall", confidence=0.75,
+            culprit_ranks=stale,
+            summary=f"all ranks at step {quorum_step} but {stale} stopped progressing",
+            evidence=evidence, should_resume=True,
+        )
+    return AttributionResult(
+        category="healthy", confidence=0.6, culprit_ranks=[],
+        summary=f"all ranks at step {quorum_step}", evidence=evidence,
+        should_resume=True,
+    )

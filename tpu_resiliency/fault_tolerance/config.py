@@ -56,6 +56,7 @@ class FaultToleranceConfig:
     # --- logging / observability ---
     log_level: str = "INFO"
     per_cycle_log_dir: Optional[str] = None
+    cycle_info_dir: Optional[str] = None
     profiling_file: Optional[str] = None
     # --- timeouts persistence ---
     state_dict_path: Optional[str] = None

@@ -1,0 +1,76 @@
+"""Per-cycle info JSON for external consumers.
+
+Capability parity with ``fault_tolerance/cycle_info_writer.py`` (427 LoC):
+the store-hosting agent writes one JSON document per restart cycle —
+participants, spares, failure that ended the previous cycle, timestamps —
+plus a ``cycle_info.<job>.current`` symlink external tooling (job monitors,
+attribution services) tails without knowing cycle numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("cycle_info")
+
+
+class CycleInfoReporter:
+    def __init__(self, out_dir: str, job_name: str = "job"):
+        self.out_dir = out_dir
+        self.job_name = job_name
+        os.makedirs(out_dir, exist_ok=True)
+        self._current: Optional[Dict[str, Any]] = None
+
+    def _path(self, cycle: int) -> str:
+        return os.path.join(self.out_dir, f"cycle_info.{self.job_name}.{cycle}.json")
+
+    def start_cycle(
+        self,
+        cycle: int,
+        round_num: int,
+        participants: List[str],
+        standby: List[str],
+        global_world_size: int,
+    ) -> None:
+        self._current = {
+            "job": self.job_name,
+            "cycle": cycle,
+            "round": round_num,
+            "started_at": time.time(),
+            "participants": participants,
+            "standby": standby,
+            "global_world_size": global_world_size,
+            "ended_at": None,
+            "end_reason": None,
+            "failed_ranks": [],
+        }
+        self._write(cycle)
+
+    def end_cycle(self, reason: str, failed_ranks: Optional[List[int]] = None) -> None:
+        if self._current is None:
+            return
+        self._current["ended_at"] = time.time()
+        self._current["end_reason"] = reason
+        self._current["failed_ranks"] = failed_ranks or []
+        self._write(self._current["cycle"])
+
+    def _write(self, cycle: int) -> None:
+        path = self._path(cycle)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._current, f, indent=2)
+        os.replace(tmp, path)
+        current = os.path.join(self.out_dir, f"cycle_info.{self.job_name}.current")
+        tmp_link = current + ".tmp"
+        try:
+            if os.path.lexists(tmp_link):
+                os.unlink(tmp_link)
+            os.symlink(os.path.basename(path), tmp_link)
+            os.replace(tmp_link, current)
+        except OSError:
+            log.warning("could not update current cycle symlink")

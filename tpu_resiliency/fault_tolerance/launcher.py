@@ -152,6 +152,11 @@ class ElasticAgent:
         self.progress = TrainingProgressTracker(
             cfg.progress_iteration_file, cfg.max_no_progress_cycles
         )
+        self.cycle_info = None
+        if host_store and cfg.cycle_info_dir:
+            from .cycle_info import CycleInfoReporter
+
+            self.cycle_info = CycleInfoReporter(cfg.cycle_info_dir)
         run_dir = f"/tmp/tpurx-{os.getpid()}"
         os.makedirs(run_dir, exist_ok=True)
         self._run_dir = run_dir
@@ -245,6 +250,11 @@ class ElasticAgent:
             os.close(err_fd)
             self.workers.append(_Worker(lr, grank, proc))
         record_event(ProfilingEvent.WORKER_STARTED, cycle=cycle)
+        if self.cycle_info is not None:
+            self.cycle_info.start_cycle(
+                cycle, result.round_num, result.participants, [],
+                result.global_world_size,
+            )
         log.info(
             "cycle %s: started %s workers (global ranks %s..%s)",
             cycle, len(self.workers), result.rank_offset,
@@ -413,6 +423,10 @@ class ElasticAgent:
                     cycle=result.cycle,
                     failed=[[r, c] for r, c in failed],
                 )
+                if self.cycle_info is not None:
+                    self.cycle_info.end_cycle(
+                        "worker_failure", [r for r, _ in failed]
+                    )
                 # Stop workers FIRST so the per-cycle pipe readers drain the
                 # dying ranks' final output (tracebacks) before the
                 # attribution gate reads the cycle log.

@@ -1,0 +1,1 @@
+"""Operator-facing services (reference: ``services/attrsvc``, ``services/smonsvc``)."""

@@ -1,0 +1,163 @@
+"""HTTP attribution service.
+
+Reference analog: ``services/attrsvc/`` (~1135 LoC FastAPI app): submit log
+files/text, get failure-attribution verdicts, result caching.  Rebuilt on
+the stdlib http server (no web-framework dependency):
+
+    POST /analyze        {"text": "..."} or {"path": "/logs/cycle_3.log"}
+    POST /analyze_trace  {"markers": {rank: markerJson | null}}
+    GET  /health
+    GET  /stats
+
+Run: python -m tpu_resiliency.services.attrsvc --port 8950
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..attribution import LogAnalyzer
+from ..attribution.trace_analyzer import ProgressMarker, analyze_markers
+from ..utils.logging import get_logger, setup_logger
+
+log = get_logger("attrsvc")
+
+
+class _State:
+    def __init__(self):
+        self.analyzer = LogAnalyzer()
+        self.cache: Dict[str, dict] = {}
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.cache_hits = 0
+
+
+STATE = _State()
+
+
+def _verdict_to_dict(v) -> dict:
+    return {
+        "category": v.category.value if hasattr(v.category, "value") else v.category,
+        "should_resume": v.should_resume,
+        "confidence": v.confidence,
+        "culprit_ranks": v.culprit_ranks,
+        "summary": v.summary,
+        "evidence": v.evidence[:20],
+    }
+
+
+class Handler(BaseHTTPRequestHandler):
+    server_version = "tpurx-attrsvc/0.1"
+
+    def _send(self, code: int, payload: dict) -> None:
+        raw = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def log_message(self, fmt, *args):  # route to our logger, not stderr spam
+        log.debug("http: " + fmt, *args)
+
+    def do_GET(self):
+        if self.path == "/health":
+            return self._send(200, {"status": "ok"})
+        if self.path == "/stats":
+            with STATE.lock:
+                return self._send(
+                    200,
+                    {
+                        "requests": STATE.requests,
+                        "cache_hits": STATE.cache_hits,
+                        "cache_entries": len(STATE.cache),
+                    },
+                )
+        return self._send(404, {"error": "unknown path"})
+
+    def do_POST(self):
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+        except (ValueError, json.JSONDecodeError) as exc:
+            return self._send(400, {"error": f"bad request: {exc}"})
+        with STATE.lock:
+            STATE.requests += 1
+        if self.path == "/analyze":
+            return self._analyze(body)
+        if self.path == "/analyze_trace":
+            return self._analyze_trace(body)
+        return self._send(404, {"error": "unknown path"})
+
+    def _analyze(self, body: dict):
+        text: Optional[str] = body.get("text")
+        path: Optional[str] = body.get("path")
+        if text is None and path is None:
+            return self._send(400, {"error": "need 'text' or 'path'"})
+        try:
+            if text is None:
+                with open(path, "rb") as f:
+                    text = f.read()[-(1 << 20):].decode(errors="replace")
+        except OSError as exc:
+            return self._send(400, {"error": f"cannot read {path}: {exc}"})
+        digest = hashlib.sha256(text.encode()).hexdigest()
+        with STATE.lock:
+            cached = STATE.cache.get(digest)
+            if cached is not None:
+                STATE.cache_hits += 1
+                return self._send(200, {**cached, "cached": True})
+        verdict = _verdict_to_dict(STATE.analyzer.analyze_text(text))
+        with STATE.lock:
+            if len(STATE.cache) > 1024:
+                STATE.cache.clear()
+            STATE.cache[digest] = verdict
+        return self._send(200, verdict)
+
+    def _analyze_trace(self, body: dict):
+        raw_markers = body.get("markers")
+        if not isinstance(raw_markers, dict):
+            return self._send(400, {"error": "need 'markers' dict"})
+        markers = {}
+        try:
+            for rank_s, m in raw_markers.items():
+                markers[int(rank_s)] = (
+                    ProgressMarker(**m) if isinstance(m, dict) else None
+                )
+        except (TypeError, ValueError) as exc:
+            return self._send(400, {"error": f"bad markers: {exc}"})
+        result = analyze_markers(markers, stale_after_s=body.get("stale_after_s", 30.0))
+        return self._send(
+            200,
+            {
+                "category": result.category,
+                "should_resume": result.should_resume,
+                "confidence": result.confidence,
+                "culprit_ranks": result.culprit_ranks,
+                "summary": result.summary,
+                "evidence": result.evidence,
+            },
+        )
+
+
+def serve(host: str = "0.0.0.0", port: int = 8950) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer((host, port), Handler)
+    log.info("attrsvc listening on %s:%s", host, server.server_port)
+    return server
+
+
+def main(argv=None) -> None:
+    setup_logger()
+    p = argparse.ArgumentParser(prog="tpurx-attrsvc")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8950)
+    args = p.parse_args(argv)
+    serve(args.host, args.port).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

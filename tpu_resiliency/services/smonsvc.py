@@ -1,0 +1,177 @@
+"""Job monitor service.
+
+Reference analog: ``services/smonsvc/`` (~1900 LoC): polls the scheduler,
+watches job cycles, submits failed-cycle logs to the attribution service,
+keeps restart statistics, and serves status over HTTP.
+
+Scheduler-agnostic re-design: the monitor watches a job's **cycle-info
+directory** (written by the launcher's :class:`CycleInfoReporter`) plus its
+per-cycle logs — artifacts every deployment has, whether the job runs under
+SLURM, GKE, or xmanager.  On each ended cycle it (optionally) POSTs the
+cycle log to attrsvc and aggregates verdicts.
+
+    python -m tpu_resiliency.services.smonsvc \
+        --cycle-info-dir /logs/cycles --log-dir /logs/percycle \
+        [--attrsvc http://host:8950] [--port 8960]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger, setup_logger
+
+log = get_logger("smonsvc")
+
+
+class JobMonitor:
+    def __init__(
+        self,
+        cycle_info_dir: str,
+        log_dir: Optional[str] = None,
+        attrsvc_url: Optional[str] = None,
+        poll_interval: float = 5.0,
+    ):
+        self.cycle_info_dir = cycle_info_dir
+        self.log_dir = log_dir
+        self.attrsvc_url = attrsvc_url.rstrip("/") if attrsvc_url else None
+        self.poll_interval = poll_interval
+        self._seen_ended: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats: Dict = {
+            "cycles_observed": 0,
+            "cycles_failed": 0,
+            "verdicts": {},          # category -> count
+            "last_cycle": None,
+            "restart_timestamps": [],
+        }
+        self.lock = threading.Lock()
+
+    # -- polling -----------------------------------------------------------
+
+    def poll_once(self) -> List[Dict]:
+        """Scan cycle info files; process newly-ended cycles."""
+        ended = []
+        for path in sorted(glob.glob(os.path.join(self.cycle_info_dir, "cycle_info.*.json"))):
+            try:
+                with open(path) as f:
+                    info = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            key = (info.get("job"), info.get("cycle"))
+            with self.lock:
+                self.stats["last_cycle"] = info.get("cycle")
+            if info.get("ended_at") and key not in self._seen_ended:
+                self._seen_ended.add(key)
+                ended.append(info)
+        for info in ended:
+            self._process_ended_cycle(info)
+        return ended
+
+    def _process_ended_cycle(self, info: Dict) -> None:
+        with self.lock:
+            self.stats["cycles_observed"] += 1
+            if info.get("end_reason") != "success":
+                self.stats["cycles_failed"] += 1
+                self.stats["restart_timestamps"].append(info.get("ended_at"))
+                self.stats["restart_timestamps"] = self.stats["restart_timestamps"][-100:]
+        log.info(
+            "cycle %s ended: %s (failed ranks %s)",
+            info.get("cycle"), info.get("end_reason"), info.get("failed_ranks"),
+        )
+        if self.attrsvc_url and self.log_dir:
+            log_path = os.path.join(self.log_dir, f"cycle_{info.get('cycle')}.log")
+            if os.path.exists(log_path):
+                verdict = self._submit_to_attrsvc(log_path)
+                if verdict:
+                    with self.lock:
+                        cat = verdict.get("category", "unknown")
+                        self.stats["verdicts"][cat] = self.stats["verdicts"].get(cat, 0) + 1
+
+    def _submit_to_attrsvc(self, log_path: str) -> Optional[Dict]:
+        try:
+            req = urllib.request.Request(
+                f"{self.attrsvc_url}/analyze",
+                data=json.dumps({"path": log_path}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read().decode())
+        except Exception as exc:  # noqa: BLE001
+            log.warning("attrsvc submission failed: %s", exc)
+            return None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "JobMonitor":
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="tpurx-smon")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001
+                log.exception("poll failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug("http: " + fmt, *args)
+
+        def do_GET(self):
+            if self.path in ("/status", "/health"):
+                with monitor.lock:
+                    payload = json.dumps(monitor.stats).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+            else:
+                self.send_response(404)
+                self.end_headers()
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    log.info("smonsvc status on %s:%s", host, server.server_port)
+    return server
+
+
+def main(argv=None) -> None:
+    setup_logger()
+    p = argparse.ArgumentParser(prog="tpurx-smonsvc")
+    p.add_argument("--cycle-info-dir", required=True)
+    p.add_argument("--log-dir", default=None)
+    p.add_argument("--attrsvc", default=None, help="attribution service URL")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8960)
+    p.add_argument("--poll-interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+    monitor = JobMonitor(
+        args.cycle_info_dir, args.log_dir, args.attrsvc, args.poll_interval
+    ).start()
+    server = make_status_server(monitor, args.host, args.port)
+    try:
+        server.serve_forever()
+    finally:
+        monitor.stop()
+
+
+if __name__ == "__main__":
+    main()

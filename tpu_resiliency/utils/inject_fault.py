@@ -13,6 +13,9 @@ Usage (also driven by env, so launchers can inject into workers):
     TPURX_FAULT=gil_hang:10    (GIL-holding hang — tests hard-timeout path)
     TPURX_FAULT=exit:5
 Optionally gate on rank: TPURX_FAULT_RANKS=0,3
+Optionally gate on restart cycle: TPURX_FAULT_CYCLES=0 (so a fault fires in
+cycle 0 but the restarted cycle runs clean — the reference's
+``cycle:infra_rank`` injector shape).
 """
 
 from __future__ import annotations
@@ -31,6 +34,7 @@ log = get_logger("inject_fault")
 
 ENV_FAULT = "TPURX_FAULT"
 ENV_FAULT_RANKS = "TPURX_FAULT_RANKS"
+ENV_FAULT_CYCLES = "TPURX_FAULT_CYCLES"
 
 
 class Fault(str, enum.Enum):
@@ -130,6 +134,11 @@ def maybe_inject_from_env(rank: Optional[int] = None) -> Optional[threading.Thre
     spec = os.environ.get(ENV_FAULT)
     if not spec:
         return None
+    cycles = os.environ.get(ENV_FAULT_CYCLES)
+    if cycles is not None:
+        cycle = int(os.environ.get("TPURX_CYCLE", "0"))
+        if cycle not in {int(c) for c in cycles.split(",") if c.strip()}:
+            return None
     ranks = os.environ.get(ENV_FAULT_RANKS)
     if ranks is not None:
         if rank is None:

@@ -1,0 +1,89 @@
+"""Device memory logger.
+
+Reference analog: ``shared_utils/memory.py:24`` (``GPUMemoryLogger`` over
+NVML used-memory).  TPUs expose per-device stats through JAX's
+``device.memory_stats()`` (bytes_in_use, peak_bytes_in_use, bytes_limit on
+supported runtimes); the logger samples them on a background thread and
+warns above a watermark — the early signal before an HBM OOM kills a step.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .logging import get_logger
+
+log = get_logger("memory")
+
+
+def device_memory_stats() -> List[Dict[str, float]]:
+    """Per-local-device memory stats (empty values where unsupported)."""
+    import jax
+
+    out = []
+    for dev in jax.local_devices():
+        stats = {}
+        try:
+            raw = dev.memory_stats() or {}
+            stats = {
+                "bytes_in_use": float(raw.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": float(raw.get("peak_bytes_in_use", 0)),
+                "bytes_limit": float(raw.get("bytes_limit", 0)),
+            }
+        except Exception:  # noqa: BLE001 - some backends lack memory_stats
+            pass
+        stats["device"] = f"{dev.platform}:{dev.id}"
+        out.append(stats)
+    return out
+
+
+class DeviceMemoryLogger:
+    def __init__(
+        self,
+        interval: float = 30.0,
+        warn_fraction: float = 0.92,
+        on_sample=None,
+    ):
+        self.interval = interval
+        self.warn_fraction = warn_fraction
+        self.on_sample = on_sample
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.last_sample: Optional[List[Dict[str, float]]] = None
+
+    def sample(self) -> List[Dict[str, float]]:
+        stats = device_memory_stats()
+        self.last_sample = stats
+        for s in stats:
+            limit = s.get("bytes_limit") or 0
+            used = s.get("bytes_in_use") or 0
+            if limit and used / limit >= self.warn_fraction:
+                log.warning(
+                    "%s HBM %.1f%% full (%.2f/%.2f GiB)",
+                    s["device"], 100 * used / limit,
+                    used / 2**30, limit / 2**30,
+                )
+        if self.on_sample:
+            self.on_sample(stats)
+        return stats
+
+    def start(self) -> "DeviceMemoryLogger":
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpurx-mem-logger", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001
+                log.exception("memory sample failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
