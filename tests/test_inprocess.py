@@ -206,3 +206,40 @@ def test_spare_promoted_after_crash(store_server):
     # spare (initial rank 2) became active rank 1 in iteration 1
     assert "train start rank=1 world=2 iter=1" in outs[2]
     assert "ret=ok@1" in outs[0]
+
+
+class TestActivateWholeGroups:
+    def _policy(self):
+        from tpu_resiliency.inprocess.rank_assignment import ActivateWholeGroups
+
+        # 8 ranks, 4 per host
+        return ActivateWholeGroups(key_of_rank=lambda r: r // 4, group_size=4)
+
+    def test_all_groups_complete(self):
+        p = self._policy()
+        ctx = RankAssignmentCtx(_state(5, 8), set())
+        p(ctx)
+        assert ctx.state.mode == Mode.ACTIVE
+        assert ctx.state.active_rank == 5
+        assert ctx.state.active_world_size == 8
+
+    def test_broken_group_parks_inactive(self):
+        p = self._policy()
+        # rank 6 died -> host 1 (ranks 4-7) incomplete; rank 5 parks
+        ctx = RankAssignmentCtx(_state(5, 8), {6})
+        p(ctx)
+        assert ctx.state.mode == Mode.INACTIVE
+        assert ctx.state.active_world_size == 4
+        # host 0 members stay active with their ranks
+        ctx0 = RankAssignmentCtx(_state(2, 8), {6})
+        p(ctx0)
+        assert ctx0.state.mode == Mode.ACTIVE
+        assert ctx0.state.active_rank == 2
+
+    def test_min_groups_enforced(self):
+        from tpu_resiliency.inprocess.exceptions import RestartAbort
+        from tpu_resiliency.inprocess.rank_assignment import ActivateWholeGroups
+
+        p = ActivateWholeGroups(lambda r: r // 4, 4, min_groups=2)
+        with pytest.raises(RestartAbort):
+            p(RankAssignmentCtx(_state(0, 8), {6}))

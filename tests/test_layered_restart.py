@@ -1,0 +1,75 @@
+"""Layered restart: in-process ring UNDER the in-job ring.
+
+Reference analog: ``tests/fault_tolerance/unit/test_layered_restart_v1.py``
+— the composition contract from SURVEY.md §1: faults the wrapper can absorb
+never reach the launcher; faults it cannot (dead process) escalate.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = str(REPO / "tests" / "workloads" / "layered_worker.py")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_layered(tmp_path, scenario, timeout=150):
+    env = dict(os.environ)
+    env.update(
+        {
+            "TPURX_REPO": str(REPO),
+            "LAYERED_SCENARIO": scenario,
+            "TOY_CKPT": str(tmp_path / "progress.txt"),
+            "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0",
+            "TPURX_FT_WORKERS_STOP_TIMEOUT": "3.0",
+            "TPURX_FT_RDZV_ROUND_TIMEOUT": "30.0",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+            "--nnodes", "1", "--nproc-per-node", "2",
+            "--rdzv-endpoint", f"127.0.0.1:{free_port()}",
+            "--host-store", "--max-restarts", "3",
+            "--monitor-interval", "0.05",
+            WORKER,
+        ],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    if proc.returncode != 0:
+        print("STDOUT:", proc.stdout[-4000:])
+        print("STDERR:", proc.stderr[-4000:])
+    return proc
+
+
+def test_inner_fault_absorbed_by_inprocess_ring(tmp_path):
+    proc = run_layered(tmp_path, "inner")
+    assert proc.returncode == 0
+    # the wrapper recovered: both ranks finished at wrapper-iteration 1...
+    assert proc.stdout.count("ret=done@1") == 2
+    # ...and the LAUNCHER never saw a failure (no new cycle)
+    assert "worker failure detected" not in proc.stderr
+    assert "cycle=1" not in proc.stdout
+    # the nested-restarter protocol surfaced the recovery phases
+    assert "[NestedRestarter] name=[InProcess] state=handling_start" in proc.stdout
+    assert "[NestedRestarter] name=[InProcess] state=completed" in proc.stdout
+
+
+def test_outer_fault_escalates_to_launcher(tmp_path):
+    proc = run_layered(tmp_path, "outer")
+    assert proc.returncode == 0
+    # the process death escalated: launcher restarted the group
+    assert "worker failure detected" in proc.stderr
+    # cycle 1 ran clean to completion on both ranks
+    assert proc.stdout.count("cycle=1 ret=done@0") == 2

@@ -149,3 +149,37 @@ def test_smonsvc_watches_cycles(tmp_path, attrsvc):
     # second poll: no double counting
     assert mon.poll_once() == []
     assert mon.stats["cycles_observed"] == 1
+
+
+class TestCombinedAttribution:
+    def test_deterministic_log_overrides_trace(self):
+        from tpu_resiliency.attribution.combined import analyze_combined
+        from tpu_resiliency.attribution.trace_analyzer import ProgressMarker
+        import time as _t
+
+        now = _t.time()
+        markers = {
+            0: ProgressMarker(rank=0, iteration=0, step=10, ts=now),
+            1: ProgressMarker(rank=1, iteration=0, step=8, ts=now),
+        }
+        res = analyze_combined(
+            "XlaRuntimeError: RESOURCE_EXHAUSTED: allocating in hbm\n", markers
+        )
+        assert res.should_resume is False
+        assert res.category == "oom_hbm"
+        assert 1 in res.culprit_ranks
+
+    def test_silent_hang_becomes_device_suspect(self):
+        from tpu_resiliency.attribution.combined import analyze_combined
+        from tpu_resiliency.attribution.trace_analyzer import ProgressMarker
+        import time as _t
+
+        now = _t.time()
+        markers = {
+            0: ProgressMarker(rank=0, iteration=0, step=10, ts=now),
+            1: ProgressMarker(rank=1, iteration=0, step=3, ts=now),
+        }
+        res = analyze_combined("clean logs, nothing of note\n", markers)
+        assert res.category == "suspected_device_hang"
+        assert res.culprit_ranks == [1]
+        assert res.should_resume is True

@@ -1,0 +1,75 @@
+"""Layered-restart workload: in-process Wrapper UNDER the elastic launcher.
+
+The key composition (SURVEY.md §1): the wrapper recovers faults in-process
+while the launcher's rank monitor knows (via the nested-restarter section)
+that recovery is in progress; only faults the wrapper cannot survive fall
+through to the launcher ring.
+
+Scenario (env LAYERED_SCENARIO):
+  inner  — rank 1 raises at wrapper-iteration 0; the in-process ring recovers
+           it; the LAUNCHER must see zero worker failures (cycle stays 0).
+  outer  — rank 1 hard-exits; the in-process ring cannot save a dead process;
+           its launcher respawns it and the wrapper group re-forms.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "/root/repo"))
+
+from tpu_resiliency.fault_tolerance import FaultToleranceConfig, RankMonitorClient
+from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper
+from tpu_resiliency.inprocess.nested_restarter import NestedRestarterCallback
+
+RANK = int(os.environ["TPURX_RANK"])
+CYCLE = int(os.environ["TPURX_CYCLE"])
+SCENARIO = os.environ.get("LAYERED_SCENARIO", "inner")
+
+client = RankMonitorClient(
+    FaultToleranceConfig(
+        rank_section_timeouts={"inprocess_restart": 30.0},
+        skip_section_response=False,
+    )
+)
+client.init_workload_monitoring()
+bridge = NestedRestarterCallback(client)
+
+
+@Wrapper(
+    group=f"layered-c{CYCLE}",
+    rank_assignment=ShiftRanks(),
+    initialize=bridge.on_initialize,
+    abort=bridge.on_abort,
+    finalize=bridge.on_finalize,
+    soft_timeout=15.0,
+    hard_timeout=30.0,
+    monitor_process_interval=0.2,
+    monitor_thread_interval=0.1,
+    heartbeat_interval=0.2,
+    sibling_timeout=3.0,
+)
+def train(call_wrapper=None):
+    it = call_wrapper.iteration
+    state = call_wrapper.state
+    print(f"train rank={state.active_rank} world={state.active_world_size} "
+          f"iter={it} cycle={CYCLE}", flush=True)
+    for step in range(40):
+        call_wrapper.ping()
+        client.send_heartbeat()
+        time.sleep(0.05)
+        if CYCLE == 0 and it == 0 and RANK == 1 and step == 5:
+            if SCENARIO == "inner":
+                raise RuntimeError("inner fault: recover in-process")
+            if SCENARIO == "outer":
+                print("outer fault: dying for real", flush=True)
+                os._exit(29)
+        if state.active_rank == 0:
+            write_progress_iteration(os.environ["TOY_CKPT"], step)
+    return f"done@{it}"
+
+
+if __name__ == "__main__":
+    ret = train()
+    print(f"RESULT rank={RANK} cycle={CYCLE} ret={ret}", flush=True)

@@ -1,0 +1,79 @@
+"""Combined log + progress-trace attribution.
+
+Reference analog: ``attribution/combined_log_fr/`` (448 LoC): joins the log
+analysis with the flight-recorder analysis into a single verdict.  Here the
+two signals are the rule-based log verdict and the progress-marker trace
+verdict; combination rules:
+
+- agreement on culprit ranks boosts confidence;
+- a non-survivable log category (OOM/NaN/data) overrides the trace's
+  resume=True (restarting cannot fix a deterministic failure);
+- a trace-only culprit with an "unknown" log verdict yields a device-suspect
+  verdict (the wedged rank logged nothing — typical for chip hangs).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .base import AttributionResult
+from .log_analyzer import AnalysisVerdict, FailureCategory, LogAnalyzer
+from .trace_analyzer import ProgressMarker, analyze_markers
+
+
+def combine(
+    log_verdict: AnalysisVerdict, trace_result: AttributionResult
+) -> AttributionResult:
+    culprits = sorted(set(log_verdict.culprit_ranks) | set(trace_result.culprit_ranks))
+    agree = bool(
+        set(log_verdict.culprit_ranks) & set(trace_result.culprit_ranks)
+    )
+    # deterministic failures dominate regardless of what the trace suggests
+    if not log_verdict.should_resume and log_verdict.confidence >= 0.8:
+        return AttributionResult(
+            category=log_verdict.category.value,
+            confidence=max(log_verdict.confidence, trace_result.confidence),
+            culprit_ranks=culprits,
+            summary=f"log: {log_verdict.summary}; trace: {trace_result.summary}",
+            evidence=log_verdict.evidence + trace_result.evidence,
+            should_resume=False,
+        )
+    if (
+        log_verdict.category == FailureCategory.UNKNOWN
+        and trace_result.culprit_ranks
+    ):
+        return AttributionResult(
+            category="suspected_device_hang",
+            confidence=min(0.95, trace_result.confidence + 0.05),
+            culprit_ranks=trace_result.culprit_ranks,
+            summary=(
+                f"trace blames ranks {trace_result.culprit_ranks} and the log "
+                "shows no error signature — silent device/host hang"
+            ),
+            evidence=trace_result.evidence,
+            should_resume=True,
+        )
+    confidence = max(log_verdict.confidence, trace_result.confidence)
+    if agree:
+        confidence = min(0.99, confidence + 0.1)
+    return AttributionResult(
+        category=log_verdict.category.value
+        if log_verdict.confidence >= trace_result.confidence
+        else trace_result.category,
+        confidence=confidence,
+        culprit_ranks=culprits,
+        summary=f"log: {log_verdict.summary}; trace: {trace_result.summary}",
+        evidence=log_verdict.evidence + trace_result.evidence,
+        should_resume=log_verdict.should_resume and trace_result.should_resume,
+    )
+
+
+def analyze_combined(
+    log_text: str,
+    markers: Dict[int, Optional[ProgressMarker]],
+    llm_fn=None,
+    stale_after_s: float = 30.0,
+) -> AttributionResult:
+    log_verdict = LogAnalyzer(llm_fn=llm_fn).analyze_text(log_text)
+    trace_result = analyze_markers(markers, stale_after_s=stale_after_s)
+    return combine(log_verdict, trace_result)

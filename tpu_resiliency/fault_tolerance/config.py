@@ -39,6 +39,8 @@ class FaultToleranceConfig:
     restart_policy: str = "any-failed"  # any-failed | min-healthy
     term_signal: str = "SIGKILL"
     workers_stop_timeout: float = 15.0
+    # bind worker i to NUMA node (i * nodes // nproc) via numactl when available
+    numa_binding: bool = False
     # --- rendezvous ---
     rdzv_round_timeout: float = 600.0
     min_nodes: int = 1

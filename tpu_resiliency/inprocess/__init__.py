@@ -25,6 +25,7 @@ from .monitor_process import MonitorProcess
 from .progress_watchdog import ProgressWatchdog
 from .rank_assignment import (
     ActivateAllRanks,
+    ActivateWholeGroups,
     ActiveWorldSizeDivisibleBy,
     FillGaps,
     MaxActiveWorldSize,
@@ -56,6 +57,7 @@ __all__ = [
     "FaultCounterExceeded",
     "RankAssignmentCtx",
     "ActivateAllRanks",
+    "ActivateWholeGroups",
     "MaxActiveWorldSize",
     "ActiveWorldSizeDivisibleBy",
     "FillGaps",

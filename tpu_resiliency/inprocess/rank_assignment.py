@@ -12,6 +12,8 @@ mode) given the terminated set.  Policies chain with
 - :class:`FillGaps` — dead ranks' slots are back-filled by the highest
   surviving ranks; survivors otherwise keep their rank (``:786``).
 - :class:`ShiftRanks` — survivors shift down preserving order (``:843``).
+- :class:`ActivateWholeGroups` — only complete topology groups stay active
+  (``FilterCountGroupedByKey`` ``:900`` / ``Tree`` layers ``:416-520``).
 """
 
 from __future__ import annotations
@@ -108,6 +110,61 @@ class MaxActiveWorldSize(RankAssignment):
         else:
             state.active_rank = None
             state.active_world_size = cap
+            state.mode = Mode.INACTIVE
+        return ctx
+
+
+class ActivateWholeGroups(RankAssignment):
+    """Keep only COMPLETE topology groups active.
+
+    Reference analogs: ``FilterCountGroupedByKey`` (``:900``) and the ``Tree``
+    layers (``:416-520``) — on TPU a partial host or slice cannot form a
+    legal device mesh, so after failures only groups with every member
+    surviving may stay active.  ``key_of_rank`` maps an initial rank to its
+    group (e.g. ``lambda r: r // 4`` for 4 chips per host); survivors in
+    complete groups are renumbered contiguously group-major; survivors in
+    broken groups park INACTIVE (ready to back-fill after the next fault).
+    """
+
+    def __init__(self, key_of_rank, group_size: int, min_groups: int = 1):
+        self.key_of_rank = key_of_rank
+        self.group_size = group_size
+        self.min_groups = min_groups
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        if state.initial_rank in ctx.terminated_ranks:
+            raise RankDiscontinued(f"rank {state.initial_rank} terminated")
+        survivors = _surviving(ctx)
+        groups: dict = {}
+        for r in survivors:
+            groups.setdefault(self.key_of_rank(r), []).append(r)
+        complete = {
+            k: sorted(members)
+            for k, members in groups.items()
+            if len(members) == self.group_size
+        }
+        if len(complete) < self.min_groups:
+            raise RestartAbort(
+                f"only {len(complete)} complete groups < min_groups {self.min_groups}"
+            )
+        ordered: List[int] = []
+        for k in sorted(complete, key=lambda k: complete[k][0]):
+            ordered.extend(complete[k])
+        # unique renumbering across ALL survivors: actives take 0..n_active-1
+        # (group-major), parked survivors continue after them — two live
+        # processes must never share a state.rank
+        parked = [r for r in survivors if r not in ordered]
+        numbering = {r: i for i, r in enumerate(ordered + parked)}
+        state.world_size = len(survivors)
+        state.rank = numbering[state.initial_rank]
+        if state.initial_rank in numbering and state.rank < len(ordered):
+            state.active_rank = state.rank
+            state.active_world_size = len(ordered)
+            state.mode = Mode.ACTIVE
+        else:
+            state.active_rank = None
+            state.active_world_size = len(ordered)
             state.mode = Mode.INACTIVE
         return ctx
 
