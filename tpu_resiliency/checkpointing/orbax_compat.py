@@ -1,0 +1,74 @@
+"""Orbax interoperability.
+
+Users migrating from orbax-checkpoint keep their on-disk history; this
+adapter reads/writes orbax-format checkpoints with the same call shapes as
+:class:`AsyncCheckpointer`, and ``migrate_to_tpurx`` converts an orbax
+checkpoint into the tpurx sharded format (so local replication and the
+async commit protocol apply from then on).
+
+Orbax remains optional: importing this module without orbax installed raises
+only when used.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("orbax_compat")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+class OrbaxCompatCheckpointer:
+    """Save/load pytrees in orbax format with the AsyncCheckpointer surface."""
+
+    def __init__(self):
+        ocp = _checkpointer()
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def save(self, tree: Any, ckpt_dir: str, extra_metadata: Optional[Dict] = None) -> None:
+        self._ckptr.save(os.path.abspath(ckpt_dir), tree, force=True)
+        self._ckptr.wait_until_finished()
+
+    def async_save(self, tree: Any, ckpt_dir: str, extra_metadata: Optional[Dict] = None) -> int:
+        self._ckptr.save(os.path.abspath(ckpt_dir), tree, force=True)
+        return 0
+
+    def maybe_finalize(self, blocking: bool = False):
+        if blocking:
+            self._ckptr.wait_until_finished()
+        return []
+
+    def finalize_all(self, timeout: float = 600.0) -> None:
+        self._ckptr.wait_until_finished()
+
+    def close(self) -> None:
+        self._ckptr.wait_until_finished()
+        self._ckptr.close()
+
+
+def load_orbax_checkpoint(ckpt_dir: str, template: Any) -> Any:
+    """Restore an orbax checkpoint into the template's structure/shardings."""
+    ocp = _checkpointer()
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(ckpt_dir), template)
+
+
+def migrate_to_tpurx(orbax_dir: str, tpurx_dir: str, template: Any) -> None:
+    """One-shot conversion: orbax checkpoint -> tpurx sharded format."""
+    from . import AsyncCheckpointer
+
+    tree = load_orbax_checkpoint(orbax_dir, template)
+    ck = AsyncCheckpointer()
+    try:
+        ck.save(tree, tpurx_dir, extra_metadata={"migrated_from": orbax_dir})
+    finally:
+        ck.close()
+    log.info("migrated orbax checkpoint %s -> %s", orbax_dir, tpurx_dir)

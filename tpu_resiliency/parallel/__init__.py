@@ -2,10 +2,12 @@
 
 from .mesh import make_mesh, mesh_axis_sizes
 from .collectives import device_max_reduce, make_timeouts_reduce_fn
+from .distributed import init_distributed
 
 __all__ = [
     "make_mesh",
     "mesh_axis_sizes",
     "device_max_reduce",
     "make_timeouts_reduce_fn",
+    "init_distributed",
 ]

@@ -1,0 +1,64 @@
+"""Multi-host JAX bootstrap from launcher-provided env.
+
+The reference's workloads call ``torch.distributed.init_process_group`` from
+torchelastic env; the JAX analog is ``jax.distributed.initialize`` with a
+coordinator address.  The tpurx launcher already exports rank/world/store
+env; this helper derives the coordinator from them so workloads need one
+line:
+
+    from tpu_resiliency.parallel import init_distributed
+    init_distributed()          # no-op single-process; idempotent
+
+The coordinator runs on the node hosting the KV store (same machine that
+already owns the control plane), port = store port + 1 by default, or
+``TPURX_JAX_COORDINATOR`` overrides.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("distributed")
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from tpurx env. Returns True if initialized
+    (False for single-process runs where it is unnecessary)."""
+    global _initialized
+    if _initialized:
+        return True
+    env = os.environ
+    if num_processes is None:
+        num_processes = int(env.get("TPURX_NNODES", "1"))
+    if process_id is None:
+        process_id = int(env.get("TPURX_GROUP_RANK", "0"))
+    if num_processes <= 1:
+        return False
+    if coordinator_address is None:
+        coordinator_address = env.get("TPURX_JAX_COORDINATOR")
+    if coordinator_address is None:
+        host = env.get("TPURX_STORE_ADDR", "127.0.0.1")
+        port = int(env.get("TPURX_STORE_PORT", "29400")) + 1
+        coordinator_address = f"{host}:{port}"
+    import jax
+
+    log.info(
+        "jax.distributed.initialize(%s, num_processes=%s, process_id=%s)",
+        coordinator_address, num_processes, process_id,
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
