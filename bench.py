@@ -27,10 +27,27 @@ Prints ONE JSON line.
 """
 
 import json
+import os
+import signal
+import sys
 import time
+
+# A wedged device/relay must fail the bench loudly, not hang it forever.
+_BENCH_DEADLINE_S = int(os.environ.get("TPURX_BENCH_DEADLINE_S", "480"))
+
+
+def _deadline(signum, frame):
+    print(
+        "bench: device unresponsive past deadline "
+        f"({_BENCH_DEADLINE_S}s) — aborting",
+        file=sys.stderr, flush=True,
+    )
+    os._exit(3)
 
 
 def main() -> None:
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(_BENCH_DEADLINE_S)
     import jax
     import numpy as np
 
@@ -67,7 +84,7 @@ def main() -> None:
         if "t_hang" in monitor_holder and "t_detect" not in monitor_holder:
             monitor_holder["t_detect"] = time.monotonic()
 
-    repeats = 5
+    repeats = 3
     latencies_ms = []
     for rep in range(repeats):
         mon = QuorumMonitor(mesh, budget_ms=1e9, interval=0.001, on_stale=on_stale)
@@ -75,7 +92,7 @@ def main() -> None:
         gaps = []
         last = time.monotonic()
         mon.beat()
-        for _ in range(50):
+        for _ in range(30):
             params, opt, loss = step(params, opt, batch)
             jax.block_until_ready(loss)
             now = time.monotonic()
@@ -103,6 +120,7 @@ def main() -> None:
             latencies_ms.append(raw_ms)
 
     assert latencies_ms, "hang was never detected"
+    signal.alarm(0)
     median_ms = float(np.median(latencies_ms))
     baseline_ms = 61000.0  # reference GIL-released hang detection (BASELINE.md)
     print(

@@ -1,0 +1,89 @@
+"""Control-plane scalability benchmark: store throughput + barrier latency.
+
+The reference's scalability headline is a 0.5s TCPStore barrier at 16,384
+ranks (BASELINE.md).  This measures our store servers on one host:
+small-op throughput per client, aggregate multi-client throughput, and
+N-participant barrier completion latency, for both the asyncio and native
+C++ servers.  Prints one JSON line per server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_resiliency.store import StoreClient, StoreServer, barrier
+
+
+def bench_server(server, label, n_clients=64, ops_per_client=200):
+    port = server.port
+    # aggregate ADD throughput
+    def worker(i, out):
+        c = StoreClient("127.0.0.1", port)
+        t0 = time.perf_counter()
+        for _ in range(ops_per_client):
+            c.add(f"ctr{i % 8}", 1)
+        out[i] = time.perf_counter() - t0
+        c.close()
+
+    times = {}
+    threads = [threading.Thread(target=worker, args=(i, times)) for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    agg_ops = n_clients * ops_per_client / wall
+
+    # barrier latency with n_clients participants
+    lat = {}
+
+    def member(i):
+        c = StoreClient("127.0.0.1", port)
+        t0 = time.perf_counter()
+        barrier(c, "bench_barrier", n_clients, timeout=60.0)
+        lat[i] = time.perf_counter() - t0
+        c.close()
+
+    threads = [threading.Thread(target=member, args=(i,)) for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    barrier_ms = max(lat.values()) * 1000.0
+
+    print(
+        json.dumps(
+            {
+                "metric": f"store_{label}",
+                "clients": n_clients,
+                "agg_ops_per_s": round(agg_ops),
+                "barrier_ms": round(barrier_ms, 1),
+            }
+        )
+    )
+
+
+def main():
+    n_clients = int(os.environ.get("BENCH_CLIENTS", "64"))
+    py_server = StoreServer(host="127.0.0.1", port=0).start_in_thread()
+    bench_server(py_server, "asyncio", n_clients=n_clients)
+    py_server.stop()
+    try:
+        from tpu_resiliency.store.native import NativeStoreServer
+
+        native = NativeStoreServer(host="127.0.0.1", port=0).start()
+        bench_server(native, "native_cpp", n_clients=n_clients)
+        native.stop()
+    except Exception as exc:  # noqa: BLE001
+        print(json.dumps({"metric": "store_native_cpp", "error": str(exc)}))
+
+
+if __name__ == "__main__":
+    main()
