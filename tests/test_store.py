@@ -180,3 +180,17 @@ def test_reentrant_barrier(store_server):
 
     errors = _run_threads(member, world)
     assert not errors
+
+
+def test_failover_store_client(store_server):
+    from tpu_resiliency.store import FailoverStoreClient
+
+    # first endpoint dead, second is the live server -> transparent failover
+    dead_port = 1  # nothing listens there
+    c = FailoverStoreClient(
+        [f"127.0.0.1:{dead_port}", f"127.0.0.1:{store_server.port}"],
+        timeout=5.0, connect_timeout=6.0,
+    )
+    c.set("k", b"v")
+    assert c.get("k") == b"v"
+    c.close()

@@ -4,8 +4,9 @@ Reference analog: ``services/attrsvc/`` (~1135 LoC FastAPI app): submit log
 files/text, get failure-attribution verdicts, result caching.  Rebuilt on
 the stdlib http server (no web-framework dependency):
 
-    POST /analyze        {"text": "..."} or {"path": "/logs/cycle_3.log"}
-    POST /analyze_trace  {"markers": {rank: markerJson | null}}
+    POST /analyze           {"text": "..."} or {"path": "/logs/cycle_3.log"}
+    POST /analyze_trace     {"markers": {rank: markerJson | null}}
+    POST /analyze_combined  {"text": ..., "markers": ...}  (joint verdict)
     GET  /health
     GET  /stats
 
@@ -92,7 +93,35 @@ class Handler(BaseHTTPRequestHandler):
             return self._analyze(body)
         if self.path == "/analyze_trace":
             return self._analyze_trace(body)
+        if self.path == "/analyze_combined":
+            return self._analyze_combined(body)
         return self._send(404, {"error": "unknown path"})
+
+    def _analyze_combined(self, body: dict):
+        from ..attribution.combined import analyze_combined
+
+        text = body.get("text", "")
+        raw_markers = body.get("markers") or {}
+        try:
+            markers = {
+                int(r): (ProgressMarker(**m) if isinstance(m, dict) else None)
+                for r, m in raw_markers.items()
+            }
+        except (TypeError, ValueError) as exc:
+            return self._send(400, {"error": f"bad markers: {exc}"})
+        result = analyze_combined(
+            text, markers, stale_after_s=body.get("stale_after_s", 30.0)
+        )
+        return self._send(
+            200,
+            {
+                "category": result.category,
+                "should_resume": result.should_resume,
+                "confidence": result.confidence,
+                "culprit_ranks": result.culprit_ranks,
+                "summary": result.summary,
+            },
+        )
 
     def _analyze(self, body: dict):
         text: Optional[str] = body.get("text")

@@ -138,7 +138,11 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
         def do_GET(self):
             if self.path in ("/status", "/health"):
                 with monitor.lock:
-                    payload = json.dumps(monitor.stats).encode()
+                    stats = dict(monitor.stats)
+                    ts = stats.get("restart_timestamps") or []
+                    recent = [t for t in ts if t and t > time.time() - 3600]
+                    stats["restarts_last_hour"] = len(recent)
+                    payload = json.dumps(stats).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))

@@ -12,12 +12,19 @@ can be implemented natively; ``server.py`` is the asyncio implementation,
 ``native.py`` loads the C++ server when built.
 """
 
-from .client import StoreClient, PrefixStore, StoreTimeout, StoreError
+from .client import (
+    FailoverStoreClient,
+    PrefixStore,
+    StoreClient,
+    StoreError,
+    StoreTimeout,
+)
 from .server import StoreServer, serve_forever
 from .barrier import barrier, reentrant_barrier, BarrierOverflow, BarrierTimeout
 
 __all__ = [
     "StoreClient",
+    "FailoverStoreClient",
     "PrefixStore",
     "StoreTimeout",
     "StoreError",

@@ -359,8 +359,61 @@ class PrefixStore:
         return self._store.multi_get([self._p(k) for k in keys])
 
 
+class FailoverStoreClient(StoreClient):
+    """Client over an ordered list of store endpoints.
+
+    Reference analog: the TCPStore-with-host-failover subclass
+    (``inprocess/store.py:358-366``).  When the current endpoint is
+    unreachable past the normal retry budget, the client advances to the
+    next endpoint (wrapping).  Like the reference, failover is about
+    *availability*, not durability: a replacement store starts empty, which
+    coordination protocols tolerate (a fresh rendezvous round forms); bulk
+    state (checkpoints) never lives in the store.
+    """
+
+    def __init__(self, endpoints, timeout: float = _DEFAULT_TIMEOUT, **kwargs):
+        self.endpoints = [
+            (h, int(p))
+            for h, p in (
+                e.rsplit(":", 1) if isinstance(e, str) else e for e in endpoints
+            )
+        ]
+        if not self.endpoints:
+            raise ValueError("need at least one endpoint")
+        self._endpoint_idx = 0
+        host, port = self.endpoints[0]
+        super().__init__(host, port, timeout=timeout, **kwargs)
+
+    def clone(self) -> "FailoverStoreClient":
+        return FailoverStoreClient(
+            [f"{h}:{p}" for h, p in self.endpoints], timeout=self.timeout
+        )
+
+    def _connect(self, connect_timeout: float) -> None:
+        last_exc: Optional[Exception] = None
+        endpoints = getattr(self, "endpoints", None)
+        if endpoints is None:  # during base __init__
+            return super()._connect(connect_timeout)
+        per_endpoint = max(2.0, connect_timeout / len(endpoints))
+        for _ in range(len(endpoints)):
+            self.host, self.port = endpoints[self._endpoint_idx]
+            try:
+                super()._connect(per_endpoint)
+                return
+            except StoreError as exc:
+                last_exc = exc
+                self._endpoint_idx = (self._endpoint_idx + 1) % len(endpoints)
+        raise StoreError(f"no store endpoint reachable: {last_exc}")
+
+
 def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
-    """Connect using TPURX_STORE_ADDR / TPURX_STORE_PORT env (set by launcher)."""
+    """Connect using TPURX_STORE_ADDR / TPURX_STORE_PORT env (set by
+    launcher); TPURX_STORE_ENDPOINTS="h1:p1,h2:p2" enables failover."""
+    endpoints = os.environ.get("TPURX_STORE_ENDPOINTS")
+    if endpoints:
+        return FailoverStoreClient(
+            [e.strip() for e in endpoints.split(",") if e.strip()], timeout=timeout
+        )
     host = os.environ.get("TPURX_STORE_ADDR", "127.0.0.1")
     port = int(os.environ.get("TPURX_STORE_PORT", "29500"))
     return StoreClient(host, port, timeout=timeout)
