@@ -23,7 +23,7 @@ import dataclasses
 import json
 import time
 from collections import Counter
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from ..utils.logging import get_logger
 from .base import AttributionResult

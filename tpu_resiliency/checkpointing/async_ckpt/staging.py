@@ -17,7 +17,6 @@ leaves to avoid N identical writes).
 from __future__ import annotations
 
 import dataclasses
-import math
 from multiprocessing import shared_memory
 from typing import Any, Dict, List, Optional, Tuple
 

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import json
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, Optional, Sequence
 
 from ..store.barrier import barrier
 from .data import HeartbeatTimeouts, SectionTimeouts

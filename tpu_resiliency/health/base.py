@@ -5,7 +5,7 @@ from __future__ import annotations
 import abc
 import dataclasses
 import time
-from typing import List, Optional
+from typing import List
 
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event

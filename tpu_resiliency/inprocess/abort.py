@@ -18,8 +18,6 @@ in-process Abort stage handles what Python *can* release:
 
 from __future__ import annotations
 
-from typing import Optional
-
 from ..utils.logging import get_logger
 
 log = get_logger("inproc.abort")

@@ -16,7 +16,6 @@ Capability parity with ``inprocess/health_check.py:73-228``:
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Optional
 
 from ..utils.logging import get_logger
 from .exceptions import HealthCheckError, RestartAbort

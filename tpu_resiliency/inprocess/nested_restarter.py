@@ -14,8 +14,6 @@ ring-composition contract from SURVEY.md §1.
 
 from __future__ import annotations
 
-from typing import Optional
-
 from ..fault_tolerance.state_machine import RestarterState, RestartStateMachine
 from ..utils.logging import get_logger
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import List
 
 from ..utils.logging import get_logger
 from .attribution import Interruption, InterruptionRecord

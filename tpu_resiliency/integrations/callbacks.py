@@ -15,7 +15,7 @@ a loop-agnostic protocol.  Use:
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional
+from typing import List, Optional
 
 from ..utils.logging import get_logger
 

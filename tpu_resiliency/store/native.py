@@ -12,7 +12,7 @@ import os
 import re
 import subprocess
 import time
-from typing import List, Optional
+from typing import Optional
 
 from ..utils.logging import get_logger
 
