@@ -1,0 +1,153 @@
+"""MCP server, webhook notifier, coalescing, funnel-wired launcher tests."""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.attribution.mcp_server import handle_request, serve_stdio
+from tpu_resiliency.attribution.base import AttributionResult
+from tpu_resiliency.attribution.notify import WebhookNotifier, format_verdict
+
+
+def _rpc(method, params=None, msg_id=1):
+    return {"jsonrpc": "2.0", "id": msg_id, "method": method, "params": params or {}}
+
+
+class TestMcpServer:
+    def test_initialize_and_list(self):
+        resp = handle_request(_rpc("initialize"))
+        assert resp["result"]["serverInfo"]["name"] == "tpurx-attribution"
+        assert handle_request({"jsonrpc": "2.0", "method": "notifications/initialized"}) is None
+        tools = handle_request(_rpc("tools/list"))["result"]["tools"]
+        assert {t["name"] for t in tools} == {
+            "analyze_log", "analyze_trace", "analyze_combined",
+        }
+
+    def test_call_analyze_log(self):
+        resp = handle_request(
+            _rpc("tools/call", {
+                "name": "analyze_log",
+                "arguments": {"text": "RESOURCE_EXHAUSTED: allocating in hbm"},
+            })
+        )
+        body = json.loads(resp["result"]["content"][0]["text"])
+        assert body["category"] == "oom_hbm"
+        assert body["should_resume"] is False
+        assert resp["result"]["isError"] is False
+
+    def test_call_analyze_trace(self):
+        markers = {
+            "0": {"rank": 0, "iteration": 0, "step": 10, "ts": time.time()},
+            "1": {"rank": 1, "iteration": 0, "step": 5, "ts": time.time()},
+        }
+        resp = handle_request(
+            _rpc("tools/call", {"name": "analyze_trace", "arguments": {"markers": markers}})
+        )
+        body = json.loads(resp["result"]["content"][0]["text"])
+        assert body["category"] == "lagging_rank"
+        assert body["culprit_ranks"] == [1]
+
+    def test_unknown_tool_is_tool_error(self):
+        resp = handle_request(_rpc("tools/call", {"name": "nope", "arguments": {}}))
+        assert resp["result"]["isError"] is True
+
+    def test_unknown_method(self):
+        resp = handle_request(_rpc("bogus/method"))
+        assert resp["error"]["code"] == -32601
+
+    def test_stdio_roundtrip(self):
+        stdin = io.StringIO(
+            json.dumps(_rpc("initialize")) + "\n"
+            + json.dumps(_rpc("tools/list", msg_id=2)) + "\n"
+            + "not json\n"
+        )
+        stdout = io.StringIO()
+        serve_stdio(stdin, stdout)
+        lines = [json.loads(l) for l in stdout.getvalue().splitlines()]
+        assert lines[0]["id"] == 1
+        assert lines[1]["id"] == 2
+
+
+class TestNotifier:
+    def _result(self, category="oom_hbm", conf=0.95):
+        return AttributionResult(
+            category=category, confidence=conf, culprit_ranks=[3],
+            summary="hbm exhausted", should_resume=False,
+        )
+
+    def test_posts_to_webhook(self):
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        received = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        server = HTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{server.server_port}/hook"
+        notifier = WebhookNotifier(url, job="llama-70b")
+        out = notifier(self._result())
+        server.shutdown()
+        assert out.category == "oom_hbm"
+        assert len(received) == 1
+        assert "llama-70b" in received[0]["text"]
+        assert "NO — operator action needed" in received[0]["text"]
+
+    def test_category_filter(self):
+        notifier = WebhookNotifier(
+            "http://127.0.0.1:1/none", only_categories={"numerics"}
+        )
+        # oom_hbm filtered out -> no POST attempted -> no error logged path
+        out = notifier(self._result())
+        assert out is not None
+
+    def test_failed_post_is_nonfatal(self):
+        notifier = WebhookNotifier("http://127.0.0.1:1/dead", timeout=0.2)
+        out = notifier(self._result())
+        assert out.category == "oom_hbm"
+
+    def test_format(self):
+        text = format_verdict(self._result(), job="j1")
+        assert "j1" in text and "oom_hbm" in text and "[3]" in text
+
+
+def test_attrsvc_coalesces_concurrent_requests():
+    import urllib.request
+
+    from tpu_resiliency.services import attrsvc as svc
+
+    server = svc.serve(host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{server.server_port}"
+    text = "unique error for coalescing test: RESOURCE_EXHAUSTED hbm " + str(time.time())
+
+    def post(out):
+        req = urllib.request.Request(
+            url + "/analyze", data=json.dumps({"text": text}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=15) as resp:
+            out.append(json.loads(resp.read()))
+
+    outs = []
+    threads = [threading.Thread(target=post, args=(outs,)) for _ in range(6)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    server.shutdown()
+    assert len(outs) == 6
+    assert all(o["category"] == "oom_hbm" for o in outs)

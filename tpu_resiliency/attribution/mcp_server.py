@@ -1,0 +1,180 @@
+"""MCP server exposing the attribution analyses as tools.
+
+Reference analog: ``attribution/mcp_integration/`` (~1650 LoC over the mcp
+SDK).  The protocol itself is small enough to speak directly — JSON-RPC 2.0
+over stdio per the Model Context Protocol spec (2024-11-05 revision):
+``initialize`` → ``tools/list`` → ``tools/call`` — so this implementation
+has no SDK dependency.
+
+    python -m tpu_resiliency.attribution.mcp_server   # serve on stdio
+
+Tools: analyze_log, analyze_trace, analyze_combined.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, Optional
+
+from ..utils.logging import get_logger
+from .combined import analyze_combined
+from .log_analyzer import LogAnalyzer
+from .trace_analyzer import ProgressMarker, analyze_markers
+
+log = get_logger("mcp")
+
+PROTOCOL_VERSION = "2024-11-05"
+
+TOOLS = [
+    {
+        "name": "analyze_log",
+        "description": (
+            "Classify a distributed-training failure from log text: category "
+            "(oom_hbm, device_error, hang_kill, numerics, ...), culprit "
+            "ranks, and whether restarting can succeed."
+        ),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "text": {"type": "string", "description": "log text"},
+                "path": {"type": "string", "description": "or: log file path"},
+            },
+        },
+    },
+    {
+        "name": "analyze_trace",
+        "description": (
+            "Find the rank that stalled a wedged job from per-rank progress "
+            "markers (step/phase/timestamp)."
+        ),
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "markers": {
+                    "type": "object",
+                    "description": "{rank: {rank, iteration, step, phase, ts} | null}",
+                },
+                "stale_after_s": {"type": "number"},
+            },
+            "required": ["markers"],
+        },
+    },
+    {
+        "name": "analyze_combined",
+        "description": "Joint log + progress-trace verdict.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {
+                "text": {"type": "string"},
+                "markers": {"type": "object"},
+            },
+            "required": ["text", "markers"],
+        },
+    },
+]
+
+
+def _parse_markers(raw: Dict) -> Dict[int, Optional[ProgressMarker]]:
+    return {
+        int(r): (ProgressMarker(**m) if isinstance(m, dict) else None)
+        for r, m in raw.items()
+    }
+
+
+def call_tool(name: str, args: Dict[str, Any]) -> Dict[str, Any]:
+    if name == "analyze_log":
+        analyzer = LogAnalyzer()
+        if args.get("text") is not None:
+            verdict = analyzer.analyze_text(args["text"])
+        elif args.get("path"):
+            verdict = analyzer.analyze_file(args["path"])
+        else:
+            raise ValueError("need 'text' or 'path'")
+        return {
+            "category": verdict.category.value,
+            "should_resume": verdict.should_resume,
+            "confidence": verdict.confidence,
+            "culprit_ranks": verdict.culprit_ranks,
+            "summary": verdict.summary,
+            "evidence": verdict.evidence[:10],
+        }
+    if name == "analyze_trace":
+        result = analyze_markers(
+            _parse_markers(args["markers"]),
+            stale_after_s=args.get("stale_after_s", 30.0),
+        )
+    elif name == "analyze_combined":
+        result = analyze_combined(args["text"], _parse_markers(args["markers"]))
+    else:
+        raise ValueError(f"unknown tool {name}")
+    return {
+        "category": result.category,
+        "should_resume": result.should_resume,
+        "confidence": result.confidence,
+        "culprit_ranks": result.culprit_ranks,
+        "summary": result.summary,
+    }
+
+
+def handle_request(req: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """One JSON-RPC request -> response dict (None for notifications)."""
+    method = req.get("method")
+    msg_id = req.get("id")
+    if method == "initialize":
+        result = {
+            "protocolVersion": PROTOCOL_VERSION,
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "tpurx-attribution", "version": "0.1.0"},
+        }
+    elif method == "notifications/initialized":
+        return None
+    elif method == "tools/list":
+        result = {"tools": TOOLS}
+    elif method == "tools/call":
+        params = req.get("params", {})
+        try:
+            out = call_tool(params.get("name", ""), params.get("arguments", {}))
+            result = {
+                "content": [{"type": "text", "text": json.dumps(out)}],
+                "isError": False,
+            }
+        except Exception as exc:  # noqa: BLE001 - tool errors go to the model
+            result = {
+                "content": [{"type": "text", "text": f"error: {exc}"}],
+                "isError": True,
+            }
+    elif method == "ping":
+        result = {}
+    else:
+        if msg_id is None:
+            return None  # unknown notification: ignore
+        return {
+            "jsonrpc": "2.0",
+            "id": msg_id,
+            "error": {"code": -32601, "message": f"method not found: {method}"},
+        }
+    if msg_id is None:
+        return None
+    return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
+
+def serve_stdio(stdin=None, stdout=None) -> None:
+    stdin = stdin or sys.stdin
+    stdout = stdout or sys.stdout
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        resp = handle_request(req)
+        if resp is not None:
+            stdout.write(json.dumps(resp) + "\n")
+            stdout.flush()
+
+
+if __name__ == "__main__":
+    serve_stdio()

@@ -1,0 +1,68 @@
+"""Attribution postprocessing: operator notifications.
+
+Reference analog: ``attribution/postprocessing/slack.py`` — push verdicts to
+a webhook so operators see failures without tailing logs.  Generic webhook
+poster (Slack-compatible payload shape), usable as an
+:class:`AttributionPipeline` postprocess stage:
+
+    pipeline = AttributionPipeline(attribute=..., postprocess=[
+        WebhookNotifier(os.environ["SLACK_WEBHOOK_URL"],
+                        only_categories={"oom_hbm", "numerics"}),
+    ])
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional, Set
+
+from ..utils.logging import get_logger
+from .base import AttributionResult
+
+log = get_logger("notify")
+
+
+def format_verdict(result: AttributionResult, job: str = "") -> str:
+    lines = [
+        f"*{job or 'training job'}*: `{result.category}` "
+        f"(confidence {result.confidence:.0%})",
+        result.summary,
+        f"culprit ranks: {result.culprit_ranks or 'n/a'}",
+        f"auto-resume: {'yes' if result.should_resume else 'NO — operator action needed'}",
+    ]
+    return "\n".join(lines)
+
+
+class WebhookNotifier:
+    """POSTs ``{"text": ...}`` (Slack-compatible) per verdict."""
+
+    def __init__(
+        self,
+        webhook_url: str,
+        job: str = "",
+        only_categories: Optional[Set[str]] = None,
+        min_confidence: float = 0.0,
+        timeout: float = 10.0,
+    ):
+        self.url = webhook_url
+        self.job = job
+        self.only_categories = only_categories
+        self.min_confidence = min_confidence
+        self.timeout = timeout
+
+    def __call__(self, result: AttributionResult, ctx=None) -> AttributionResult:
+        if self.only_categories and result.category not in self.only_categories:
+            return result
+        if result.confidence < self.min_confidence:
+            return result
+        try:
+            req = urllib.request.Request(
+                self.url,
+                data=json.dumps({"text": format_verdict(result, self.job)}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        except Exception as exc:  # noqa: BLE001 - notification loss is not fatal
+            log.warning("webhook notification failed: %s", exc)
+        return result

@@ -34,6 +34,21 @@ class CycleLogRouter:
         self._file: Optional[IO[str]] = None
         self._file_lock = threading.Lock()
         self._readers: Dict[Tuple[int, str], threading.Thread] = {}
+        self._funnel = None
+        funnel = os.environ.get("TPURX_LOG_FUNNEL")
+        if funnel:
+            # stream worker lines into the cluster log funnel as well
+            try:
+                import logging as _logging
+
+                from ..utils.log_funnel import LogForwarder
+
+                host, _, port = funnel.rpartition(":")
+                fwd = LogForwarder(host, int(port))
+                fwd.setFormatter(_logging.Formatter("%(message)s"))
+                self._funnel = fwd
+            except Exception:  # noqa: BLE001 - funnel is best-effort
+                log.exception("could not attach log funnel %s", funnel)
         if log_dir:
             os.makedirs(log_dir, exist_ok=True)
 
@@ -70,6 +85,11 @@ class CycleLogRouter:
                 with self._file_lock:
                     if self._file:
                         self._file.write(out + "\n")
+                if self._funnel is not None:
+                    record = __import__("logging").LogRecord(
+                        "worker", 20, "", 0, out, None, None
+                    )
+                    self._funnel.emit(record)
                 if self.tee:
                     print(out, flush=True)
 
@@ -78,3 +98,6 @@ class CycleLogRouter:
             if self._file:
                 self._file.close()
                 self._file = None
+        if self._funnel is not None:
+            self._funnel.close()
+            self._funnel = None

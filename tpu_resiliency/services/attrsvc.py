@@ -36,6 +36,10 @@ class _State:
         self.lock = threading.Lock()
         self.requests = 0
         self.cache_hits = 0
+        self.coalesced = 0
+        # digest -> Event; concurrent identical requests wait for the first
+        # (reference coalescing/coalescer.py analog)
+        self.in_flight: Dict[str, threading.Event] = {}
 
 
 STATE = _State()
@@ -76,6 +80,7 @@ class Handler(BaseHTTPRequestHandler):
                     {
                         "requests": STATE.requests,
                         "cache_hits": STATE.cache_hits,
+                        "coalesced": STATE.coalesced,
                         "cache_entries": len(STATE.cache),
                     },
                 )
@@ -135,16 +140,29 @@ class Handler(BaseHTTPRequestHandler):
         except OSError as exc:
             return self._send(400, {"error": f"cannot read {path}: {exc}"})
         digest = hashlib.sha256(text.encode()).hexdigest()
-        with STATE.lock:
-            cached = STATE.cache.get(digest)
-            if cached is not None:
-                STATE.cache_hits += 1
-                return self._send(200, {**cached, "cached": True})
-        verdict = _verdict_to_dict(STATE.analyzer.analyze_text(text))
-        with STATE.lock:
-            if len(STATE.cache) > 1024:
-                STATE.cache.clear()
-            STATE.cache[digest] = verdict
+        while True:
+            with STATE.lock:
+                cached = STATE.cache.get(digest)
+                if cached is not None:
+                    STATE.cache_hits += 1
+                    return self._send(200, {**cached, "cached": True})
+                pending = STATE.in_flight.get(digest)
+                if pending is None:
+                    STATE.in_flight[digest] = threading.Event()
+                    break
+                STATE.coalesced += 1
+            pending.wait(timeout=60.0)  # first requester computes; we reuse
+        try:
+            verdict = _verdict_to_dict(STATE.analyzer.analyze_text(text))
+            with STATE.lock:
+                if len(STATE.cache) > 1024:
+                    STATE.cache.clear()
+                STATE.cache[digest] = verdict
+        finally:
+            with STATE.lock:
+                ev = STATE.in_flight.pop(digest, None)
+            if ev is not None:
+                ev.set()
         return self._send(200, verdict)
 
     def _analyze_trace(self, body: dict):
