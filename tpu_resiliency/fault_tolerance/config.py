@@ -36,7 +36,6 @@ class FaultToleranceConfig:
     # --- restart policy ---
     max_rank_restarts: int = 0  # in-job worker restarts before giving up (0 = unlimited)
     max_no_progress_cycles: int = 3
-    restart_policy: str = "any-failed"  # any-failed | min-healthy
     term_signal: str = "SIGKILL"
     workers_stop_timeout: float = 15.0
     # bind worker i to NUMA node (i * nodes // nproc) via numactl when available

@@ -140,7 +140,7 @@ class ElasticAgent:
             max_restarts if max_restarts is not None else cfg.max_rank_restarts
         )
         self.node_id = node_id or f"{os.uname().nodename}-{uuid.uuid4().hex[:8]}"
-        self.slice_key = slice_key
+        self.slice_key = slice_key or cfg.node_group_key or ""
         self.remaining_restarts = self.max_restarts
         self._store_server: Optional[StoreServer] = None
         self._host_loop: Optional[HostRoundLoop] = None
@@ -149,7 +149,8 @@ class ElasticAgent:
         self.monitors: List = []  # (proc, ctrl_conn, socket_path)
         self.log_router = CycleLogRouter(cfg.per_cycle_log_dir)
         self.progress = TrainingProgressTracker(
-            cfg.progress_iteration_file, cfg.max_no_progress_cycles
+            cfg.progress_iteration_file if cfg.enable_progress_tracking else None,
+            cfg.max_no_progress_cycles,
         )
         self.cycle_info = None
         if host_store and cfg.cycle_info_dir:

@@ -86,7 +86,9 @@ class FaultToleranceCallback(Callback):
         from ..fault_tolerance import RankMonitorClient
 
         self.client = client or RankMonitorClient()
-        self.state_path = state_path
+        self.state_path = state_path or getattr(
+            self.client.cfg, "state_dict_path", None
+        )
         self.machine = _TrainingStateMachine(warmup_steps)
         self.update_interval = update_interval
         self._last_update_step = -1
