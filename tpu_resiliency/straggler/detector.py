@@ -90,6 +90,19 @@ class Detector:
             out[name] = self.device_timer.wrap(fn, name)
         return out
 
+    @contextlib.contextmanager
+    def profiled_step(self):
+        """Sampled per-op capture: profile the enclosed step with the XLA
+        profiler and record op durations into the device stats (the CUPTI
+        per-kernel analog).  Costs ~tens of ms — call every Nth step, not
+        every step."""
+        from .xla_profile import XlaProfileCollector
+
+        if not hasattr(self, "_xla_collector"):
+            self._xla_collector = XlaProfileCollector(self.device)
+        with self._xla_collector.capture():
+            yield
+
     def _tick(self) -> None:
         # accumulate: a due report must survive further ticks until consumed
         if self.tracker.tick():

@@ -1,0 +1,98 @@
+"""XLA-profile timer backend: per-op device durations from JAX traces.
+
+The reference's CUPTI extension records per-kernel durations on every
+detection section (``cupti_src/``); the XLA analog captures a JAX profiler
+trace and aggregates the device-lane op events.  The emitted Chrome-trace
+JSON is parsed with the stdlib (the xplane protobuf bindings in this image
+are version-broken, and a hard dependency on them would be fragile anyway).
+
+Profiling a step costs more than the reference's always-on CUPTI buffers
+(trace start/stop ≈ tens of ms), so the collector is designed for **sampled**
+capture — wrap one step every N report rounds:
+
+    collector = XlaProfileCollector(detector.device)
+    with collector.capture():
+        step_fn(...)   # one profiled step
+    # per-op durations now in the detector's device DurationStore ("xla:...")
+
+Op-name durations feed the same relative/individual scoring as section and
+callable timings — per-op granularity pinpoints WHICH op is slow on a
+straggling rank (the CUPTI per-kernel capability).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, List
+
+from ..utils.logging import get_logger
+from .timers import DurationStore
+
+log = get_logger("straggler.xla")
+
+
+def parse_trace_dir(trace_dir: str) -> Dict[str, List[float]]:
+    """Aggregate op durations (seconds) from a profiler dump directory.
+
+    Takes complete ('X') events from non-Python lanes — on TPU these are the
+    device "XLA Ops" lanes; on CPU the xla codegen threads — keyed by op
+    name."""
+    out: Dict[str, List[float]] = {}
+    for path in glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ):
+        with gzip.open(path) as f:
+            data = json.load(f)
+        events = data.get("traceEvents", [])
+        lanes: Dict[tuple, str] = {}
+        for e in events:
+            if e.get("ph") == "M" and e.get("name") == "thread_name":
+                lanes[(e.get("pid"), e.get("tid"))] = e["args"].get("name", "")
+        for e in events:
+            if e.get("ph") != "X" or not e.get("dur"):
+                continue
+            lane = lanes.get((e.get("pid"), e.get("tid")), "")
+            if lane == "python" or lane.startswith("tf_Compile"):
+                continue  # host-side python frames are not device time
+            name = e.get("name", "?")
+            if name.startswith("$"):  # python frame events in unnamed lanes
+                continue
+            out.setdefault(name, []).append(float(e["dur"]) / 1e6)  # µs → s
+    return out
+
+
+class XlaProfileCollector:
+    def __init__(self, store: DurationStore, prefix: str = "xla:", top_k: int = 64):
+        self.store = store
+        self.prefix = prefix
+        self.top_k = top_k
+        self.last_capture: Dict[str, List[float]] = {}
+
+    @contextlib.contextmanager
+    def capture(self):
+        """Profile the enclosed step(s); record per-op durations on exit."""
+        import jax
+
+        trace_dir = tempfile.mkdtemp(prefix="tpurx-xlaprof-")
+        try:
+            with jax.profiler.trace(trace_dir):
+                yield
+            per_op = parse_trace_dir(trace_dir)
+            # keep the top_k ops by total time: straggler scores weight by
+            # total anyway, and unbounded op-name cardinality would bloat
+            # every report
+            ranked = sorted(
+                per_op.items(), key=lambda kv: -sum(kv[1])
+            )[: self.top_k]
+            self.last_capture = dict(ranked)
+            for name, durs in ranked:
+                for d in durs:
+                    self.store.record(self.prefix + name, d)
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
