@@ -61,6 +61,7 @@ class Detector:
         # per-name best historical median (for individual scores)
         self._best_medians: Dict[str, float] = {}
         self._initialized = False
+        self._xla_collector = None  # built on first profiled_step()
 
     def initialize(self) -> None:
         self._initialized = True
@@ -98,7 +99,7 @@ class Detector:
         every step."""
         from .xla_profile import XlaProfileCollector
 
-        if not hasattr(self, "_xla_collector"):
+        if self._xla_collector is None:
             self._xla_collector = XlaProfileCollector(self.device)
         with self._xla_collector.capture():
             yield

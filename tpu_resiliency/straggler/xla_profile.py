@@ -37,31 +37,54 @@ from .timers import DurationStore
 log = get_logger("straggler.xla")
 
 
+# Runtime bookkeeping spans sharing the op lanes: not op time.  "end: <op>"
+# markers would double-count ops; executor/listener spans cover whole
+# executions and would dilute per-op weighting; "XLA Modules"/"Steps" lane
+# aggregates likewise.
+_NON_OP_PREFIXES = ("end: ", "$")
+_NON_OP_SUBSTRINGS = (
+    "ThunkExecutor", "ThreadpoolListener", "ExecuteThunks", "BufferAllocations",
+)
+_NON_OP_LANE_SUBSTRINGS = ("python", "Steps", "XLA Modules", "tf_Compile", "Framework")
+
+
+def _is_op_event(name: str, lane: str) -> bool:
+    if any(s in lane for s in _NON_OP_LANE_SUBSTRINGS):
+        return False
+    if name.startswith(_NON_OP_PREFIXES):
+        return False
+    if any(s in name for s in _NON_OP_SUBSTRINGS):
+        return False
+    return True
+
+
 def parse_trace_dir(trace_dir: str) -> Dict[str, List[float]]:
     """Aggregate op durations (seconds) from a profiler dump directory.
 
-    Takes complete ('X') events from non-Python lanes — on TPU these are the
-    device "XLA Ops" lanes; on CPU the xla codegen threads — keyed by op
-    name."""
+    Takes complete ('X') events from the op lanes — on TPU the device
+    "XLA Ops" lanes; on CPU the PjRt client execution threads — keyed by op
+    name, with runtime bookkeeping spans filtered (see ``_is_op_event``)."""
     out: Dict[str, List[float]] = {}
     for path in glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
     ):
-        with gzip.open(path) as f:
-            data = json.load(f)
+        try:
+            with gzip.open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as exc:
+            log.warning("unparseable trace file %s: %s", path, exc)
+            continue
         events = data.get("traceEvents", [])
         lanes: Dict[tuple, str] = {}
         for e in events:
             if e.get("ph") == "M" and e.get("name") == "thread_name":
-                lanes[(e.get("pid"), e.get("tid"))] = e["args"].get("name", "")
+                lanes[(e.get("pid"), e.get("tid"))] = e.get("args", {}).get("name", "")
         for e in events:
             if e.get("ph") != "X" or not e.get("dur"):
                 continue
             lane = lanes.get((e.get("pid"), e.get("tid")), "")
-            if lane == "python" or lane.startswith("tf_Compile"):
-                continue  # host-side python frames are not device time
             name = e.get("name", "?")
-            if name.startswith("$"):  # python frame events in unnamed lanes
+            if not _is_op_event(name, lane):
                 continue
             out.setdefault(name, []).append(float(e["dur"]) / 1e6)  # µs → s
     return out
