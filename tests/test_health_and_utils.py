@@ -1,0 +1,149 @@
+"""Health checks, profiling recorder, log config tests (previously indirect)."""
+
+import json
+import logging
+import os
+import time
+
+import pytest
+
+from tpu_resiliency.health import (
+    ChainedHealthCheck,
+    DeviceHealthCheck,
+    HealthCheck,
+    HealthCheckResult,
+    NicLinkHealthCheck,
+    NodeResourceHealthCheck,
+    StoragePathHealthCheck,
+)
+from tpu_resiliency.utils.profiling import ProfilingEvent, ProfilingRecorder
+
+
+class _Fail(HealthCheck):
+    name = "always_fail"
+
+    def _check(self):
+        return HealthCheckResult(False, "nope")
+
+
+class _Pass(HealthCheck):
+    name = "always_pass"
+
+    def _check(self):
+        return HealthCheckResult(True, "fine")
+
+
+class _Boom(HealthCheck):
+    name = "crasher"
+
+    def _check(self):
+        raise RuntimeError("check exploded")
+
+
+class TestHealthChecks:
+    def test_chained_fail_fast(self):
+        result = ChainedHealthCheck([_Pass(), _Fail(), _Pass()]).run()
+        assert not result.healthy
+        assert result.name == "always_fail"
+
+    def test_chained_collect_all(self):
+        result = ChainedHealthCheck([_Fail(), _Boom()], fail_fast=False).run()
+        assert not result.healthy
+        assert "always_fail" in result.message and "crasher" in result.message
+
+    def test_crashing_check_is_unhealthy(self):
+        result = _Boom().run()
+        assert not result.healthy
+        assert "check exploded" in result.message
+        assert result.duration_s >= 0
+
+    def test_node_resources_ok_by_default(self):
+        assert NodeResourceHealthCheck().run().healthy
+
+    def test_node_resources_disk_threshold(self, tmp_path):
+        result = NodeResourceHealthCheck(
+            min_free_disk_mb=10 ** 9, disk_path=str(tmp_path)
+        ).run()
+        assert not result.healthy
+        assert "low disk" in result.message
+
+    def test_storage_probe_roundtrip(self, tmp_path):
+        result = StoragePathHealthCheck(str(tmp_path)).run()
+        assert result.healthy
+        # no probe files left behind
+        assert not list(tmp_path.iterdir())
+
+    def test_storage_probe_unwritable(self, tmp_path):
+        # a regular file as path parent fails regardless of uid (root
+        # ignores permission bits, so chmod-based denial would not)
+        blocker = tmp_path / "file"
+        blocker.write_text("x")
+        result = StoragePathHealthCheck(str(blocker / "sub")).run()
+        assert not result.healthy
+
+    def test_nic_link_check_with_fake_sysfs(self, tmp_path):
+        for iface, state in (("eth0", "up"), ("eth1", "down")):
+            d = tmp_path / iface
+            d.mkdir()
+            (d / "operstate").write_text(state + "\n")
+        ok = NicLinkHealthCheck(["eth0"], sys_net=str(tmp_path)).run()
+        assert ok.healthy
+        bad = NicLinkHealthCheck(sys_net=str(tmp_path)).run()
+        assert not bad.healthy
+        assert "eth1=down" in bad.message
+
+    def test_device_probe_via_subprocess(self):
+        DeviceHealthCheck.clear_cache()
+        result = DeviceHealthCheck(
+            timeout=120, env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+        ).run()
+        assert result.healthy, result.message
+        # cached on second run
+        again = DeviceHealthCheck(timeout=1).run()
+        assert again.healthy and "cached" in again.message
+        DeviceHealthCheck.clear_cache()
+
+
+class TestProfilingRecorder:
+    def test_records_and_latency(self, tmp_path):
+        path = str(tmp_path / "prof.jsonl")
+        rec = ProfilingRecorder(path=path, cycle=2)
+        rec.record(ProfilingEvent.FAILURE_DETECTED, rank=3)
+        time.sleep(0.01)
+        rec.record(ProfilingEvent.WORKER_STARTED)
+        lat = rec.latency_ns(ProfilingEvent.FAILURE_DETECTED, ProfilingEvent.WORKER_STARTED)
+        assert lat is not None and lat > 0
+        lines = [json.loads(l) for l in open(path)]
+        assert lines[0]["event"] == "failure_detected"
+        assert lines[0]["cycle"] == 2
+        assert lines[0]["rank"] == 3
+
+    def test_latency_none_when_missing(self):
+        rec = ProfilingRecorder()
+        assert rec.latency_ns(ProfilingEvent.FAILURE_DETECTED, ProfilingEvent.WORKER_STARTED) is None
+
+
+def test_log_funnel_gap_detection(tmp_path):
+    """A skipped batch sequence is surfaced in the aggregate log."""
+    import socket
+    import struct
+
+    from tpu_resiliency.utils.log_funnel import RootLogServer
+
+    root = RootLogServer(str(tmp_path / "agg.log"), host="127.0.0.1", flush_age=0.05)
+    U32 = struct.Struct("<I")
+
+    def send(batch):
+        raw = json.dumps(batch).encode()
+        s = socket.create_connection(("127.0.0.1", root.port))
+        s.sendall(U32.pack(len(raw)) + raw)
+        s.close()
+
+    send({"source": "n1", "seq": 1, "lines": ["a"]})
+    send({"source": "n1", "seq": 4, "lines": ["b"], "dropped": 2})
+    time.sleep(0.4)
+    root.close()
+    content = (tmp_path / "agg.log").read_text()
+    assert "[n1] a" in content and "[n1] b" in content
+    assert "GAP from n1" in content
+    assert "dropped 2 lines" in content

@@ -40,7 +40,10 @@ class HealthCheck(abc.ABC):
             result = self._check()
         except Exception as exc:  # noqa: BLE001 - a crashing check is unhealthy
             result = HealthCheckResult(False, f"{type(exc).__name__}: {exc}")
-        result.name = self.name
+        if not result.name:
+            # keep the inner check's name when a wrapper (Chained) returns
+            # its result — "which check failed" is the useful signal
+            result.name = self.name
         result.duration_s = time.monotonic() - t0
         record_event(
             ProfilingEvent.HEALTH_CHECK_COMPLETED,
