@@ -120,3 +120,27 @@ def test_hot_spare_takes_over(tmp_path):
     assert procs["C"].returncode == 0
     assert int((tmp_path / "progress.txt").read_text()) == 10
     assert "injecting crash" in outs["A"] + outs["B"] + outs["C"]
+
+
+def test_two_nodes_crash_restart_native_store(tmp_path):
+    """Same two-node crash/restart flow, served by the C++ store."""
+    port = free_port()
+    env = base_env(tmp_path)
+    env["TOY_FAIL"] = "0:3:4"
+    env["TPURX_NATIVE_STORE"] = "1"
+    a = subprocess.Popen(
+        launcher_cmd(port, "2", "nodeA", host_store=True, nproc=2),
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    b = subprocess.Popen(
+        launcher_cmd(port, "2", "nodeB", nproc=2),
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    out_a, _ = a.communicate(timeout=120)
+    out_b, _ = b.communicate(timeout=120)
+    if a.returncode != 0 or b.returncode != 0:
+        print("A:", out_a[-3000:])
+        print("B:", out_b[-3000:])
+    assert a.returncode == 0 and b.returncode == 0
+    assert int((tmp_path / "progress.txt").read_text()) == 12
+    assert "hosting native C++ store" in out_a

@@ -274,3 +274,33 @@ def test_stale_writer_cannot_corrupt_new_round(rdzv_store):
     assert r.round_num == 1
     assert r.participants == [r.participants[0]]  # exactly one participant
     assert "zombie" not in r.participants
+
+
+def test_round_gc_reclaims_old_rounds(rdzv_store):
+    """Crash-looping jobs must not grow the store: old rounds' keys are GCed."""
+    store = rdzv_store()
+    host = RendezvousHost(store, min_nodes=1, max_nodes=1, settle_time=0.05)
+    host.bootstrap()
+    host.open_round()
+    for round_num in range(5):
+        results = {}
+        t = threading.Thread(
+            target=_run_join, args=(rdzv_store, NodeDesc.create(f"n-{round_num}"), results)
+        )
+        t.start()
+        host.close_round_when_ready(timeout=20.0)
+        t.join(timeout=20.0)
+        from tpu_resiliency.fault_tolerance.rendezvous import request_restart
+
+        if round_num < 4:
+            request_restart(store, "loop")
+            host.open_round()
+    # rounds older than current-2 are gone; recent rounds remain
+    old_keys = [
+        k for k in store.list_keys("rdzv/")
+        if any(k.decode().startswith(f"rdzv/{kind}/{n}") or f"/{n}/" in k.decode()
+               for kind in ("open", "done", "result") for n in (0, 1))
+    ]
+    assert not any(b"rdzv/result/0" in k or b"rdzv/result/1" in k
+                   for k in store.list_keys("rdzv/result/"))
+    assert store.check(["rdzv/result/4"])

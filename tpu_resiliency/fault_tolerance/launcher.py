@@ -183,6 +183,7 @@ class ElasticAgent:
                 self._store_server = NativeStoreServer(
                     host="0.0.0.0", port=self.store_port
                 ).start()
+                log.info("hosting native C++ store on port %s", self._store_server.port)
             else:
                 self._store_server = StoreServer(
                     host="0.0.0.0", port=self.store_port

@@ -269,10 +269,30 @@ class RendezvousHost:
             self.store.compare_set(K_ACTIVE_ROUND, str(n).encode(), str(target).encode())
             self.store.set(k_open(target), b"1")
             cycle = self.store.add(K_CYCLE, 1) - 1
+            self._gc_old_rounds(target)
             log.info("rendezvous round %s open (cycle %s)", target, cycle)
             record_event(ProfilingEvent.RENDEZVOUS_STARTED, round=target, cycle=cycle)
             return target
         return n
+
+    def _gc_old_rounds(self, current: int, keep: int = 2) -> None:
+        """Delete keys of rounds older than ``current - keep``: a job crash-
+        looping for days must not grow the store unboundedly.  Stale writers
+        are already fenced by round-numbered keys; GC only reclaims memory."""
+        cutoff = current - keep
+        if cutoff < 0:
+            return
+        prefixes = ("rdzv/open/", "rdzv/closed/", "rdzv/join_count/",
+                    "rdzv/node/", "rdzv/result/", "rdzv/done/",
+                    "rdzv/restart_req/")
+        try:
+            for prefix in prefixes:
+                for key in self.store.list_keys(prefix):
+                    tail = key.decode()[len(prefix):].split("/", 1)[0]
+                    if tail.isdigit() and int(tail) < cutoff:
+                        self.store.delete(key)
+        except Exception:  # noqa: BLE001 - GC must never break a round open
+            log.exception("round GC failed (continuing)")
 
     def close_round_when_ready(self, timeout: float = 600.0) -> int:
         """Step 2: wait for >= min_nodes joiners (plus a settle window to let
