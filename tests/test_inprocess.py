@@ -243,3 +243,42 @@ class TestActivateWholeGroups:
         p = ActivateWholeGroups(lambda r: r // 4, 4, min_groups=2)
         with pytest.raises(RestartAbort):
             p(RankAssignmentCtx(_state(0, 8), {6}))
+
+
+def test_completion_and_terminate_hooks(store_server):
+    """Completion transforms the return value; terminate fires on RestartAbort."""
+    import threading
+
+    from tpu_resiliency.inprocess import Wrapper
+    from tpu_resiliency.inprocess.exceptions import RestartAbort
+    from tpu_resiliency.store import StoreClient
+
+    calls = {"completion": 0, "terminate": 0}
+
+    def completion(state, ret):
+        calls["completion"] += 1
+        return ret + "!"
+
+    def terminate(state):
+        calls["terminate"] += 1
+
+    def factory():
+        return StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+
+    os.environ["TPURX_RANK"] = "0"
+    os.environ["TPURX_WORLD_SIZE"] = "1"
+    try:
+        w1 = Wrapper(store_factory=factory, group="hooks1", completion=completion,
+                     enable_monitor_process=False, enable_sibling_monitor=False)
+        assert w1(lambda: "done")() == "done!"
+        assert calls["completion"] == 1
+
+        w2 = Wrapper(store_factory=factory, group="hooks2", terminate=terminate,
+                     max_iterations=0,
+                     enable_monitor_process=False, enable_sibling_monitor=False)
+        with pytest.raises(RestartAbort):
+            w2(lambda: "never")()
+        assert calls["terminate"] == 1
+    finally:
+        os.environ.pop("TPURX_RANK", None)
+        os.environ.pop("TPURX_WORLD_SIZE", None)

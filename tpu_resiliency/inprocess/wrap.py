@@ -56,6 +56,8 @@ class Wrapper:
         finalize: Optional[Callable] = None,
         health_check: Optional[Callable] = None,
         rank_assignment: Optional[Callable] = None,
+        completion: Optional[Callable] = None,
+        terminate: Optional[Callable] = None,
         max_iterations: Optional[int] = None,
         soft_timeout: float = 60.0,
         hard_timeout: float = 90.0,
@@ -74,6 +76,8 @@ class Wrapper:
         self.abort = abort
         self.finalize = finalize
         self.health_check = health_check
+        self.completion = completion
+        self.terminate = terminate
         self.rank_assignment = rank_assignment or ShiftRanks()
         self.max_iterations = max_iterations
         self.soft_timeout = soft_timeout
@@ -90,7 +94,17 @@ class Wrapper:
     def __call__(self, fn: Callable) -> Callable:
         def wrapped(*args, **kwargs):
             with CallWrapper(self, fn) as cw:
-                return cw.run(*args, **kwargs)
+                try:
+                    return cw.run(*args, **kwargs)
+                except RestartAbort:
+                    if self.terminate:
+                        # Terminate plugin (reference `terminate.py` ABC):
+                        # last hook before this rank leaves the loop for good
+                        try:
+                            self.terminate(cw.state.freeze())
+                        except Exception:  # noqa: BLE001
+                            log.exception("terminate plugin failed")
+                    raise
 
         wrapped.__name__ = getattr(fn, "__name__", "wrapped")
         return wrapped
@@ -228,6 +242,11 @@ class CallWrapper:
                     if self._accepts_cw:
                         kwargs = {**kwargs, "call_wrapper": self}
                     ret = self.fn(*args, **kwargs)
+                    if w.completion:
+                        # Completion plugin (reference `completion.py` ABC):
+                        # may transform/validate the return value before the
+                        # group is released
+                        ret = w.completion(state.freeze(), ret)
                     self.ops.mark_completed(iteration)
                     return ret
                 else:
