@@ -20,6 +20,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -108,6 +109,16 @@ def main() -> None:
         ],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
+    # drain stdout continuously: a full 64KB pipe would block the launcher
+    # and wedge the very run being measured
+    chunks: list = []
+
+    def _drain():
+        for line in proc.stdout:
+            chunks.append(line)
+
+    reader = threading.Thread(target=_drain, daemon=True)
+    reader.start()
     deadline = time.monotonic() + args.seconds
     progress_samples = []
     while time.monotonic() < deadline and proc.poll() is None:
@@ -117,7 +128,13 @@ def main() -> None:
         except OSError:
             progress_samples.append(0)
     proc.terminate()
-    out, _ = proc.communicate(timeout=30)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()  # never leak the launcher tree from the soak itself
+        proc.wait(timeout=10)
+    reader.join(timeout=10)
+    out = "".join(chunks)
 
     cycles = out.count("rendezvous round")
     crashes = out.count("] crash at step")

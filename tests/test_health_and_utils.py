@@ -160,7 +160,11 @@ def test_shm_janitor_removes_only_orphans(tmp_path, monkeypatch):
     orphan_name = orphan.name
     orphan.close()  # unmapped by everyone, but still linked in /dev/shm
     try:
-        monkeypatch.setattr(sj, "_age", lambda path: 10_000.0)
+        ours = {held.name.lstrip("/"), orphan_name.lstrip("/")}
+        monkeypatch.setattr(
+            sj, "_age",
+            lambda path: 10_000.0 if path.rsplit("/", 1)[1] in ours else 0.0,
+        )
         removed = sj.sweep(min_age_s=600.0)
         assert orphan_name.lstrip("/") in [r.lstrip("/") for r in removed]
         assert held.name.lstrip("/") not in [r.lstrip("/") for r in removed]

@@ -26,19 +26,24 @@ _PREFIXES = ("psm_",)
 
 
 def _mapped_shm_names() -> Set[str]:
-    """Names of shm files currently mapped by any live process."""
+    """Names of shm files currently mapped by any live process.
+
+    Raises OSError when /proc cannot be enumerated — the caller must then
+    SKIP the sweep (an empty answer would read as "nothing is mapped" and
+    delete segments live processes still hold)."""
     mapped: Set[str] = set()
-    try:
-        pids = [p for p in os.listdir("/proc") if p.isdigit()]
-    except OSError:
-        return mapped
+    pids = [p for p in os.listdir("/proc") if p.isdigit()]
+    if not pids:
+        raise OSError("/proc listed no processes — masked procfs?")
     for pid in pids:
         try:
             with open(f"/proc/{pid}/maps") as f:
                 for line in f:
                     if SHM_DIR + "/" in line:
+                        # path may carry a trailing " (deleted)" token, which
+                        # split() already isolates; never rstrip a char set
                         name = line.rsplit(SHM_DIR + "/", 1)[1].split()[0]
-                        mapped.add(name.rstrip(" (deleted)"))
+                        mapped.add(name)
         except OSError:
             continue  # process exited or not ours
     return mapped
@@ -59,7 +64,13 @@ def sweep(min_age_s: float = 600.0, prefixes=_PREFIXES, dry_run: bool = False) -
     ]
     if not candidates:
         return removed
-    mapped = _mapped_shm_names()
+    try:
+        mapped = _mapped_shm_names()
+    except OSError as exc:
+        # fail CLOSED: without a trustworthy map scan we cannot distinguish
+        # orphans from held segments
+        log.warning("skipping shm sweep (cannot scan /proc): %s", exc)
+        return removed
     for name in candidates:
         if name in mapped:
             continue  # somebody still holds it
