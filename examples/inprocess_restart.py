@@ -20,6 +20,13 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # demo mode: some TPU sandboxes force-register their platform via
+    # sitecustomize, overriding the env var — override it back
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from tpu_resiliency.inprocess import (
     Compose,
     DeviceProbeHealthCheck,
