@@ -112,3 +112,22 @@ def test_quorum_monitor_detects_stale():
     assert hits
     latency_ms = (time.monotonic() - t0) * 1000
     assert latency_ms < 2000
+
+
+def test_quorum_tick_pipelined():
+    mesh = make_mesh(("all",), (8,))
+    hits = []
+    mon = QuorumMonitor(
+        mesh, budget_ms=100.0, interval=0.01,
+        on_stale=lambda age: hits.append(age), use_pallas=False,
+    )
+    mon.beat()
+    assert mon.tick_pipelined() is None      # first call primes the pipe
+    age1 = mon.tick_pipelined()
+    assert age1 is not None and age1 < 100
+    # stop beating; ages grow; stale fires once past budget (1-tick lag)
+    time.sleep(0.15)
+    mon.tick_pipelined()
+    age = mon.tick_pipelined()
+    assert age is not None and age >= 100
+    assert hits
