@@ -238,9 +238,12 @@ class QuorumMonitor:
         return self
 
     def _loop(self) -> None:
+        # pipelined ticks: the device round-trip hides behind the interval,
+        # so the effective detection cadence is ~interval instead of
+        # interval + round-trip (documented one-tick result lag)
         while not self._stop.is_set():
             try:
-                self.tick()
+                self.tick_pipelined()
             except Exception as exc:  # noqa: BLE001
                 log.warning("quorum tick failed: %s", exc)
                 return
