@@ -201,3 +201,28 @@ def test_monitor_in_subprocess(tmp_path):
         proc.join(timeout=5)
         if proc.is_alive():
             proc.terminate()
+
+
+def test_stale_connection_cannot_mutate_state(monitor):
+    """A lingering previous worker's messages are refused once a new worker
+    INITs (heartbeats, sections, timeout updates)."""
+    cfg = FaultToleranceConfig(workload_check_interval=5.0, skip_section_response=False)
+    st, path = monitor(cfg)
+    old = _client(cfg, path, rank=0)
+    old.send_heartbeat()
+    time.sleep(0.02)
+    old.send_heartbeat()  # two observed intervals: timeout calc is possible
+    new = _client(cfg, path, rank=0)  # new cycle's worker takes ownership
+    new.send_heartbeat()
+    from tpu_resiliency.fault_tolerance.rank_monitor_client import (
+        RankMonitorClientError,
+    )
+
+    with pytest.raises(RankMonitorClientError, match="stale connection"):
+        old.send_heartbeat()
+    with pytest.raises(RankMonitorClientError, match="stale connection"):
+        old.calculate_and_set_hb_timeouts()
+    # the owner still works
+    new.send_heartbeat()
+    new.shutdown_workload_monitoring()
+    old.shutdown_workload_monitoring()

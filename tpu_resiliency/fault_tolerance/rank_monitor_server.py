@@ -216,6 +216,13 @@ class RankMonitorServer:
                 st.last_section_activity = now
             return {"type": MsgType.OK.value}
         if mtype == MsgType.UPDATE_TIMEOUTS:
+            if st.owner_conn is not None and conn_id != st.owner_conn:
+                # a lingering previous worker must not rewrite the learned
+                # timeouts under the current worker
+                return {
+                    "type": MsgType.ERROR.value,
+                    "error": "stale connection: another worker owns this monitor",
+                }
             if msg.get("hb_timeouts"):
                 self.hb_timeouts = heartbeat_timeouts_from_dict(msg["hb_timeouts"])
             if msg.get("section_timeouts"):
