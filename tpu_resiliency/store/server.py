@@ -156,10 +156,19 @@ class StoreServer:
                     log.warning("dropping connection: unknown opcode %r", header)
                     break
                 (nargs,) = _U32.unpack(await self._read_exact(reader, 4))
+                if nargs > 1 << 20:  # sanity caps match the native server
+                    log.warning("dropping connection: absurd nargs %d", nargs)
+                    break
                 args = []
                 for _ in range(nargs):
                     (ln,) = _U32.unpack(await self._read_exact(reader, 4))
+                    if ln > 1 << 30:
+                        log.warning("dropping connection: absurd arg len %d", ln)
+                        nargs = -1
+                        break
                     args.append(await self._read_exact(reader, ln) if ln else b"")
+                if nargs == -1:
+                    break
                 try:
                     resp = await self._handle_request(op, args)
                 except Exception as exc:  # noqa: BLE001 - report to client
