@@ -231,3 +231,39 @@ def test_control_plane_with_external_launchers(tmp_path):
     assert all(p.returncode == 0 for p in launchers)
     assert cp.returncode == 0
     assert int((tmp_path / "p.txt").read_text()) == 6
+
+
+def test_control_plane_native_store(tmp_path):
+    """Standalone control plane serving the C++ store to client launchers."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    import os
+
+    env = dict(os.environ)
+    env.update({"TPURX_REPO": str(REPO), "TOY_ITERS": "5",
+                "TOY_CKPT": str(tmp_path / "p.txt"),
+                "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0"})
+    cp = subprocess.Popen(
+        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.control_plane",
+         "--host", "127.0.0.1", "--port", str(port), "--min-nodes", "1",
+         "--settle-time", "0.3", "--native-store"],
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(2.0)
+    launcher = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+         "--nnodes", "1", "--nproc-per-node", "1",
+         "--rdzv-endpoint", f"127.0.0.1:{port}",
+         "--node-id", "n0", "--monitor-interval", "0.05",
+         str(REPO / "tests" / "workloads" / "toy_train.py")],
+        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=90,
+    )
+    cp_out, _ = cp.communicate(timeout=30)
+    if launcher.returncode != 0 or cp.returncode != 0:
+        print("CP:", cp_out[-2000:])
+        print("L:", (launcher.stdout + launcher.stderr)[-2000:])
+    assert launcher.returncode == 0
+    assert cp.returncode == 0
+    assert int((tmp_path / "p.txt").read_text()) == 5
