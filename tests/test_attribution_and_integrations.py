@@ -188,82 +188,69 @@ def test_log_funnel_roundtrip(tmp_path):
     assert "[nodeA] line 24" in content
 
 
-def test_control_plane_with_external_launchers(tmp_path):
-    """Launchers as pure store clients against a standalone control plane."""
+def _run_control_plane_job(tmp_path, *, native=False, nnodes=2, iters=6,
+                           extra_cp_args=()):
+    """Shared scaffold: standalone control plane + N client launchers."""
+    import os
+
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    import os
-
     env = dict(os.environ)
-    env.update({"TPURX_REPO": str(REPO), "TOY_ITERS": "6",
+    env.update({"TPURX_REPO": str(REPO), "TOY_ITERS": str(iters),
                 "TOY_CKPT": str(tmp_path / "p.txt"),
                 "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0"})
-    cp = subprocess.Popen(
-        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.control_plane",
-         "--host", "127.0.0.1", "--port", str(port), "--min-nodes", "2",
-         "--settle-time", "0.3"],
-        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    time.sleep(1.5)
-    launchers = [
-        subprocess.Popen(
-            [sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
-             "--nnodes", "2", "--nproc-per-node", "1",
-             "--rdzv-endpoint", f"127.0.0.1:{port}",
-             "--node-id", f"n{i}", "--monitor-interval", "0.05",
-             str(REPO / "tests" / "workloads" / "toy_train.py")],
-            cwd=str(REPO), env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    for p in launchers:
-        out, _ = p.communicate(timeout=90)
-        outs.append(out)
-    cp_out, _ = cp.communicate(timeout=30)
+    cp_cmd = [sys.executable, "-m", "tpu_resiliency.fault_tolerance.control_plane",
+              "--host", "127.0.0.1", "--port", str(port),
+              "--min-nodes", str(nnodes), "--settle-time", "0.3",
+              *extra_cp_args]
+    if native:
+        cp_cmd.append("--native-store")
+    cp = subprocess.Popen(cp_cmd, cwd=str(REPO), env=env,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    launchers, outs = [], []
+    try:
+        time.sleep(2.0)
+        launchers = [
+            subprocess.Popen(
+                [sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+                 "--nnodes", str(nnodes), "--nproc-per-node", "1",
+                 "--rdzv-endpoint", f"127.0.0.1:{port}",
+                 "--node-id", f"n{i}", "--monitor-interval", "0.05",
+                 str(REPO / "tests" / "workloads" / "toy_train.py")],
+                cwd=str(REPO), env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            )
+            for i in range(nnodes)
+        ]
+        for p in launchers:
+            out, _ = p.communicate(timeout=90)
+            outs.append(out)
+        cp_out, _ = cp.communicate(timeout=30)
+    finally:
+        # never leak the control plane (or launchers) into the session
+        for p in launchers:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        if cp.poll() is None:
+            cp.kill()
+            cp.communicate()
     if any(p.returncode != 0 for p in launchers) or cp.returncode != 0:
         print("CP:", cp_out[-2000:])
         for i, o in enumerate(outs):
             print(f"L{i}:", o[-2000:])
     assert all(p.returncode == 0 for p in launchers)
     assert cp.returncode == 0
-    assert int((tmp_path / "p.txt").read_text()) == 6
+    assert int((tmp_path / "p.txt").read_text()) == iters
+
+
+def test_control_plane_with_external_launchers(tmp_path):
+    """Launchers as pure store clients against a standalone control plane."""
+    _run_control_plane_job(tmp_path, nnodes=2, iters=6)
 
 
 def test_control_plane_native_store(tmp_path):
     """Standalone control plane serving the C++ store to client launchers."""
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    import os
-
-    env = dict(os.environ)
-    env.update({"TPURX_REPO": str(REPO), "TOY_ITERS": "5",
-                "TOY_CKPT": str(tmp_path / "p.txt"),
-                "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0"})
-    cp = subprocess.Popen(
-        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.control_plane",
-         "--host", "127.0.0.1", "--port", str(port), "--min-nodes", "1",
-         "--settle-time", "0.3", "--native-store"],
-        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    time.sleep(2.0)
-    launcher = subprocess.run(
-        [sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
-         "--nnodes", "1", "--nproc-per-node", "1",
-         "--rdzv-endpoint", f"127.0.0.1:{port}",
-         "--node-id", "n0", "--monitor-interval", "0.05",
-         str(REPO / "tests" / "workloads" / "toy_train.py")],
-        cwd=str(REPO), env=env, capture_output=True, text=True, timeout=90,
-    )
-    cp_out, _ = cp.communicate(timeout=30)
-    if launcher.returncode != 0 or cp.returncode != 0:
-        print("CP:", cp_out[-2000:])
-        print("L:", (launcher.stdout + launcher.stderr)[-2000:])
-    assert launcher.returncode == 0
-    assert cp.returncode == 0
-    assert int((tmp_path / "p.txt").read_text()) == 5
+    _run_control_plane_job(tmp_path, native=True, nnodes=1, iters=5)
