@@ -165,3 +165,25 @@ def test_progress_tracker_stops_crash_loop(tmp_path):
     assert "terminating early: no progress" in proc.stderr
     # stopped after 2 no-progress cycles, well under the 10-restart budget
     assert proc.stderr.count("worker failure detected") <= 3
+
+
+def test_ft_param_cli_overrides(tmp_path):
+    from tpu_resiliency.fault_tolerance.launcher import build_agent, parse_args
+
+    args = parse_args([
+        "--nnodes", "1", "--nproc-per-node", "1",
+        "--rdzv-endpoint", "127.0.0.1:1",
+        "--ft-param", "rank_heartbeat_timeout=33.5",
+        "--ft-param", "enable_device_health_check=false",
+        "--ft-param", "rank_section_timeouts={step: 12}",
+        "x.py",
+    ])
+    agent = build_agent(args)
+    assert agent.cfg.rank_heartbeat_timeout == 33.5
+    assert agent.cfg.enable_device_health_check is False
+    assert agent.cfg.rank_section_timeouts == {"step": 12}
+    with pytest.raises(SystemExit):
+        build_agent(parse_args([
+            "--nnodes", "1", "--rdzv-endpoint", "127.0.0.1:1",
+            "--ft-param", "not_a_field=1", "x.py",
+        ]))

@@ -563,6 +563,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--slice-key", default="", help="TPU slice / ICI domain id")
     p.add_argument("--max-restarts", type=int, default=None)
     p.add_argument("--ft-cfg", default=None, help="YAML config path")
+    p.add_argument(
+        "--ft-param", action="append", default=[], metavar="KEY=VALUE",
+        help="FaultToleranceConfig override (repeatable), e.g. "
+             "--ft-param rank_heartbeat_timeout=30 --ft-param max_nodes=8",
+    )
     p.add_argument("--monitor-interval", type=float, default=0.1)
     p.add_argument("--log-dir", default=None)
     p.add_argument("cmd", nargs=argparse.REMAINDER, help="worker command")
@@ -580,6 +585,18 @@ def build_agent(args: argparse.Namespace) -> ElasticAgent:
         if args.ft_cfg
         else FaultToleranceConfig()
     )
+    if args.ft_param:
+        from .config import _coerce
+        import dataclasses as _dc
+
+        types = {f.name: f.type for f in _dc.fields(FaultToleranceConfig)}
+        overrides = {}
+        for item in args.ft_param:
+            key, sep, value = item.partition("=")
+            if not sep or key not in types:
+                raise SystemExit(f"bad --ft-param {item!r} (unknown key or missing '=')")
+            overrides[key] = _coerce(value, types[key])
+        cfg = cfg.merged_with(overrides, allow_none=True)
     cfg = cfg.merged_with_env()
     if ":" in args.nnodes:
         mn, mx = args.nnodes.split(":")

@@ -175,9 +175,13 @@ class QuorumMonitor:
         self.mesh = mesh
         self.budget_ms = budget_ms
         self.interval = interval
-        self.on_stale = on_stale or (
-            lambda age: log.error("pod heartbeat stale by %.1fms", age)
-        )
+        def _default_on_stale(age):
+            from ..utils.profiling import ProfilingEvent, record_event
+
+            log.error("pod heartbeat stale by %.1fms", age)
+            record_event(ProfilingEvent.HANG_DETECTED, source="quorum", age_ms=age)
+
+        self.on_stale = on_stale or _default_on_stale
         self._fn = make_quorum_fn(mesh, use_pallas=use_pallas)
         self._fn_async = None
         self._pending = None
