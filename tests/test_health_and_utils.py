@@ -178,3 +178,77 @@ def test_shm_janitor_removes_only_orphans(tmp_path, monkeypatch):
             shared_memory.SharedMemory(name=orphan_name).unlink()
         except FileNotFoundError:
             pass
+
+
+class TestConfig:
+    def test_yaml_section_discovery_nested(self, tmp_path):
+        from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+
+        # the section hides inside an arbitrary trainer config tree
+        (tmp_path / "trainer.yaml").write_text(
+            "trainer:\n"
+            "  devices: 8\n"
+            "  plugins:\n"
+            "    fault_tolerance:\n"
+            "      rank_heartbeat_timeout: 120.5\n"
+            "      max_nodes: 4\n"
+            "      rank_section_timeouts: {step: 60}\n"
+        )
+        cfg = FaultToleranceConfig.from_yaml(str(tmp_path / "trainer.yaml"))
+        assert cfg.rank_heartbeat_timeout == 120.5
+        assert cfg.max_nodes == 4
+        assert cfg.rank_section_timeouts == {"step": 60}
+
+    def test_yaml_missing_section(self, tmp_path):
+        from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+
+        (tmp_path / "c.yaml").write_text("foo: {bar: 1}\n")
+        with pytest.raises(ValueError, match="not found"):
+            FaultToleranceConfig.from_yaml(str(tmp_path / "c.yaml"))
+
+    def test_unknown_key_rejected(self):
+        from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+
+        with pytest.raises(ValueError, match="unknown"):
+            FaultToleranceConfig.from_dict({"not_a_real_field": 1})
+
+    def test_env_null_disables_timeout(self, monkeypatch):
+        from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+
+        monkeypatch.setenv("TPURX_FT_RANK_HEARTBEAT_TIMEOUT", "null")
+        cfg = FaultToleranceConfig().merged_with_env()
+        assert cfg.rank_heartbeat_timeout is None
+
+
+class TestDataModel:
+    def test_timeouts_json_roundtrip(self):
+        from tpu_resiliency.fault_tolerance.data import (
+            HeartbeatTimeouts,
+            SectionTimeouts,
+            heartbeat_timeouts_from_dict,
+            heartbeat_timeouts_to_dict,
+            section_timeouts_from_dict,
+            section_timeouts_to_dict,
+        )
+
+        hb = HeartbeatTimeouts(initial=10.0, subsequent=None, were_calculated=True)
+        assert heartbeat_timeouts_from_dict(heartbeat_timeouts_to_dict(hb)) == hb
+        st = SectionTimeouts(
+            section={"step": 5.0, "ckpt": None}, out_of_section=9.0,
+            calculated_sections=("step",), calculated_out_of_section=True,
+        )
+        back = section_timeouts_from_dict(section_timeouts_to_dict(st))
+        assert back.section == st.section
+        assert back.out_of_section == st.out_of_section
+        assert back.calculated_sections == st.calculated_sections
+
+    def test_workload_control_roundtrip(self):
+        from tpu_resiliency.fault_tolerance.data import (
+            WorkloadAction,
+            WorkloadControlRequest,
+        )
+
+        req = WorkloadControlRequest(WorkloadAction.ExcludeThisNode, "bad hbm")
+        back = WorkloadControlRequest.from_json(req.to_json())
+        assert back.action == WorkloadAction.ExcludeThisNode
+        assert back.reason == "bad hbm"
