@@ -252,3 +252,23 @@ class TestDataModel:
         back = WorkloadControlRequest.from_json(req.to_json())
         assert back.action == WorkloadAction.ExcludeThisNode
         assert back.reason == "bad hbm"
+
+
+def test_cycle_log_router_caps_file_size(tmp_path):
+    import os
+
+    from tpu_resiliency.fault_tolerance.per_cycle_logs import CycleLogRouter
+
+    router = CycleLogRouter(str(tmp_path), tee_to_stdout=False,
+                            max_bytes_per_cycle=200)
+    router.start_cycle(0)
+    w_fd = router.make_worker_pipe(0, "out")
+    with os.fdopen(w_fd, "w") as wf:
+        for i in range(100):
+            wf.write(f"spam line {i}\n")
+    time.sleep(0.3)
+    router.close()
+    content = (tmp_path / "cycle_0.log").read_text()
+    assert "TRUNCATED" in content
+    assert len(content) < 1000  # capped, not 100 lines
+    assert "spam line 0" in content

@@ -27,9 +27,20 @@ log = get_logger("per_cycle_logs")
 class CycleLogRouter:
     """Routes worker output pipes into per-cycle log files."""
 
-    def __init__(self, log_dir: Optional[str], tee_to_stdout: bool = True):
+    def __init__(
+        self,
+        log_dir: Optional[str],
+        tee_to_stdout: bool = True,
+        max_bytes_per_cycle: int = 512 << 20,
+    ):
         self.log_dir = log_dir
         self.tee = tee_to_stdout
+        # a worker stuck in a print loop must not fill the host disk; when a
+        # cycle file hits the cap, writing stops with a truncation marker
+        # (the funnel/stdout tee keeps flowing)
+        self.max_bytes = max_bytes_per_cycle
+        self._written = 0
+        self._truncated = False
         self._cycle = 0
         self._file: Optional[IO[str]] = None
         self._file_lock = threading.Lock()
@@ -58,6 +69,8 @@ class CycleLogRouter:
                 self._file.close()
                 self._file = None
             self._cycle = cycle
+            self._written = 0
+            self._truncated = False
             if self.log_dir:
                 path = os.path.join(self.log_dir, f"cycle_{cycle}.log")
                 self._file = open(path, "a", buffering=1)
@@ -83,8 +96,16 @@ class CycleLogRouter:
                 line = line.rstrip("\n")
                 out = f"{prefix} {line}"
                 with self._file_lock:
-                    if self._file:
+                    if self._file and not self._truncated:
                         self._file.write(out + "\n")
+                        self._written += len(out) + 1
+                        if self._written >= self.max_bytes:
+                            self._file.write(
+                                f"[per_cycle_logs] TRUNCATED at "
+                                f"{self.max_bytes} bytes for cycle "
+                                f"{self._cycle}\n"
+                            )
+                            self._truncated = True
                 if self._funnel is not None:
                     record = __import__("logging").LogRecord(
                         "worker", 20, "", 0, out, None, None
