@@ -265,3 +265,23 @@ def test_ici_replication_in_manager(store_server, tmp_path):
 
     results = _run_ranks(world, member)
     assert all(results.values())
+
+
+def test_partial_blob_without_done_marker_ignored(store_server, tmp_path):
+    """A save killed between blob write and .done marker must not count as a
+    valid checkpoint (crash consistency of the local format)."""
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "n"), 0, 1, store=store)
+    mgr.save(make_tree(0, seed=1), iteration=3, is_async=False)
+    # simulate a crash mid-save of iteration 4: blob present, no .done
+    d = mgr._iter_dir(4)
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    with open(mgr._blob_path(4, 0), "wb") as f:
+        f.write(b"partial garbage")
+    assert mgr._holdings() == {3: [0]}
+    assert mgr.find_latest() == 3
+    tree, it = mgr.load(make_tree(0))
+    assert it == 3
+    store.close()
