@@ -285,3 +285,25 @@ def test_partial_blob_without_done_marker_ignored(store_server, tmp_path):
     tree, it = mgr.load(make_tree(0))
     assert it == 3
     store.close()
+
+
+def test_cleanup_reclaims_crash_debris(store_server, tmp_path):
+    """Uncommitted iter dirs older than a committed save are removed; a
+    potentially in-progress (newest) uncommitted dir is left alone."""
+    import os
+
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "n"), 0, 1, store=store)
+    # crash debris at iteration 1 (no .done)
+    os.makedirs(mgr._iter_dir(1), exist_ok=True)
+    with open(mgr._blob_path(1, 0), "wb") as f:
+        f.write(b"junk")
+    # newest uncommitted (could be an in-flight save) at iteration 9
+    os.makedirs(mgr._iter_dir(9), exist_ok=True)
+    with open(mgr._blob_path(9, 0), "wb") as f:
+        f.write(b"in progress")
+    mgr.save(make_tree(0, seed=2), iteration=5, is_async=False)  # runs cleanup
+    assert not os.path.exists(mgr._iter_dir(1))   # debris reclaimed
+    assert os.path.exists(mgr._iter_dir(9))       # in-progress spared
+    assert mgr.find_latest() == 5
+    store.close()

@@ -159,6 +159,17 @@ class LocalCheckpointManager:
         iters = sorted(self._holdings())
         for old in iters[: max(0, len(iters) - self.keep_last)]:
             shutil.rmtree(self._iter_dir(old), ignore_errors=True)
+        # reclaim crash debris: iter dirs with no committed blob, but only
+        # ones OLDER than a committed iteration — the newest uncommitted dir
+        # may be a save in progress
+        if iters:
+            newest_committed = iters[-1]
+            for name in os.listdir(self.root):
+                m = _ITER_RE.match(name)
+                if m and int(m.group(1)) < newest_committed:
+                    d = os.path.join(self.root, name)
+                    if not any(f.endswith(".done") for f in os.listdir(d)):
+                        shutil.rmtree(d, ignore_errors=True)
         # holdings changed
         self._publish_holdings()
 
