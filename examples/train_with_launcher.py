@@ -25,6 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # demo mode: some TPU sandboxes force-register their platform via
+    # sitecustomize, overriding the env var — override it back
+    jax.config.update("jax_platforms", "cpu")
+
 from tpu_resiliency.checkpointing import AsyncCheckpointer, load_checkpoint
 from tpu_resiliency.checkpointing.async_ckpt.writer import is_committed
 from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
