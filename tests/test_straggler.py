@@ -166,3 +166,17 @@ def test_detector_profiled_step():
     with det.profiled_step():
         jax.block_until_ready(step(x))
     assert any(n.startswith("xla:") for n in det.device.names())
+
+
+def test_op_diff_pinpoints_slow_op():
+    per_rank = {
+        0: {"matmul": make_stats("matmul", 0.10), "io": make_stats("io", 0.02)},
+        1: {"matmul": make_stats("matmul", 0.30), "io": make_stats("io", 0.02)},
+    }
+    report = Report(0, {}, per_rank)
+    diff = report.op_diff(1)
+    assert diff[0]["name"] == "matmul"           # the dominant regression
+    assert diff[0]["slowdown"] == pytest.approx(3.0, rel=0.05)
+    assert diff[0]["time_lost"] > 0
+    # the fastest rank shows no losses
+    assert all(d["time_lost"] == 0 for d in report.op_diff(0))

@@ -117,6 +117,39 @@ class Report:
             return None
         return weighted / weight_sum
 
+    def op_diff(self, rank: int, top_k: int = 10) -> List[Dict]:
+        """Per-op slowdown of ``rank`` vs the fastest rank — pinpoints WHICH
+        op drags a flagged straggler (the per-kernel CUPTI diff capability).
+        Entries: {name, rank_median, best_median, slowdown, total} sorted by
+        time lost (slowdown-weighted total)."""
+        mine = self.device_stats.get(rank) or self.section_stats.get(rank) or {}
+        per_rank = self.device_stats if self.device_stats.get(rank) else self.section_stats
+        out = []
+        for name, st in mine.items():
+            if st.median <= 0:
+                continue
+            best = min(
+                (
+                    per_rank[r][name].median
+                    for r in per_rank
+                    if name in per_rank[r] and per_rank[r][name].median > 0
+                ),
+                default=st.median,
+            )
+            slowdown = st.median / best if best > 0 else 1.0
+            out.append(
+                {
+                    "name": name,
+                    "rank_median": st.median,
+                    "best_median": best,
+                    "slowdown": slowdown,
+                    "total": st.total,
+                    "time_lost": max(0.0, (st.median - best) * st.count),
+                }
+            )
+        out.sort(key=lambda d: -d["time_lost"])
+        return out[:top_k]
+
     def identify_stragglers(
         self,
         relative_threshold: float = 0.7,
