@@ -208,6 +208,44 @@ def test_spare_promoted_after_crash(store_server):
     assert "ret=ok@1" in outs[0]
 
 
+def test_tree_spare_promoted_into_gap(store_server):
+    # 4 ranks = two 2-chip hosts; Tree(root RESERVE max_active=2,
+    # host min=1 max=1): actives {0, 2}, spares {1, 3}.  Rank 2 crashes ->
+    # its same-host spare (initial rank 3) takes over app rank 1.
+    env = {"MAX_ACTIVE": "2", "FAIL_RANK": "2", "CHIPS_PER_HOST": "2"}
+    procs, outs = run_scenario(
+        store_server, "tree_crash", world=4, timeout=150, extra_env=env
+    )
+    if procs[0].returncode != 0 or procs[3].returncode != 0:
+        _dump(outs)
+    assert procs[1].returncode == 0      # parked spare, job completed
+    assert procs[2].returncode == 31     # crashed
+    assert procs[0].returncode == 0
+    assert procs[3].returncode == 0
+    assert "train start rank=1 world=2 iter=1" in outs[3]
+    assert "ret=ok@1" in outs[0]
+
+
+def test_tree_host_loss_promotes_whole_spare_host(store_server):
+    # host min=max=2: rank 1's crash terminates all of host0 (healthy rank 0
+    # is discontinued and must mark itself so peers' barriers don't wait);
+    # host1's spares take both slots.
+    env = {"MAX_ACTIVE": "2", "FAIL_RANK": "1", "CHIPS_PER_HOST": "2"}
+    procs, outs = run_scenario(
+        store_server, "tree_hostcrash", world=4, timeout=150, extra_env=env
+    )
+    if procs[2].returncode != 0 or procs[3].returncode != 0:
+        _dump(outs)
+    assert procs[1].returncode == 31     # crashed
+    assert procs[0].returncode == 7      # healthy but discontinued with host0
+    assert "DISCONTINUED rank=0" in outs[0]
+    assert procs[2].returncode == 0
+    assert procs[3].returncode == 0
+    assert "train start rank=0 world=2 iter=1" in outs[2]
+    assert "train start rank=1 world=2 iter=1" in outs[3]
+    assert "ret=ok@1" in outs[2]
+
+
 class TestActivateWholeGroups:
     def _policy(self):
         from tpu_resiliency.inprocess.rank_assignment import ActivateWholeGroups

@@ -1,0 +1,334 @@
+"""Multi-layer ``Tree`` rank assignment (reference
+``inprocess/rank_assignment.py:416-520``): init activation bounded by
+``max_ranks``, ``min_ranks`` branch termination, RESERVE promotion,
+BACKFILL local gap-filling, global shift, ``world_size_filter``.
+
+All pure logic — one ``Tree`` instance per rank is driven through the same
+cumulative terminated sets the store would serve, and every step asserts
+cross-rank consistency (unique app ranks 0..A-1, agreed active world size).
+"""
+
+import pytest
+
+from tpu_resiliency.inprocess import (
+    Layer,
+    LayerFlag,
+    Mode,
+    RankAssignmentCtx,
+    RestartAbort,
+    Tree,
+    tpu_pod_layers,
+)
+from tpu_resiliency.inprocess.rank_assignment import RankDiscontinued
+from tpu_resiliency.inprocess.state import State
+
+DISCONTINUED = "discontinued"
+
+
+def host_layers(chips=4, max_active=None, root_flag=LayerFlag.RESERVE,
+                host_flag=LayerFlag.RESERVE, host_min=None, host_max=None,
+                root_min=1):
+    return [
+        Layer(min_ranks=root_min, max_ranks=max_active, key_of_rank="root",
+              flag=root_flag),
+        Layer(min_ranks=chips if host_min is None else host_min,
+              max_ranks=chips if host_max is None else host_max,
+              key_of_rank=lambda r, c=chips: r // c, flag=host_flag),
+    ]
+
+
+def simulate(world, layers_fn, term_steps, world_size_filter=None):
+    """Drive one Tree per rank through a cumulative ordered termination log
+    (what ``InprocStore.terminated_ranks()`` serves); return per-step
+    snapshots {initial_rank: State | DISCONTINUED}."""
+    trees = {
+        r: Tree(layers_fn(), world_size_filter=world_size_filter)
+        for r in range(world)
+    }
+    alive = set(range(world))
+    log = []  # ordered, like the store's append log
+    steps = []
+    for terms in term_steps:
+        log.extend(t for t in terms if t not in log)
+        snap = {}
+        for r in sorted(alive - set(log)):
+            st = State(rank=r, world_size=world)
+            try:
+                trees[r](RankAssignmentCtx(st, list(log)))
+                snap[r] = st
+            except RankDiscontinued:
+                alive.discard(r)
+                snap[r] = DISCONTINUED
+        steps.append(snap)
+        check_consistency(snap)
+    return steps
+
+
+def check_consistency(snap):
+    states = [s for s in snap.values() if s is not DISCONTINUED]
+    if not states:
+        return
+    active_worlds = {s.active_world_size for s in states}
+    worlds = {s.world_size for s in states}
+    assert len(active_worlds) == 1, f"disagree on active world: {active_worlds}"
+    assert len(worlds) == 1, f"disagree on world: {worlds}"
+    actives = sorted(s.rank for s in states if s.mode is Mode.ACTIVE)
+    assert actives == list(range(len(actives))), f"active ranks not 0..A-1: {actives}"
+    assert len(actives) == active_worlds.pop()
+    all_ranks = [s.rank for s in states]
+    assert len(all_ranks) == len(set(all_ranks)), f"duplicate ranks: {all_ranks}"
+
+
+def active_map(snap):
+    """initial_rank -> app rank, actives only."""
+    return {
+        r: s.rank
+        for r, s in snap.items()
+        if s is not DISCONTINUED and s.mode is Mode.ACTIVE
+    }
+
+
+class TestInitActivation:
+    def test_all_active_no_cap(self):
+        (snap,) = simulate(8, lambda: host_layers(4), [()])
+        assert active_map(snap) == {r: r for r in range(8)}
+
+    def test_root_max_active_parks_surplus(self):
+        (snap,) = simulate(8, lambda: host_layers(4, max_active=4), [()])
+        assert active_map(snap) == {0: 0, 1: 1, 2: 2, 3: 3}
+        for r in (4, 5, 6, 7):
+            assert snap[r].mode is Mode.INACTIVE
+            assert snap[r].active_rank is None
+
+    def test_host_max_ranks_limits_per_host(self):
+        (snap,) = simulate(
+            8, lambda: host_layers(4, host_min=1, host_max=2), [()]
+        )
+        # two actives per 4-chip host, in DFS order
+        assert active_map(snap) == {0: 0, 1: 1, 4: 2, 5: 3}
+
+    def test_parked_ranks_numbered_after_actives(self):
+        (snap,) = simulate(8, lambda: host_layers(4, max_active=4), [()])
+        parked = sorted(s.rank for s in snap.values() if s.mode is Mode.INACTIVE)
+        assert parked == [4, 5, 6, 7]
+
+
+class TestMinRanksTermination:
+    def test_partial_host_terminates_whole_host(self):
+        steps = simulate(8, lambda: host_layers(4, max_active=None), [(), (5,)])
+        snap = steps[1]
+        for r in (4, 6, 7):
+            assert snap[r] is DISCONTINUED
+        assert active_map(snap) == {r: r for r in range(4)}
+        assert snap[0].world_size == 4
+
+    def test_root_min_ranks_aborts_everyone(self):
+        steps = simulate(
+            8, lambda: host_layers(4, root_min=8, host_min=1), [(), (3,)]
+        )
+        assert all(v is DISCONTINUED for v in steps[1].values())
+
+    def test_cascading_propagation_host_then_slice(self):
+        # chip->host->slice: host loss drops slice below its min -> slice dies
+        layers = lambda: tpu_pod_layers(chips_per_host=2, hosts_per_slice=2)
+        steps = simulate(8, layers, [(), (0,)])
+        snap = steps[1]
+        for r in (1, 2, 3):
+            assert snap[r] is DISCONTINUED
+        assert active_map(snap) == {4: 0, 5: 1, 6: 2, 7: 3}
+
+
+class TestReservePromotion:
+    def test_same_host_spare_takes_gap(self):
+        layers = lambda: host_layers(4, host_min=1, host_max=2)
+        steps = simulate(8, layers, [(), (1,)])
+        # init actives: {0,1} on host0, {4,5} on host1; spare 2 promotes into
+        # rank 1's slot (same-host RESERVE scope preferred in DFS order)
+        assert active_map(steps[1]) == {0: 0, 2: 1, 4: 2, 5: 3}
+
+    def test_cross_host_promotion_through_reserve_root(self):
+        layers = lambda: host_layers(4, max_active=4)
+        steps = simulate(8, layers, [(), (1,)])
+        # host0 falls below min_ranks=4 -> whole host0 dies -> 4 gaps ->
+        # host1 spares promote in order
+        snap = steps[1]
+        for r in (0, 2, 3):
+            assert snap[r] is DISCONTINUED
+        assert active_map(snap) == {4: 0, 5: 1, 6: 2, 7: 3}
+
+    def test_search_stops_at_non_reserve_layer(self):
+        # host layer NOT flagged RESERVE: the upward search never reaches the
+        # (reserve) root, so the host-1 spares stay parked and ranks shift
+        layers = lambda: host_layers(
+            4, host_min=1, host_max=2, host_flag=LayerFlag.NONE
+        )
+        steps = simulate(8, layers, [(), (1,)])
+        snap = steps[1]
+        assert active_map(snap) == {0: 0, 4: 1, 5: 2}
+        assert snap[2].mode is Mode.INACTIVE
+
+    def test_candidate_must_respect_own_host_max_ranks(self):
+        layers = lambda: host_layers(4, host_min=1, host_max=2)
+        # kill host0's actives AND spares -> no same-host candidates; host1
+        # is at max_ranks=2 so its spares cannot promote either
+        steps = simulate(8, layers, [(), (0, 1, 2, 3)])
+        snap = steps[1]
+        assert active_map(snap) == {4: 0, 5: 1}
+        assert snap[6].mode is Mode.INACTIVE
+        assert snap[7].mode is Mode.INACTIVE
+
+    def test_promotion_sequence_exhausts_spares(self):
+        layers = lambda: host_layers(4, host_min=1, host_max=2)
+        steps = simulate(8, layers, [(), (0,), (1,), (2,), (3,)])
+        # spares 2 then 3 promote; afterwards host0 is empty and host1 full
+        assert active_map(steps[1]) == {1: 1, 2: 0, 4: 2, 5: 3}
+        assert active_map(steps[2]) == {2: 0, 3: 1, 4: 2, 5: 3}
+        # no spares left for rank 2's slot (host1 at max_ranks) -> shift
+        assert active_map(steps[3]) == {3: 0, 4: 1, 5: 2}
+        snap = steps[4]
+        assert active_map(snap) == {4: 0, 5: 1}
+
+
+class TestBuildTimeConstraints:
+    def test_min_ranks_enforced_at_build(self):
+        # world 6 with 4-chip hosts: the 2-chip remainder host must never
+        # activate as an illegal sub-mesh — terminated before activation
+        (snap,) = simulate(6, lambda: host_layers(4, host_min=4), [()])
+        assert snap[4] is DISCONTINUED and snap[5] is DISCONTINUED
+        assert active_map(snap) == {0: 0, 1: 1, 2: 2, 3: 3}
+
+
+class TestBackfillAndShift:
+    def test_backfill_search_stops_at_unflagged_layer(self):
+        # root BACKFILL but host NONE: the chain breaks at the host layer,
+        # so no cross-host backfill happens — plain shift instead
+        layers = lambda: host_layers(
+            4, host_min=1, root_flag=LayerFlag.BACKFILL, host_flag=LayerFlag.NONE
+        )
+        steps = simulate(8, layers, [(), (1,)])
+        assert active_map(steps[1]) == {0: 0, 2: 1, 3: 2, 4: 3, 5: 4, 6: 5, 7: 6}
+
+    def test_backfill_moves_largest_local_rank_into_gap(self):
+        layers = lambda: host_layers(
+            4, host_min=1, root_flag=LayerFlag.NONE, host_flag=LayerFlag.BACKFILL
+        )
+        steps = simulate(8, layers, [(), (1,)])
+        # host0's largest app rank (3) backfills slot 1; ranks 4..7 shift by 1
+        assert active_map(steps[1]) == {0: 0, 3: 1, 2: 2, 4: 3, 5: 4, 6: 5, 7: 6}
+
+    def test_plain_shift_without_flags(self):
+        layers = lambda: host_layers(
+            4, host_min=1, root_flag=LayerFlag.NONE, host_flag=LayerFlag.NONE
+        )
+        steps = simulate(8, layers, [(), (1,)])
+        assert active_map(steps[1]) == {0: 0, 2: 1, 3: 2, 4: 3, 5: 4, 6: 5, 7: 6}
+
+
+class TestWorldSizeFilter:
+    def test_divisibility_filter_parks_tail(self):
+        layers = lambda: host_layers(
+            4, host_min=1, root_flag=LayerFlag.NONE, host_flag=LayerFlag.NONE
+        )
+        steps = simulate(
+            8, layers, [(), (7,)], world_size_filter=lambda n: (n // 4) * 4
+        )
+        snap = steps[1]
+        assert active_map(snap) == {0: 0, 1: 1, 2: 2, 3: 3}
+        for r in (4, 5, 6):
+            assert snap[r].mode is Mode.INACTIVE
+
+    def test_filter_may_not_grow_world(self):
+        t = Tree(host_layers(4), world_size_filter=lambda n: n + 1)
+        with pytest.raises(RestartAbort):
+            t(RankAssignmentCtx(State(rank=0, world_size=8), set()))
+
+
+class TestTreeContract:
+    def test_terminated_rank_discontinued(self):
+        t = Tree(host_layers(4, host_min=1))
+        with pytest.raises(RankDiscontinued):
+            t(RankAssignmentCtx(State(rank=2, world_size=8), {2}))
+
+    def test_mixed_root_keys_rejected(self):
+        layers = [Layer(key_of_rank=lambda r: r % 2)]
+        t = Tree(layers)
+        with pytest.raises(RestartAbort):
+            t(RankAssignmentCtx(State(rank=0, world_size=4), set()))
+
+    def test_requires_layers(self):
+        with pytest.raises(ValueError):
+            Tree([])
+
+    def test_single_layer_tree(self):
+        (snap,) = simulate(
+            4, lambda: [Layer(min_ranks=2, max_ranks=3, flag=LayerFlag.RESERVE)], [()]
+        )
+        assert active_map(snap) == {0: 0, 1: 1, 2: 2}
+        assert snap[3].mode is Mode.INACTIVE
+
+    def test_single_layer_reserve_promotion(self):
+        steps = simulate(
+            4,
+            lambda: [Layer(min_ranks=2, max_ranks=3, flag=LayerFlag.RESERVE)],
+            [(), (1,)],
+        )
+        assert active_map(steps[1]) == {0: 0, 3: 1, 2: 2}
+
+    def test_tpu_pod_layers_shapes(self):
+        layers = tpu_pod_layers(chips_per_host=4, hosts_per_slice=2, min_slices=1)
+        assert len(layers) == 3
+        assert layers[1].min_ranks == 8 and layers[1].max_ranks == 8
+        assert layers[2].min_ranks == 4 and layers[2].max_ranks == 4
+
+    def test_incremental_matches_fresh_instance(self):
+        # a fresh Tree given the whole ordered log must agree with one that
+        # saw the same terminations step by step (prefix-pure replay)
+        layers = lambda: host_layers(4, host_min=1, host_max=3)
+        steps = simulate(8, layers, [(), (0,), (5,)])
+        final_incremental = active_map(steps[2])
+        assert final_incremental == {3: 0, 1: 1, 2: 2, 4: 3, 7: 4, 6: 5}
+        fresh = simulate(8, layers, [(0, 5)])
+        assert active_map(fresh[0]) == final_incremental
+
+    def test_batching_independence_brute_force(self):
+        # THE Tree correctness property: the assignment is a pure function
+        # of the ordered termination log prefix — HOW a rank's store reads
+        # batch the same events must not matter.  Random topologies, random
+        # kill orders, random batchings of the same order must all agree.
+        import random
+
+        rng = random.Random(20260729)
+        for trial in range(120):
+            chips = rng.choice([2, 3, 4])
+            hosts = rng.choice([2, 3, 4])
+            world = chips * hosts
+            flags = [
+                rng.choice([LayerFlag.NONE, LayerFlag.RESERVE, LayerFlag.BACKFILL])
+                for _ in range(2)
+            ]
+            max_active = rng.choice([None, world // 2, world - 1])
+            host_min = rng.choice([1, chips])
+            # filter timing is the known batching hazard: _apply_filter must
+            # run per-event, not per-call — keep it in the randomized space
+            ws_filter = rng.choice([None, lambda n, c=chips: (n // c) * c])
+            layers_fn = lambda: [
+                Layer(min_ranks=1, max_ranks=max_active, key_of_rank="root",
+                      flag=flags[0]),
+                Layer(min_ranks=host_min, max_ranks=chips,
+                      key_of_rank=lambda r, c=chips: r // c, flag=flags[1]),
+            ]
+            kills = rng.sample(range(world), rng.randint(1, world - 1))
+
+            def final_map(batches):
+                steps = simulate(
+                    world, layers_fn, [()] + batches, world_size_filter=ws_filter
+                )
+                return active_map(steps[-1])
+
+            one_batch = final_map([tuple(kills)])
+            one_by_one = final_map([(k,) for k in kills])
+            cut = rng.randint(1, len(kills))
+            split = final_map([tuple(kills[:cut]), tuple(kills[cut:])])
+            ctx = f"trial {trial}: chips={chips} hosts={hosts} flags={flags} " \
+                  f"max_active={max_active} host_min={host_min} kills={kills}"
+            assert one_batch == one_by_one == split, ctx

@@ -4,6 +4,7 @@ Env:
   TPURX_RANK / TPURX_WORLD_SIZE   identity
   TPURX_STORE_ADDR / PORT         store
   SCENARIO                        clean | exception | crash | hang | spare
+                                  | tree_crash | tree_hostcrash
   FAIL_RANK                       rank that faults (default 1)
   STEPS                           steps per fn run (default 30)
 Prints "RESULT rank=<r> iters=<n> world=<w> ret=<ret>" on success.
@@ -17,8 +18,12 @@ sys.path.insert(0, os.environ.get("TPURX_REPO", "/root/repo"))
 
 from tpu_resiliency.inprocess import (
     Compose,
+    Layer,
+    LayerFlag,
     MaxActiveWorldSize,
+    RankDiscontinued,
     ShiftRanks,
+    Tree,
     Wrapper,
 )
 
@@ -55,12 +60,43 @@ def train(call_wrapper=None):
     return f"ok@{it}"
 
 
-def main():
-    assignment = (
-        Compose(ShiftRanks(), MaxActiveWorldSize(int(os.environ.get("MAX_ACTIVE", "2"))))
-        if SCENARIO.startswith("spare")
-        else ShiftRanks()
+def _tree_assignment():
+    """Two-layer pod: root(RESERVE, capped) over N-chip hosts.
+
+    ``tree_crash`` allows partial hosts (spare promotes into a one-chip gap);
+    ``tree_hostcrash`` pins min=max=chips so losing one chip terminates the
+    whole host and both slots refill from the other host's spares.
+    """
+    chips = int(os.environ.get("CHIPS_PER_HOST", "2"))
+    host_min = 1 if SCENARIO == "tree_crash" else chips
+    host_max = 1 if SCENARIO == "tree_crash" else chips
+    return Tree(
+        [
+            Layer(
+                min_ranks=1,
+                max_ranks=int(os.environ.get("MAX_ACTIVE", "2")),
+                key_of_rank="root",
+                flag=LayerFlag.RESERVE,
+            ),
+            Layer(
+                min_ranks=host_min,
+                max_ranks=host_max,
+                key_of_rank=lambda r, c=chips: r // c,
+                flag=LayerFlag.RESERVE,
+            ),
+        ]
     )
+
+
+def main():
+    if SCENARIO.startswith("tree"):
+        assignment = _tree_assignment()
+    elif SCENARIO.startswith("spare"):
+        assignment = Compose(
+            ShiftRanks(), MaxActiveWorldSize(int(os.environ.get("MAX_ACTIVE", "2")))
+        )
+    else:
+        assignment = ShiftRanks()
     wrapper = Wrapper(
         rank_assignment=assignment,
         soft_timeout=float(os.environ.get("SOFT_TIMEOUT", "1.0")),
@@ -73,7 +109,13 @@ def main():
         barrier_timeout=30.0,
     )
     wrapped = wrapper(train)
-    ret = wrapped()
+    try:
+        ret = wrapped()
+    except RankDiscontinued as exc:
+        # precisely a policy discontinuation (Tree min_ranks propagation),
+        # NOT a generic abort — max_iterations/health aborts must fail loud
+        print(f"DISCONTINUED rank={INITIAL_RANK} reason={exc}", flush=True)
+        sys.exit(7)
     final_rank = os.environ.get("TPURX_RANK")
     print(
         f"RESULT rank={INITIAL_RANK} calls={calls['n']} "

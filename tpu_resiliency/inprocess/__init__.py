@@ -28,9 +28,14 @@ from .rank_assignment import (
     ActivateWholeGroups,
     ActiveWorldSizeDivisibleBy,
     FillGaps,
+    Layer,
+    LayerFlag,
     MaxActiveWorldSize,
     RankAssignmentCtx,
+    RankDiscontinued,
     ShiftRanks,
+    Tree,
+    tpu_pod_layers,
 )
 from .sibling_monitor import SiblingMonitor
 from .state import FrozenState, Mode, State
@@ -56,10 +61,15 @@ __all__ = [
     "FaultCounter",
     "FaultCounterExceeded",
     "RankAssignmentCtx",
+    "RankDiscontinued",
     "ActivateAllRanks",
     "ActivateWholeGroups",
     "MaxActiveWorldSize",
     "ActiveWorldSizeDivisibleBy",
     "FillGaps",
     "ShiftRanks",
+    "Layer",
+    "LayerFlag",
+    "Tree",
+    "tpu_pod_layers",
 ]

@@ -19,7 +19,8 @@ mode) given the terminated set.  Policies chain with
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Set
+import enum
+from typing import Callable, Dict, Hashable, List, Optional, Sequence, Set, Union
 
 from .exceptions import RestartAbort
 from .state import Mode, State
@@ -27,8 +28,16 @@ from .state import Mode, State
 
 @dataclasses.dataclass
 class RankAssignmentCtx:
+    """``terminated_ranks`` is the store's cumulative termination record.
+
+    When it is an ordered sequence (``InprocStore.terminated_ranks()``
+    append-log order), stateful policies replay it event-by-event so that
+    every rank — regardless of how reads batch the events — computes the
+    same assignment.  Stateless policies only test membership and accept
+    any iterable."""
+
     state: State
-    terminated_ranks: Set[int]
+    terminated_ranks: Union[Sequence[int], Set[int]]
 
 
 class RankAssignment:
@@ -167,6 +176,427 @@ class ActivateWholeGroups(RankAssignment):
             state.active_world_size = len(ordered)
             state.mode = Mode.INACTIVE
         return ctx
+
+
+class LayerFlag(enum.Flag):
+    """Per-layer fault-handling policies (reference ``rank_assignment.py:416``).
+
+    - ``RESERVE``: terminated active ranks may be replaced by INACTIVE spares
+      found inside this layer's subtree; the search widens upward through
+      consecutive RESERVE-flagged ancestor layers.
+    - ``BACKFILL``: gaps left by terminated active ranks are filled by the
+      highest-app-rank active leaf *within the same subtree* (local
+      ``FillGaps`` — minimizes resharding movement inside a host/slice).
+    """
+
+    NONE = 0
+    RESERVE = enum.auto()
+    BACKFILL = enum.auto()
+
+
+@dataclasses.dataclass
+class Layer:
+    """One level of the topology tree (reference ``Layer``, ``:416-520``).
+
+    ``key_of_rank`` maps an *initial* rank to this layer's grouping key.  The
+    reference exchanges per-rank keys through the store because a rank only
+    knows its own hostname; on TPU the pod topology is static and derivable
+    from the rank (chip = r % chips_per_host, host = r // chips_per_host,
+    slice = host // hosts_per_slice), so every rank can evaluate every other
+    rank's key locally — the policy stays pure, no store round-trip.  A plain
+    string (e.g. ``'root'``) is a constant key.
+
+    ``min_ranks``: if the number of healthy ranks inside one of this layer's
+    subtrees drops below this, the whole subtree is terminated (a partial TPU
+    host/slice cannot form a legal mesh).  ``max_ranks``: at most this many
+    ACTIVE ranks per subtree; surplus healthy ranks park INACTIVE as spares.
+    """
+
+    min_ranks: int = 1
+    max_ranks: Optional[int] = None
+    key_of_rank: Union[str, Callable[[int], Hashable]] = "root"
+    flag: LayerFlag = LayerFlag.NONE
+
+    def key(self, rank: int) -> Hashable:
+        if callable(self.key_of_rank):
+            return self.key_of_rank(rank)
+        return self.key_of_rank
+
+
+def _sorted_keys(d: Dict) -> List:
+    """Deterministic child ordering: natural sort when keys are comparable
+    (ints from ``r // n``), ``repr`` fallback otherwise — every rank must
+    walk the tree in the same order."""
+    try:
+        return sorted(d)
+    except TypeError:
+        return sorted(d, key=repr)
+
+
+class _Node:
+    """Internal topology-tree node: one subtree of one :class:`Layer`.
+
+    ``active_n``/``healthy_n`` are maintained incrementally on every leaf
+    mode transition (via :meth:`_Leaf.set_mode`) so activation and fault
+    handling stay O(n·depth) on the restart critical path — a pod has
+    thousands of leaves and recounting subtrees per leaf would be O(n²).
+    """
+
+    __slots__ = ("layer", "key", "children", "leaves", "parent", "depth",
+                 "active_n", "healthy_n")
+
+    def __init__(self, layer: Layer, key: Hashable, parent: Optional["_Node"], depth: int):
+        self.layer = layer
+        self.key = key
+        self.parent = parent
+        self.depth = depth
+        self.children: Dict[Hashable, _Node] = {}
+        self.leaves: List[_Leaf] = []  # only on deepest-layer nodes
+        self.active_n = 0
+        self.healthy_n = 0
+
+    def iter_leaves(self):
+        if self.leaves:
+            yield from self.leaves
+        for key in _sorted_keys(self.children):
+            yield from self.children[key].iter_leaves()
+
+    def has_max_headroom(self) -> bool:
+        return self.layer.max_ranks is None or self.active_n < self.layer.max_ranks
+
+
+class _Leaf:
+    __slots__ = ("initial_rank", "mode", "app_rank", "parent")
+
+    def __init__(self, initial_rank: int, parent: _Node):
+        self.initial_rank = initial_rank
+        self.mode = Mode.INITIALIZED
+        self.app_rank: Optional[int] = None
+        self.parent = parent
+        for node in self.ancestors():
+            node.healthy_n += 1
+
+    def ancestors(self):
+        node = self.parent
+        while node is not None:
+            yield node
+            node = node.parent
+
+    def set_mode(self, mode: Mode) -> None:
+        if mode is self.mode:
+            return
+        d_active = (mode is Mode.ACTIVE) - (self.mode is Mode.ACTIVE)
+        d_healthy = (mode is not Mode.TERMINATED) - (self.mode is not Mode.TERMINATED)
+        if d_active or d_healthy:
+            for node in self.ancestors():
+                node.active_n += d_active
+                node.healthy_n += d_healthy
+        self.mode = mode
+
+
+class Tree(RankAssignment):
+    """Multi-layer topology-aware rank assignment (reference ``Tree``,
+    ``inprocess/rank_assignment.py:416-520``).
+
+    Builds a rooted tree whose depth equals ``len(layers)`` — e.g.
+    pod → slice → host for a TPU fleet — with ranks as leaves.  Initial
+    activation walks leaves depth-first and activates each while no ancestor
+    subtree exceeds its ``max_ranks``; surplus healthy ranks park INACTIVE.
+    On faults (cumulative terminated set from the store):
+
+    1. *propagate*: subtrees whose healthy count falls below ``min_ranks``
+       are terminated whole (children before parents);
+    2. *reserve*: each gap is refilled by an INACTIVE spare from the nearest
+       RESERVE-flagged ancestor subtree (search widens upward through
+       consecutive RESERVE layers; candidates must not overflow their own
+       ancestors' ``max_ranks``);
+    3. *backfill*: remaining gaps inside BACKFILL-flagged subtrees are taken
+       by that subtree's highest-app-rank active leaf;
+    4. *shift*: any remaining gaps close by renumbering actives in app-rank
+       order;
+    5. ``world_size_filter(n_active) -> m <= n_active`` optionally deactivates
+       the tail back into the spare pool (e.g. keep the mesh rectangular).
+
+    The instance is stateful across restart iterations (like the reference).
+    Correctness contract: ``wrap.py`` passes the store's cumulative
+    termination *log* (one global append order), and the tree applies events
+    strictly one at a time in that order — the assignment is therefore a
+    pure function of the log prefix, and ranks whose store reads batch the
+    same events differently still converge.  ``min_ranks`` also holds at
+    initial build: an undersized subtree never activates.  ``Tree`` must not
+    be composed with other rank-assignment policies.
+    """
+
+    def __init__(
+        self,
+        layers: List[Layer],
+        world_size_filter: Optional[Callable[[int], int]] = None,
+    ):
+        if not layers:
+            raise ValueError("Tree requires at least one Layer")
+        self.layers = list(layers)
+        self.world_size_filter = world_size_filter
+        self._root: Optional[_Node] = None
+        self._leaves: Dict[int, _Leaf] = {}
+        self._applied: Set[int] = set()
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self, world_size: int) -> None:
+        root_keys = {self.layers[0].key(r) for r in range(world_size)}
+        if len(root_keys) != 1:
+            raise RestartAbort(
+                f"all ranks must share one root-layer key, got {sorted(map(repr, root_keys))}"
+            )
+        self._root = _Node(self.layers[0], root_keys.pop(), None, 0)
+        for rank in range(world_size):
+            node = self._root
+            for depth in range(1, len(self.layers)):
+                layer = self.layers[depth]
+                key = layer.key(rank)
+                child = node.children.get(key)
+                if child is None:
+                    child = node.children[key] = _Node(layer, key, node, depth)
+                node = child
+            leaf = _Leaf(rank, node)
+            node.leaves.append(leaf)
+            self._leaves[rank] = leaf
+        # min_ranks holds from the start: an undersized subtree (e.g. a
+        # 2-chip remainder in a 4-chip-host fleet) must never activate as an
+        # illegal sub-mesh, so propagation runs BEFORE activation.
+        self._propagate_min_ranks(self._root)
+        # Depth-first activation bounded by every ancestor's max_ranks; app
+        # ranks follow activation order so a host's ranks stay contiguous.
+        # Non-activated leaves are spares: marked INACTIVE here (not at
+        # __call__ time) so every rank's instance sees identical modes.
+        nxt = 0
+        for leaf in self._root.iter_leaves():
+            if leaf.mode is Mode.TERMINATED:
+                continue
+            if all(n.has_max_headroom() for n in leaf.ancestors()):
+                leaf.set_mode(Mode.ACTIVE)
+                leaf.app_rank = nxt
+                nxt += 1
+            else:
+                leaf.set_mode(Mode.INACTIVE)
+        self._apply_filter()
+
+    # -- fault handling ----------------------------------------------------
+
+    def _propagate_min_ranks(self, node: _Node) -> None:
+        for key in _sorted_keys(node.children):
+            self._propagate_min_ranks(node.children[key])
+        if node.healthy_n < node.layer.min_ranks:
+            for leaf in node.iter_leaves():
+                leaf.set_mode(Mode.TERMINATED)
+                leaf.app_rank = None
+
+    def _reserve_candidate(self, dead: _Leaf) -> Optional[_Leaf]:
+        """INACTIVE spare to take over a terminated active leaf's slot.
+
+        The search starts at the dead leaf's nearest RESERVE-flagged ancestor
+        and widens upward through consecutive RESERVE layers, so a same-host
+        spare always wins over a distant one (locality = least resharding
+        movement).  The dead leaf freed one active slot in every ancestor it
+        shares with a candidate (the scope and above), so only the
+        candidate's ancestors *below* the current scope must still have
+        ``max_ranks`` headroom.
+        """
+        scopes: List[_Node] = []
+        for node in dead.ancestors():
+            if node.layer.flag & LayerFlag.RESERVE:
+                scopes.append(node)
+            else:
+                break
+        for scope in scopes:  # nearest ancestor first
+            for leaf in scope.iter_leaves():
+                if leaf.mode is Mode.INACTIVE and all(
+                    n.has_max_headroom()
+                    for n in leaf.ancestors()
+                    if n.depth > scope.depth
+                ):
+                    return leaf
+        return None
+
+    def _backfill_mover(self, dead: _Leaf, gap_rank: int) -> Optional[_Leaf]:
+        """Highest-app-rank active leaf from the consecutive BACKFILL
+        ancestor chain (nearest first) — the same stop-at-unflagged-layer
+        rule as the RESERVE search, keeping gap-filling local."""
+        for node in dead.ancestors():
+            if not (node.layer.flag & LayerFlag.BACKFILL):
+                break
+            movers = [
+                l
+                for l in node.iter_leaves()
+                if l.mode is Mode.ACTIVE and l.app_rank is not None and l.app_rank > gap_rank
+            ]
+            if movers:
+                return max(movers, key=lambda l: l.app_rank)
+        return None
+
+    @staticmethod
+    def _terminate_leaf(leaf: _Leaf, gaps: List[tuple]) -> None:
+        if leaf.mode is Mode.ACTIVE:
+            gaps.append((leaf.app_rank, leaf))
+        leaf.set_mode(Mode.TERMINATED)
+        leaf.app_rank = None
+
+    def _renumber(self) -> None:
+        """Shift step: close remaining gaps, preserving app-rank order."""
+        actives = sorted(
+            (l for l in self._root.iter_leaves() if l.mode is Mode.ACTIVE),
+            key=lambda l: l.app_rank,
+        )
+        for i, leaf in enumerate(actives):
+            leaf.app_rank = i
+
+    # -- policy entry ------------------------------------------------------
+
+    def _apply_one(self, r: int) -> None:
+        """Apply ONE termination event: terminate → propagate min_ranks →
+        refill gaps (reserve, then backfill) → shift → world_size_filter.
+
+        Events are applied strictly one at a time in the store log's global
+        order, so the final assignment is a pure function of the log prefix:
+        two ranks whose store reads batch the same events differently still
+        converge.  (The tree is stateful — a batching-dependent result here
+        would be a *permanent* cross-rank divergence, unlike the stateless
+        policies which self-heal on the next fault.)
+        """
+        leaf = self._leaves[r]
+        if leaf.mode is Mode.TERMINATED:
+            return
+        gaps: List[tuple] = []  # (vacated app rank, dead leaf)
+        self._terminate_leaf(leaf, gaps)
+        # min_ranks propagation only ever cascades along THIS leaf's
+        # ancestor chain (other subtrees' healthy counts are untouched), so
+        # a full-tree sweep per event would waste O(n) on the restart
+        # critical path; the incremental healthy_n counters make each hop
+        # O(1) to test, bottom-up so upper nodes see updated counts
+        for node in leaf.ancestors():
+            if node.healthy_n < node.layer.min_ranks:
+                for l in node.iter_leaves():
+                    if l.mode is not Mode.TERMINATED:
+                        self._terminate_leaf(l, gaps)
+        # reserve replacement, then local backfill, then global shift
+        for gap, dead in sorted(gaps, key=lambda p: p[0]):
+            spare = self._reserve_candidate(dead)
+            if spare is not None:
+                spare.set_mode(Mode.ACTIVE)
+                spare.app_rank = gap
+                continue
+            mover = self._backfill_mover(dead, gap)
+            if mover is not None:
+                mover.app_rank = gap
+        self._renumber()
+        self._apply_filter()
+
+    def _apply_filter(self) -> None:
+        """Deactivate the active tail down to ``world_size_filter(n)``.
+
+        Runs after _build and after EVERY event (not once per __call__):
+        filtered-out leaves become reserve candidates, so deferring the
+        filter to the end of a batched call would again make results depend
+        on how events were batched."""
+        if self.world_size_filter is None:
+            return
+        n_active = self._root.active_n
+        keep = self.world_size_filter(n_active)
+        if keep > n_active:
+            raise RestartAbort(
+                f"world_size_filter returned {keep} > active count {n_active}"
+            )
+        for leaf in self._root.iter_leaves():
+            if leaf.mode is Mode.ACTIVE and leaf.app_rank is not None and leaf.app_rank >= keep:
+                leaf.set_mode(Mode.INACTIVE)
+                leaf.app_rank = None
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        state = ctx.state
+        if self._root is None:
+            self._build(state.initial_world_size)
+        terms = ctx.terminated_ranks
+        if isinstance(terms, (set, frozenset)):
+            # no arrival order available (pure-logic callers/tests): pin one
+            terms = sorted(terms)
+        for r in terms:
+            if r not in self._applied:
+                self._applied.add(r)
+                self._apply_one(r)
+
+        my = self._leaves[state.initial_rank]
+        if my.mode is Mode.TERMINATED:
+            raise RankDiscontinued(
+                f"rank {state.initial_rank} terminated (topology tree)"
+            )
+        healthy = [l for l in self._root.iter_leaves() if l.mode is not Mode.TERMINATED]
+        actives = sorted(
+            (l for l in healthy if l.mode is Mode.ACTIVE), key=lambda l: l.app_rank
+        )
+        parked = sorted(
+            (l for l in healthy if l.mode is not Mode.ACTIVE), key=lambda l: l.initial_rank
+        )
+        state.world_size = len(healthy)
+        state.active_world_size = len(actives)
+        if my.mode is Mode.ACTIVE:
+            state.rank = my.app_rank
+            state.active_rank = my.app_rank
+            state.mode = Mode.ACTIVE
+        else:
+            state.rank = len(actives) + parked.index(my)
+            state.active_rank = None
+            state.mode = Mode.INACTIVE
+        return ctx
+
+
+def tpu_pod_layers(
+    chips_per_host: int,
+    hosts_per_slice: Optional[int] = None,
+    min_slices: int = 1,
+    max_active: Optional[int] = None,
+    reserve: bool = True,
+) -> List[Layer]:
+    """Layers for the canonical TPU hierarchy chip → host → slice → pod.
+
+    A host with a dead chip cannot contribute a legal sub-mesh, so the host
+    layer pins ``min_ranks = max_ranks = chips_per_host``; slices likewise if
+    ``hosts_per_slice`` is given.  ``min_slices`` sets the root's
+    minimum-capacity floor (the job aborts below ``min_slices`` whole
+    slices — or whole hosts when no slice layer is used).  ``reserve=True``
+    marks every layer RESERVE so spare hosts/slices promote into gaps
+    (hot-spare pattern, reference ``ft_rendezvous_barrier.py:1842-1865``).
+    """
+
+    flag = LayerFlag.RESERVE if reserve else LayerFlag.NONE
+    # without an explicit slice layer, the host is the slice unit — min_slices
+    # still sets the root's minimum-capacity floor either way
+    slice_chips = chips_per_host * (hosts_per_slice or 1)
+    layers = [
+        Layer(
+            min_ranks=min_slices * slice_chips,
+            max_ranks=max_active,
+            key_of_rank="root",
+            flag=flag,
+        )
+    ]
+    if hosts_per_slice is not None:
+        layers.append(
+            Layer(
+                min_ranks=slice_chips,
+                max_ranks=slice_chips,
+                key_of_rank=lambda r, n=slice_chips: r // n,
+                flag=flag,
+            )
+        )
+    layers.append(
+        Layer(
+            min_ranks=chips_per_host,
+            max_ranks=chips_per_host,
+            key_of_rank=lambda r, n=chips_per_host: r // n,
+            flag=flag,
+        )
+    )
+    return layers
 
 
 class ActiveWorldSizeDivisibleBy(RankAssignment):

@@ -59,11 +59,26 @@ class InprocStore:
     # -- terminated ranks --------------------------------------------------
 
     def mark_terminated(self, rank: int) -> None:
-        self.store.set(f"{self.ns}/terminated/{rank}", b"1")
+        # atomic APPEND to one log key: every rank observes the same total
+        # order of terminations (each read is a prefix of the same log).
+        # Stateful rank-assignment policies (Tree) replay this order, so a
+        # canonical order is load-bearing, not cosmetic.
+        self.store.append(f"{self.ns}/terminated_log", f"{rank},".encode())
 
     def terminated_ranks(self) -> List[int]:
-        keys = self.store.list_keys(f"{self.ns}/terminated/")
-        return sorted(int(k.decode().rsplit("/", 1)[1]) for k in keys)
+        """Terminated initial ranks in global first-termination order."""
+        raw = self.store.try_get(f"{self.ns}/terminated_log")
+        if not raw:
+            return []
+        seen: set = set()
+        out: List[int] = []
+        for tok in raw.decode().split(","):
+            if tok:
+                r = int(tok)
+                if r not in seen:
+                    seen.add(r)
+                    out.append(r)
+        return out
 
     # -- sibling heartbeats ------------------------------------------------
 

@@ -32,7 +32,7 @@ from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
 from .monitor_process import MonitorProcess
 from .monitor_thread import MonitorThread
 from .progress_watchdog import ProgressWatchdog
-from .rank_assignment import RankAssignmentCtx, ShiftRanks
+from .rank_assignment import RankAssignmentCtx, RankDiscontinued, ShiftRanks
 from .sibling_monitor import SiblingMonitor
 from .state import Mode, State
 from .store_ops import InprocStore
@@ -190,8 +190,7 @@ class CallWrapper:
         state = self.state
         main_tid = threading.get_ident()
         # initial assignment
-        terminated = set(self.ops.terminated_ranks())
-        w.rank_assignment(RankAssignmentCtx(state, terminated))
+        self._assign()
 
         while True:
             iteration = state.iteration
@@ -199,10 +198,9 @@ class CallWrapper:
                 raise RestartAbort(f"max_iterations {w.max_iterations} reached")
             if self.monitor_process:
                 self.monitor_process.set_iteration(iteration)
+            terminated_now = set(self.ops.terminated_ranks())
             survivors = [
-                r
-                for r in range(state.initial_world_size)
-                if r not in set(self.ops.terminated_ranks())
+                r for r in range(state.initial_world_size) if r not in terminated_now
             ]
             monitor = MonitorThread(
                 self.ops,
@@ -320,10 +318,9 @@ class CallWrapper:
                 )
                 raise RestartAbort(str(exc)) from exc
             self._iteration_barrier(iteration)
-            terminated = set(self.ops.terminated_ranks())
             state.rank = state.initial_rank
             state.world_size = state.initial_world_size
-            w.rank_assignment(RankAssignmentCtx(state, terminated))
+            self._assign()
             state.advance()
             self.watchdog.ping()
             gc.collect()
@@ -355,15 +352,35 @@ class CallWrapper:
         except RankShouldRestart:
             pass
 
+    def _assign(self) -> None:
+        """Run the rank-assignment policy against the store's terminated set.
+
+        A policy may discontinue a *healthy* rank (e.g. :class:`Tree`
+        ``min_ranks`` propagation terminates a whole host when one chip
+        dies).  That rank must record itself terminated before leaving, or
+        peers' survivor sets — and therefore iteration barriers — would keep
+        waiting for it.
+        """
+        # keep the store's global termination ORDER: stateful policies (Tree)
+        # replay it event-by-event, so every rank must see the same sequence
+        terminated = self.ops.terminated_ranks()
+        try:
+            self.w.rank_assignment(RankAssignmentCtx(self.state, terminated))
+        except RankDiscontinued:
+            if self.state.initial_rank not in terminated:
+                self.ops.mark_terminated(self.state.initial_rank)
+            raise
+
     def _iteration_barrier(self, iteration: int) -> None:
         """Barrier among survivors; re-computes the survivor set when peers
         die mid-barrier (their monitor marks them terminated)."""
         deadline = time.monotonic() + self.w.barrier_timeout
         while True:
+            terminated_now = set(self.ops.terminated_ranks())
             survivors = [
                 r
                 for r in range(self.state.initial_world_size)
-                if r not in set(self.ops.terminated_ranks())
+                if r not in terminated_now
             ]
             try:
                 self.ops.iteration_barrier(
