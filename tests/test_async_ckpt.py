@@ -135,3 +135,88 @@ def test_uncommitted_checkpoint_rejected(tmp_path):
     (d / "process_0.json").write_text("{}")
     with pytest.raises(FileNotFoundError):
         load_checkpoint(str(d), {"a": np.zeros(1)})
+
+
+# -- snapshot staging + plan/shm reuse (round 2) ------------------------------
+
+
+def test_snapshot_mode_donation_safe(tmp_path):
+    """The save must capture the state AT save time even when the very next
+    dispatch donates and overwrites the saved buffers."""
+    mesh = Mesh(np.array(jax.devices()), ("all",))
+    sh = NamedSharding(mesh, P("all"))
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32), sh)
+    bump = jax.jit(lambda v: v + 1.0, donate_argnums=(0,))
+    ckpt = AsyncCheckpointer(stage_mode="snapshot")
+    d = str(tmp_path / "snap")
+    ckpt.async_save({"x": x}, d)
+    expected = np.asarray(jnp.arange(64, dtype=jnp.float32))
+    x = bump(x)  # donates + overwrites the buffer the save references
+    x = bump(x)
+    ckpt.finalize_all()
+    restored = load_checkpoint(d, {"x": np.zeros(64, dtype=np.float32)})
+    np.testing.assert_array_equal(restored["x"], expected)
+    ckpt.close()
+
+
+def test_steady_state_save_reuses_shm(tmp_path):
+    """Second save of an unchanged layout must allocate zero new shm bytes
+    (plan + segment reuse; reference worker cache core.py:434-438)."""
+    ckpt = AsyncCheckpointer(stage_mode="snapshot")
+    tree = make_tree()
+    d1, d2, d3 = (str(tmp_path / f"ck{i}") for i in range(3))
+    ckpt.async_save(tree, d1)
+    ckpt.finalize_all()
+    assert ckpt.last_stage_stats["bytes_allocated"] > 0
+    ckpt.async_save(make_tree(seed=1), d2)
+    ckpt.finalize_all()
+    assert ckpt.last_stage_stats["bytes_allocated"] == 0
+    assert ckpt.last_stage_stats["bytes_reused"] > 0
+    # values are the NEW tree's, not the pooled buffers' old contents
+    restored = load_checkpoint(d2, jax.tree_util.tree_map(np.zeros_like, tree))
+    assert_trees_equal(make_tree(seed=1), restored)
+    # layout change invalidates reuse and still saves correctly
+    other = {"y": np.arange(17, dtype=np.float32)}
+    ckpt.async_save(other, d3)
+    ckpt.finalize_all()
+    assert ckpt.last_stage_stats["bytes_allocated"] > 0
+    restored = load_checkpoint(d3, {"y": np.zeros(17, dtype=np.float32)})
+    np.testing.assert_array_equal(restored["y"], other["y"])
+    ckpt.close()
+
+
+def test_metadata_merge_cache_verified(tmp_path):
+    """Rank-0 merge cache is reused only when every process index reports the
+    same plan signature (verify_global_md_reuse analog)."""
+    ckpt = AsyncCheckpointer(stage_mode="sync")
+    tree = make_tree()
+    d1, d2 = str(tmp_path / "m1"), str(tmp_path / "m2")
+    ckpt.async_save(tree, d1)
+    ckpt.finalize_all()
+    assert ckpt._merger.reuse_hits == 0
+    ckpt.async_save(make_tree(seed=2), d2)
+    ckpt.finalize_all()
+    assert ckpt._merger.reuse_hits == 1
+    meta = read_metadata(d2)
+    assert meta["plan_sig"]
+    restored = load_checkpoint(d2, jax.tree_util.tree_map(np.zeros_like, tree))
+    assert_trees_equal(make_tree(seed=2), restored)
+    ckpt.close()
+
+
+def test_snapshot_staging_error_surfaces(tmp_path):
+    """A staging failure in the background thread must raise from
+    maybe_finalize/finalize_all, not vanish."""
+    ckpt = AsyncCheckpointer(stage_mode="snapshot")
+
+    class Boom:
+        shape = ()
+        dtype = np.float32
+
+        def __array__(self, dtype=None, copy=None):
+            raise RuntimeError("boom")
+
+    ckpt.async_save({"bad": Boom()}, str(tmp_path / "er"))
+    with pytest.raises(CheckpointSaveError, match="staging failed"):
+        ckpt.finalize_all()
+    ckpt.close()

@@ -3,30 +3,48 @@
 Reference analogs: ``TorchAsyncCheckpoint`` (``torch_ckpt.py:32``) +
 ``save_state_dict_async_plan`` / ``..._finalize`` (``state_dict_saver.py``).
 
-Save pipeline per request:
-  1. (trainer, sync)   stage_pytree: async D2H of every shard into shm
-  2. (worker, async)   write_process_shards: shm -> .npy files + process index
-  3. (trainer, later)  finalize once ALL ranks' writes are done:
+Save pipeline per request (default ``stage_mode="snapshot"``):
+  1. (trainer, ~free)  device snapshot: one jitted copy of every jax.Array
+                       leaf into fresh device buffers — an async dispatch,
+                       so the training step never waits on D2H.  Device
+                       ordering makes this donation-safe: the copy is
+                       enqueued before the next step can reuse donated
+                       input buffers.
+  2. (stager thread)   stage_pytree: D2H of the snapshot into shm, reusing
+                       pooled segments when the plan signature matches
+                       (zero shm allocation in steady state)
+  3. (worker, async)   write_process_shards: shm -> files + process index
+  4. (trainer, later)  finalize once ALL ranks' writes are done:
                        coordinator merges process indices -> metadata.json
-                       (atomic commit), everyone unlinks shm
+                       (atomic commit), shm returns to the pool
+
+``stage_mode="sync"`` restores the reference-style behavior (trainer blocks
+on D2H at save time, reference ``core.py:547-553`` preload join) for hosts
+where the extra device-memory copy is unaffordable.
 
 The metadata-read side has a cache (:class:`CachedMetadataReader`, the
-reference's ``CachedMetadataFileSystemReader`` analog); the save side
-recomputes its plan each time — staging is O(bytes), planning is O(leaves).
+reference's ``CachedMetadataFileSystemReader`` analog); the save-side merge
+is cached by plan signature and cross-checked against every process's
+reported signature (reference ``verify_global_md_reuse``,
+``state_dict_saver.py:374``).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import glob
 import json
 import os
-from typing import Any, Callable, Dict, List, Optional
+import queue as queue_mod
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ...utils.logging import get_logger
-from .core import AsyncCallsQueue, AsyncRequest, store_sync_fn
-from .staging import StagedTree, shard_payload, stage_pytree
+from .core import AsyncCallsQueue, AsyncRequest, CheckpointSaveError, store_sync_fn
+from .staging import StagedTree, plan_signature, shard_payload, stage_pytree
 from .writer import (
     is_committed,
     read_leaf,
@@ -38,6 +56,58 @@ from .writer import (
 log = get_logger("checkpointer")
 
 
+def _has_jax_arrays(tree: Any) -> bool:
+    try:
+        import jax
+
+        return any(
+            isinstance(l, jax.Array) for l in jax.tree_util.tree_leaves(tree)
+        )
+    except Exception:  # noqa: BLE001
+        return False
+
+
+_SNAP_FN = None
+
+
+def device_snapshot(tree: Any) -> Any:
+    """Copy every jax.Array leaf into fresh device buffers with one jitted
+    dispatch (host leaves are np.copy'd).  Returns immediately — the copies
+    execute on the device stream ahead of any later-dispatched step, so the
+    snapshot is consistent even when the training step donates its inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    global _SNAP_FN
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dev_idx = [i for i, l in enumerate(leaves) if isinstance(l, jax.Array)]
+    if dev_idx:
+        if _SNAP_FN is None:
+            _SNAP_FN = jax.jit(lambda xs: [jnp.copy(x) for x in xs])
+        copies = _SNAP_FN([leaves[i] for i in dev_idx])
+        for slot, c in zip(dev_idx, copies):
+            leaves[slot] = c
+    dev_set = set(dev_idx)
+    out = [
+        l if i in dev_set else (l.copy() if isinstance(l, np.ndarray) else l)
+        for i, l in enumerate(leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+@dataclasses.dataclass
+class _StagingJob:
+    tree: Any
+    ckpt_dir: str
+    extra_metadata: Optional[Dict]
+    save_id: str
+    plan_sig: str
+    ticket: int
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    staged: Optional[StagedTree] = None
+    error: Optional[str] = None
+
+
 class AsyncCheckpointer:
     def __init__(
         self,
@@ -47,7 +117,11 @@ class AsyncCheckpointer:
         process_index: Optional[int] = None,
         persistent_worker: bool = True,
         write_threads: int = 4,
+        stage_mode: str = "snapshot",
+        pool_size: int = 1,
     ):
+        if stage_mode not in ("snapshot", "sync"):
+            raise ValueError(f"stage_mode must be snapshot|sync, got {stage_mode!r}")
         sync_fn = (
             store_sync_fn(store, rank, world_size) if store is not None else None
         )
@@ -55,6 +129,8 @@ class AsyncCheckpointer:
         self.rank = rank
         self.world_size = world_size
         self.write_threads = write_threads
+        self.stage_mode = stage_mode
+        self.pool_size = pool_size
         if process_index is None:
             try:
                 import jax
@@ -63,6 +139,15 @@ class AsyncCheckpointer:
             except Exception:  # noqa: BLE001
                 process_index = 0
         self.process_index = process_index
+        self._merger = _MetadataMerger()
+        self._save_seq = 0
+        self._jobs: List[_StagingJob] = []
+        self._pool: List[StagedTree] = []
+        self._pool_lock = threading.Lock()
+        self._stage_q: "queue_mod.Queue[Optional[_StagingJob]]" = queue_mod.Queue()
+        self._stager: Optional[threading.Thread] = None
+        # last staging's byte accounting (tests assert steady-state reuse)
+        self.last_stage_stats: Dict[str, int] = {}
 
     # -- save --------------------------------------------------------------
 
@@ -72,14 +157,17 @@ class AsyncCheckpointer:
         ckpt_dir: str,
         extra_metadata: Optional[Dict] = None,
         save_id: Optional[str] = None,
+        stage_mode: Optional[str] = None,
     ) -> int:
-        """Stage synchronously (cheap), write + commit asynchronously.
-        Returns the call idx.  Call :meth:`maybe_finalize` every step.
+        """Snapshot + hand off to the stager (default), or stage inline
+        (``stage_mode="sync"``).  Returns a monotonic save ticket.  Call
+        :meth:`maybe_finalize` every step.
 
         ``save_id`` must match across ranks of one save (e.g. the training
         iteration); finalize only merges process indices carrying the same
         id, so stale index files from a previous run into the same directory
         (possibly with a different world size) are never committed."""
+        mode = stage_mode or self.stage_mode
         os.makedirs(ckpt_dir, exist_ok=True)
         if save_id is None:
             save_id = str((extra_metadata or {}).get("iteration", "default"))
@@ -90,65 +178,207 @@ class AsyncCheckpointer:
         ):
             if stale and os.path.exists(stale):
                 os.unlink(stale)
-        staged = stage_pytree(tree, process_index=self.process_index)
-        payloads = [shard_payload(s) for s in staged.shards]
-
-        finalize_fns: List[Callable] = []
-        if self.rank == 0:
-            finalize_fns.append(
-                lambda: _finalize_metadata(ckpt_dir, staged, extra_metadata, save_id)
-            )
-
-        req = AsyncRequest(
-            async_fn=write_process_shards,
-            async_fn_args=(
-                ckpt_dir, self.process_index, payloads, self.write_threads, save_id,
-            ),
-            finalize_fns=finalize_fns,
-            cleanup_fns=[lambda: staged.close(unlink=True)],
+        sig = plan_signature(tree, self.process_index)
+        self._save_seq += 1
+        if mode == "snapshot" and _has_jax_arrays(tree):
+            tree = device_snapshot(tree)  # async dispatch; no D2H yet
+        job = _StagingJob(
+            tree=tree,
+            ckpt_dir=ckpt_dir,
+            extra_metadata=extra_metadata,
+            save_id=save_id,
+            plan_sig=sig,
+            ticket=self._save_seq,
         )
-        return self.queue.schedule_async_request(req)
+        if mode == "sync":
+            self._run_staging(job)
+            self._jobs.append(job)
+            self._drain_staged(block=False)
+        else:
+            self._jobs.append(job)
+            self._ensure_stager()
+            self._stage_q.put(job)
+        return self._save_seq
 
     def save(self, tree: Any, ckpt_dir: str, extra_metadata: Optional[Dict] = None) -> None:
         """Synchronous save (stage + write + commit before returning)."""
         self.async_save(tree, ckpt_dir, extra_metadata)
         self.finalize_all()
 
+    # -- staging thread ----------------------------------------------------
+
+    def _ensure_stager(self) -> None:
+        if self._stager is None or not self._stager.is_alive():
+            self._stager = threading.Thread(
+                target=self._stager_loop, name="tpurx-ckpt-stager", daemon=True
+            )
+            self._stager.start()
+
+    def _stager_loop(self) -> None:
+        while True:
+            job = self._stage_q.get()
+            if job is None:
+                return
+            self._run_staging(job)
+
+    def _run_staging(self, job: _StagingJob) -> None:
+        try:
+            pooled = self._pool_acquire(job.plan_sig)
+            try:
+                job.staged = stage_pytree(
+                    job.tree,
+                    process_index=self.process_index,
+                    reuse=pooled,
+                    plan_sig=job.plan_sig,
+                )
+            except BaseException:
+                if pooled is not None:
+                    pooled.close(unlink=True)  # buffers in unknown state
+                raise
+            if pooled is not None and job.staged is not pooled:
+                pooled.close(unlink=True)  # sig raced a layout change
+            self.last_stage_stats = {
+                "bytes_allocated": job.staged.bytes_allocated,
+                "bytes_reused": job.staged.bytes_reused,
+            }
+        except Exception as exc:  # noqa: BLE001
+            log.exception("checkpoint staging failed")
+            job.error = f"staging failed: {exc!r}"
+        finally:
+            job.tree = None  # free the device snapshot
+            job.done.set()
+
+    def _pool_acquire(self, sig: str) -> Optional[StagedTree]:
+        with self._pool_lock:
+            for i, st in enumerate(self._pool):
+                if st.plan_sig == sig:
+                    return self._pool.pop(i)
+        return None
+
+    def _pool_release(self, staged: StagedTree) -> None:
+        with self._pool_lock:
+            if staged.plan_sig and len(self._pool) < self.pool_size:
+                self._pool.append(staged)
+                return
+        staged.close(unlink=True)
+
+    def _drain_pool(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, []
+        for st in pool:
+            st.close(unlink=True)
+
+    # -- scheduling + finalize --------------------------------------------
+
+    def _schedule_staged(self, job: _StagingJob) -> None:
+        staged = job.staged
+        payloads = [shard_payload(s) for s in staged.shards]
+        finalize_fns: List[Callable] = []
+        if self.rank == 0:
+            extra, save_id, ckpt_dir = job.extra_metadata, job.save_id, job.ckpt_dir
+            finalize_fns.append(
+                lambda: self._merger.finalize(ckpt_dir, staged, extra, save_id)
+            )
+        req = AsyncRequest(
+            async_fn=write_process_shards,
+            async_fn_args=(
+                job.ckpt_dir, self.process_index, payloads, self.write_threads,
+                job.save_id, job.plan_sig,
+            ),
+            finalize_fns=finalize_fns,
+            cleanup_fns=[lambda: self._pool_release(staged)],
+        )
+        self.queue.schedule_async_request(req)
+
+    def _drain_staged(self, block: bool, timeout: float = 600.0) -> None:
+        """Move completed staging jobs (in order) onto the write queue."""
+        deadline = time.monotonic() + timeout
+        while self._jobs:
+            job = self._jobs[0]
+            if block:
+                if not job.done.wait(timeout=max(0.0, deadline - time.monotonic())):
+                    raise TimeoutError(
+                        f"staging of save #{job.ticket} still running after {timeout}s"
+                    )
+            elif not job.done.is_set():
+                return
+            self._jobs.pop(0)
+            if job.error is not None:
+                raise CheckpointSaveError(f"save #{job.ticket}: {job.error}")
+            self._schedule_staged(job)
+
     def maybe_finalize(self, blocking: bool = False) -> List[int]:
+        self._drain_staged(block=blocking)
         return self.queue.maybe_finalize_async_calls(blocking=blocking)
 
     def finalize_all(self, timeout: float = 600.0) -> None:
+        self._drain_staged(block=True, timeout=timeout)
         self.queue.maybe_finalize_async_calls(blocking=True, timeout=timeout)
 
     def close(self) -> None:
-        self.queue.close()
+        try:
+            self.finalize_all()
+        finally:
+            if self._stager is not None and self._stager.is_alive():
+                self._stage_q.put(None)
+                self._stager.join(timeout=10)
+            self._drain_pool()
+            self.queue.close()
 
 
-def _finalize_metadata(
-    ckpt_dir: str, staged: StagedTree, extra: Optional[Dict], save_id: str
-) -> None:
-    all_shards: List[Dict] = []
-    merged = 0
-    for pf in sorted(glob.glob(os.path.join(ckpt_dir, "process_*.json"))):
-        with open(pf) as f:
-            idx = json.load(f)
-        if idx.get("save_id") != save_id:
-            log.warning("ignoring stale process index %s (save_id %r != %r)",
-                        pf, idx.get("save_id"), save_id)
-            continue
-        merged += 1
-        for s in idx["shards"]:
-            s["process_index"] = idx["process_index"]
-            all_shards.append(s)
-    write_metadata(
-        ckpt_dir,
-        staged.treedef_repr,
-        staged.leaf_paths,
-        all_shards,
-        num_processes=merged,
-        extra={**(extra or {}), "save_id": save_id},
-    )
-    log.info("checkpoint committed: %s (%d shards)", ckpt_dir, len(all_shards))
+class _MetadataMerger:
+    """Rank-0 finalize: merge process indices into metadata.json.
+
+    The merged shard list is cached by (plan_sig, save world) and only
+    reused after verifying every process index reports the SAME plan
+    signature — the reference's ``verify_global_md_reuse``
+    (``state_dict_saver.py:374``) against silent plan drift."""
+
+    def __init__(self):
+        self._cache_key: Optional[Tuple[str, int]] = None
+        self._cache_shards: Optional[List[Dict]] = None
+        self.reuse_hits = 0
+
+    def finalize(
+        self, ckpt_dir: str, staged: StagedTree, extra: Optional[Dict], save_id: str
+    ) -> None:
+        indices = []
+        for pf in sorted(glob.glob(os.path.join(ckpt_dir, "process_*.json"))):
+            with open(pf) as f:
+                idx = json.load(f)
+            if idx.get("save_id") != save_id:
+                log.warning("ignoring stale process index %s (save_id %r != %r)",
+                            pf, idx.get("save_id"), save_id)
+                continue
+            indices.append(idx)
+        sigs = {idx.get("plan_sig", "") for idx in indices}
+        verified = sigs == {staged.plan_sig}
+        key = (staged.plan_sig, len(indices))
+        if verified and self._cache_key == key and self._cache_shards is not None:
+            all_shards = self._cache_shards
+            self.reuse_hits += 1
+        else:
+            if not verified:
+                log.warning(
+                    "plan signature mismatch across processes (%s vs local %s) — "
+                    "full metadata merge", sigs, staged.plan_sig,
+                )
+            all_shards = []
+            for idx in indices:
+                for s in idx["shards"]:
+                    s["process_index"] = idx["process_index"]
+                    all_shards.append(s)
+            if verified:
+                self._cache_key, self._cache_shards = key, all_shards
+        write_metadata(
+            ckpt_dir,
+            staged.treedef_repr,
+            staged.leaf_paths,
+            all_shards,
+            num_processes=len(indices),
+            extra={**(extra or {}), "save_id": save_id, "plan_sig": staged.plan_sig},
+        )
+        log.info("checkpoint committed: %s (%d shards)", ckpt_dir, len(all_shards))
 
 
 # -- load --------------------------------------------------------------------

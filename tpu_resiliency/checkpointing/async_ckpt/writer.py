@@ -39,6 +39,7 @@ def write_process_shards(
     payloads: List[Dict[str, Any]],
     num_threads: int = 4,
     save_id: str = "default",
+    plan_sig: str = "",
 ) -> None:
     """Worker-process entry: write every owned shard from shm, then the
     per-process index file (its atomic rename is the per-process commit)."""
@@ -73,6 +74,7 @@ def write_process_shards(
     index = {
         "process_index": process_index,
         "save_id": save_id,
+        "plan_sig": plan_sig,
         "shards": [
             {k: v for k, v in p.items() if k != "shm_name"} for p in owned
         ],

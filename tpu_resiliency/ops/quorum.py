@@ -171,10 +171,12 @@ class QuorumMonitor:
         interval: float = 0.1,
         on_stale: Optional[Callable[[float], None]] = None,
         use_pallas: Optional[bool] = None,
+        auto_beat_interval: Optional[float] = None,
     ):
         self.mesh = mesh
         self.budget_ms = budget_ms
         self.interval = interval
+        self.auto_beat_interval = auto_beat_interval
         def _default_on_stale(age):
             from ..utils.profiling import ProfilingEvent, record_event
 
@@ -190,10 +192,62 @@ class QuorumMonitor:
         self._thread = threading.Thread(
             target=self._loop, name="tpurx-quorum", daemon=True
         )
+        self._beater_stop = threading.Event()
+        self._beater: Optional[threading.Thread] = None
         self.last_max_age: Optional[int] = None
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
+
+    # -- liveness auto-beat (reference ProgressWatchdog auto-timestamps,
+    # ``progress_watchdog.py:50-61``): a daemon thread stamping at
+    # ``auto_beat_interval`` proves the interpreter schedules threads —
+    # detects process death / GIL-holding wedges with a ms-scale budget,
+    # independent of step cadence.  Manual ``beat()`` remains the
+    # progress signal (budget tied to step time).
+    def _beater_loop(self) -> None:
+        while not self._beater_stop.is_set():
+            self.beat()
+            self._beater_stop.wait(self.auto_beat_interval)
+
+    def _start_beater(self) -> None:
+        if self.auto_beat_interval is None:
+            return
+        if self._beater is None or not self._beater.is_alive():
+            self._beater_stop.clear()  # un-latch a previous stop_auto_beat
+            self._beater = threading.Thread(
+                target=self._beater_loop, name="tpurx-quorum-beat", daemon=True
+            )
+            self._beater.start()
+
+    def stop_auto_beat(self) -> None:
+        """Stop the liveness beater (tests/benchmarks simulate a wedged
+        process this way — stamps freeze while the tick loop, playing the
+        healthy peers' role, keeps reducing)."""
+        self._beater_stop.set()
+        if self._beater is not None:
+            self._beater.join(timeout=2)
+
+    def calibrate(self, n_ticks: int = 20, safety: float = 3.0,
+                  margin_ms: float = 2.0, min_budget_ms: float = 5.0) -> float:
+        """Derive the detection budget from OBSERVED healthy tick ages
+        (beat jitter + scheduling noise) instead of a safety factor over the
+        beat period alone — ages already embed every real-world delay, so the
+        budget is as tight as the platform allows without false positives.
+        Runs ``n_ticks`` blocking ticks, sets and returns ``budget_ms``."""
+        self._start_beater()
+        ages = []
+        for _ in range(max(3, n_ticks)):
+            saved = self.budget_ms
+            self.budget_ms = float("inf")  # no trips during calibration
+            try:
+                ages.append(self.tick())
+            finally:
+                self.budget_ms = saved
+        ages_arr = np.asarray(sorted(ages), dtype=np.float64)
+        p99 = float(ages_arr[min(len(ages_arr) - 1, int(0.99 * len(ages_arr)))])
+        self.budget_ms = max(min_budget_ms, safety * p99 + margin_ms)
+        return self.budget_ms
 
     def tick(self) -> int:
         """One collective; returns the pod-wide max heartbeat age (ms)."""
@@ -236,7 +290,30 @@ class QuorumMonitor:
             self.on_stale(age)
         return age
 
+    def warmup(self) -> None:
+        """Compile + run both collective variants so the monitor loop's
+        first iteration doesn't spend ~0.5s tracing while hangs go
+        unobserved."""
+        saved = self.budget_ms
+        self.budget_ms = float("inf")
+        try:
+            self.tick()
+            self.tick_pipelined()
+            self.tick_pipelined()
+            # drain the in-flight dispatch: its host-side age includes the
+            # compile time above and would trip a spurious on_stale as the
+            # loop's first evaluated result
+            if self._pending is not None:
+                int(self._pending)
+                self._pending = None
+        finally:
+            self.budget_ms = saved
+
     def start(self) -> "QuorumMonitor":
+        self.beat()
+        self._start_beater()
+        if self._fn_async is None:
+            self.warmup()
         self.beat()
         self._thread.start()
         return self
@@ -255,7 +332,11 @@ class QuorumMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        self._thread.join(timeout=5)
+        self._beater_stop.set()
+        if self._beater is not None:
+            self._beater.join(timeout=2)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
 
 
 def quorum_reduce(mesh, stamps_ms) -> int:
