@@ -131,3 +131,29 @@ def test_quorum_tick_pipelined():
     age = mon.tick_pipelined()
     assert age is not None and age >= 100
     assert hits
+
+
+def test_quorum_overlapped_loop_and_calibrate():
+    """fetch_workers>0: dispatches overlap result readbacks; calibrated
+    budget derives from observed healthy ages; auto-beat keeps the pod
+    healthy until stopped, then the stale trip fires."""
+    mesh = make_mesh(("all",), (8,))
+    hits = []
+    mon = QuorumMonitor(
+        mesh, budget_ms=1e9, interval=0.005,
+        on_stale=lambda age: hits.append(age), use_pallas=False,
+        auto_beat_interval=0.002, fetch_workers=4,
+    )
+    budget = mon.calibrate(n_ticks=8)
+    assert budget >= 5.0
+    mon.start()
+    time.sleep(0.3)
+    assert not hits, f"false trip on healthy pod: {hits}"
+    assert mon.last_max_age is not None  # overlapped loop is evaluating
+    mon.stop_auto_beat()
+    t0 = time.monotonic()
+    while not hits and time.monotonic() - t0 < 5.0:
+        time.sleep(0.005)
+    mon.stop()
+    assert hits
+    assert (time.monotonic() - t0) * 1000 < 2000

@@ -56,17 +56,6 @@ from .writer import (
 log = get_logger("checkpointer")
 
 
-def _has_jax_arrays(tree: Any) -> bool:
-    try:
-        import jax
-
-        return any(
-            isinstance(l, jax.Array) for l in jax.tree_util.tree_leaves(tree)
-        )
-    except Exception:  # noqa: BLE001
-        return False
-
-
 _SNAP_FN = None
 
 
@@ -180,7 +169,9 @@ class AsyncCheckpointer:
                 os.unlink(stale)
         sig = plan_signature(tree, self.process_index)
         self._save_seq += 1
-        if mode == "snapshot" and _has_jax_arrays(tree):
+        if mode == "snapshot":
+            # also copies host-only trees: the stager must never hold raw
+            # references the trainer can mutate in place after we return
             tree = device_snapshot(tree)  # async dispatch; no D2H yet
         job = _StagingJob(
             tree=tree,

@@ -172,11 +172,19 @@ class QuorumMonitor:
         on_stale: Optional[Callable[[float], None]] = None,
         use_pallas: Optional[bool] = None,
         auto_beat_interval: Optional[float] = None,
+        fetch_workers: int = 0,
     ):
         self.mesh = mesh
         self.budget_ms = budget_ms
         self.interval = interval
         self.auto_beat_interval = auto_beat_interval
+        # >0 enables the overlapped loop: collectives dispatch every
+        # ``interval`` and results are evaluated by a fetch thread pool, so
+        # detection latency is budget + interval/2 + ONE readback even when
+        # the result readback RTT dwarfs the interval (tunneled transports;
+        # readbacks multiplex across threads, measured on the axon relay)
+        self.fetch_workers = fetch_workers
+        self._last_seq = 0
         def _default_on_stale(age):
             from ..utils.profiling import ProfilingEvent, record_event
 
@@ -184,6 +192,7 @@ class QuorumMonitor:
             record_event(ProfilingEvent.HANG_DETECTED, source="quorum", age_ms=age)
 
         self.on_stale = on_stale or _default_on_stale
+        self.use_pallas = use_pallas
         self._fn = make_quorum_fn(mesh, use_pallas=use_pallas)
         self._fn_async = None
         self._pending = None
@@ -272,7 +281,7 @@ class QuorumMonitor:
         None on the first call."""
         if self._fn_async is None:
             self._fn_async = make_quorum_fn(
-                self.mesh, use_pallas=None, blocking=False
+                self.mesh, use_pallas=self.use_pallas, blocking=False
             )
         n_local = (
             len(self.mesh.local_devices)
@@ -319,6 +328,9 @@ class QuorumMonitor:
         return self
 
     def _loop(self) -> None:
+        if self.fetch_workers > 0:
+            self._loop_overlapped()
+            return
         # pipelined ticks: the device round-trip hides behind the interval,
         # so the effective detection cadence is ~interval instead of
         # interval + round-trip (documented one-tick result lag)
@@ -329,6 +341,62 @@ class QuorumMonitor:
                 log.warning("quorum tick failed: %s", exc)
                 return
             self._stop.wait(self.interval)
+
+    def _loop_overlapped(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._fn_async is None:
+            self._fn_async = make_quorum_fn(
+                self.mesh, use_pallas=self.use_pallas, blocking=False
+            )
+        n_local = (
+            len(self.mesh.local_devices)
+            if hasattr(self.mesh, "local_devices")
+            else int(np.prod(self.mesh.devices.shape))
+        )
+        lock = threading.Lock()
+        inflight = [0]
+
+        def evaluate(seq, pending):
+            try:
+                age = int(pending)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("quorum fetch failed: %s", exc)
+                return
+            finally:
+                with lock:
+                    inflight[0] -= 1
+            # on_stale stays serialized and at-most-once per dispatch seq
+            # (monotonic), matching the single-threaded tick loop's contract
+            # — restart machinery wired to it need not be re-entrant
+            fire = False
+            with lock:
+                if seq > self._last_seq:
+                    self._last_seq = seq
+                    self.last_max_age = age
+                    fire = age > self.budget_ms
+                if fire:
+                    self.on_stale(age)
+
+        seq = 0
+        with ThreadPoolExecutor(
+            max_workers=self.fetch_workers, thread_name_prefix="tpurx-quorum-fetch"
+        ) as pool:
+            while not self._stop.is_set():
+                with lock:
+                    free = inflight[0] < self.fetch_workers
+                if free:
+                    try:
+                        stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
+                        pending = self._fn_async(stamps)
+                    except Exception as exc:  # noqa: BLE001
+                        log.warning("quorum dispatch failed: %s", exc)
+                        return
+                    seq += 1
+                    with lock:
+                        inflight[0] += 1
+                    pool.submit(evaluate, seq, pending)
+                self._stop.wait(self.interval)
 
     def stop(self) -> None:
         self._stop.set()
