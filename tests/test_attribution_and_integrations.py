@@ -65,12 +65,19 @@ class TestLogAnalyzer:
 
         def fake_llm(prompt):
             calls.append(prompt)
-            return "thermal_throttle|yes|chip running hot"
+            return (
+                'Sure: {"category": "device_error", "should_resume": true, '
+                '"confidence": 0.8, "culprit_ranks": [3], '
+                '"reason": "chip running hot"}'
+            )
 
         # "error" keyword makes it a candidate but no rule matches
         v = LogAnalyzer(llm_fn=fake_llm).analyze_text("weird error xyzzy-42\n")
         assert calls
         assert v.should_resume is True
+        assert v.category == FailureCategory.DEVICE_ERROR
+        assert v.culprit_ranks == [3]
+        assert "xyzzy-42" in calls[0]  # prompt carries the candidates
 
 
 class TestStateMachine:

@@ -1,0 +1,333 @@
+"""LLM attribution backend + analysis engine tests.
+
+Reference analog: ``tests/attribution/unit`` (golden outputs over the
+LogSage/engine stack).  An in-process fake OpenAI-compatible server stands in
+for the real endpoint; the attrsvc e2e drives /submit → /result with all
+three analyses in one submission.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tpu_resiliency.attribution import (
+    AnalysisEngine,
+    AnalysisSpec,
+    AttributionResult,
+    FailureCategory,
+    LLMClient,
+    LogAnalyzer,
+    default_engine,
+    llm_from_env,
+)
+from tpu_resiliency.attribution.llm import (
+    LLMError,
+    build_attribution_prompt,
+    parse_attribution_response,
+)
+
+
+class FakeOpenAI(BaseHTTPRequestHandler):
+    """OpenAI-compatible /chat/completions returning a canned verdict; the
+    response content is settable per server instance, and requests are
+    recorded for prompt assertions."""
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", "0"))
+        body = json.loads(self.rfile.read(n).decode())
+        self.server.requests.append(body)
+        if self.server.fail_times > 0:
+            self.server.fail_times -= 1
+            self.send_response(500)
+            self.end_headers()
+            return
+        content = self.server.reply
+        raw = json.dumps(
+            {"choices": [{"message": {"role": "assistant", "content": content}}]}
+        ).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def fake_llm_server():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), FakeOpenAI)
+    server.requests = []
+    server.fail_times = 0
+    server.reply = json.dumps(
+        {
+            "category": "network",
+            "should_resume": True,
+            "confidence": 0.9,
+            "culprit_ranks": [5],
+            "reason": "DCN link flap on host 5",
+        }
+    )
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield server
+    server.shutdown()
+    server.server_close()
+
+
+def test_llm_client_roundtrip(fake_llm_server):
+    client = LLMClient(
+        base_url=f"http://127.0.0.1:{fake_llm_server.server_port}",
+        api_key="sk-test", model="attr-1",
+    )
+    out = client("why did it fail?")
+    assert "DCN link flap" in out
+    req = fake_llm_server.requests[0]
+    assert req["model"] == "attr-1"
+    assert req["messages"][1]["content"] == "why did it fail?"
+
+
+def test_llm_client_retries_then_raises(fake_llm_server):
+    client = LLMClient(
+        base_url=f"http://127.0.0.1:{fake_llm_server.server_port}",
+        max_retries=1,
+    )
+    fake_llm_server.fail_times = 1
+    assert "DCN" in client("q")  # one failure absorbed by retry
+    fake_llm_server.fail_times = 10
+    with pytest.raises(LLMError):
+        client("q")
+
+
+def test_llm_from_env(monkeypatch, fake_llm_server):
+    monkeypatch.delenv("TPURX_LLM_BASE_URL", raising=False)
+    assert llm_from_env() is None
+    monkeypatch.setenv(
+        "TPURX_LLM_BASE_URL", f"http://127.0.0.1:{fake_llm_server.server_port}"
+    )
+    monkeypatch.setenv("TPURX_LLM_MODEL", "m2")
+    client = llm_from_env()
+    assert client is not None and client.model == "m2"
+    assert "DCN" in client("q")
+
+
+def test_parse_attribution_response_robust():
+    assert parse_attribution_response("no json here") is None
+    assert parse_attribution_response('{"nope": 1}') is None
+    out = parse_attribution_response(
+        'Here you go:\n```json\n{"category": "OOM_HBM", "should_resume": false,'
+        ' "confidence": 1.7, "culprit_ranks": [2, 2.0], "reason": "hbm"}\n```'
+    )
+    assert out["category"] == "oom_hbm"
+    assert out["confidence"] == 1.0  # clamped
+    assert out["culprit_ranks"] == [2, 2]
+    assert out["should_resume"] is False
+
+
+def test_prompt_carries_rule_verdict():
+    p = build_attribution_prompt(
+        [(3, "some error line")],
+        rule_verdict={"category": "network", "confidence": 0.8},
+    )
+    assert "L3: some error line" in p
+    assert '"network"' in p and "confirm or override" in p
+
+
+def test_analyzer_llm_always_confirms_and_overrides():
+    # concur: same category -> confidence boost + merged ranks
+    concur = lambda prompt: json.dumps(
+        {"category": "network", "should_resume": True, "confidence": 0.9,
+         "culprit_ranks": [7], "reason": "socket reset storm"}
+    )
+    v = LogAnalyzer(llm_fn=concur, consult_llm="always").analyze_text(
+        "[r3] ConnectionResetError: peer gone\n"
+    )
+    assert v.category == FailureCategory.NETWORK
+    assert v.confidence > 0.8
+    assert v.culprit_ranks == [3, 7]
+    # override: different category, higher confidence than the rules
+    override = lambda prompt: json.dumps(
+        {"category": "preemption", "should_resume": True, "confidence": 0.97,
+         "culprit_ranks": [], "reason": "maintenance event"}
+    )
+    v2 = LogAnalyzer(llm_fn=override, consult_llm="always").analyze_text(
+        "[r3] ConnectionResetError: peer gone\n"
+    )
+    assert v2.category == FailureCategory.PREEMPTION
+    assert "overrode" in v2.summary
+    # never: llm_fn present but not consulted
+    calls = []
+    v3 = LogAnalyzer(
+        llm_fn=lambda p: calls.append(p), consult_llm="never"
+    ).analyze_text("[r3] ConnectionResetError: peer gone\n")
+    assert v3.category == FailureCategory.NETWORK and not calls
+
+
+def test_analyzer_survives_llm_garbage():
+    v = LogAnalyzer(llm_fn=lambda p: "%%% not json", consult_llm="always").analyze_text(
+        "RESOURCE_EXHAUSTED: out of HBM memory\n"
+    )
+    assert v.category == FailureCategory.OOM_HBM  # rules verdict stands
+    assert v.should_resume is False
+
+
+# -- engine -------------------------------------------------------------------
+
+
+def _markers(stale_rank=2, n=4):
+    now = time.time()
+    return {
+        str(r): {
+            "rank": r,
+            "iteration": 0,
+            "step": 100 if r != stale_rank else 37,
+            "phase": "step",
+            "ts": now if r != stale_rank else now - 120,
+        }
+        for r in range(n)
+    }
+
+
+def test_engine_runs_dag_and_reuses_results():
+    calls = []
+
+    def log_fn(payload, upstream, ctx):
+        calls.append("log")
+        return AttributionResult(category="network", confidence=0.8)
+
+    def trace_fn(payload, upstream, ctx):
+        calls.append("trace")
+        return AttributionResult(category="lagging", confidence=0.6, culprit_ranks=[2])
+
+    def joint_fn(payload, upstream, ctx):
+        calls.append("joint")
+        assert set(upstream) == {"l", "t"}  # upstream RESULTS, not recompute
+        return AttributionResult(
+            category="joint", confidence=0.9,
+            culprit_ranks=upstream["t"].culprit_ranks,
+        )
+
+    eng = AnalysisEngine(
+        [
+            AnalysisSpec(name="l", fn=log_fn),
+            AnalysisSpec(name="t", fn=trace_fn),
+            AnalysisSpec(name="j", fn=joint_fn, depends_on=["l", "t"]),
+        ]
+    )
+    out = eng.run_all({"x": 1})
+    assert out["done"] and not out["errors"]
+    assert out["results"]["j"]["culprit_ranks"] == [2]
+    assert calls.count("log") == 1 and calls.count("trace") == 1
+    eng.shutdown()
+
+
+def test_engine_isolates_failures_and_skips():
+    def boom(payload, upstream, ctx):
+        raise RuntimeError("kaput")
+
+    def dependent(payload, upstream, ctx):
+        return AttributionResult(category="x", confidence=1.0)
+
+    eng = AnalysisEngine(
+        [
+            AnalysisSpec(name="a", fn=boom),
+            AnalysisSpec(name="b", fn=dependent, depends_on=["a"]),
+            AnalysisSpec(
+                name="c", fn=dependent, applicable=lambda p: False
+            ),
+        ]
+    )
+    out = eng.run_all({})
+    assert "kaput" in out["errors"]["a"]
+    assert out["errors"]["b"] == "upstream analysis failed"
+    assert out["skipped"] == ["c"]
+    eng.shutdown()
+
+
+def test_default_engine_three_analyses():
+    eng = default_engine()
+    out = eng.run_all(
+        {
+            "text": "[r2] RESOURCE_EXHAUSTED: out of HBM memory\n",
+            "markers": _markers(stale_rank=2),
+            "stale_after_s": 30.0,
+        }
+    )
+    assert set(out["results"]) == {"log", "trace", "combined"}
+    assert out["results"]["log"]["category"] == "oom_hbm"
+    assert 2 in out["results"]["trace"]["culprit_ranks"]
+    combined = out["results"]["combined"]
+    assert combined["should_resume"] is False  # OOM dominates the trace
+    assert 2 in combined["culprit_ranks"]
+    eng.shutdown()
+
+
+def test_default_engine_skips_without_inputs():
+    eng = default_engine()
+    out = eng.run_all({"text": "Traceback (most recent call last)\n"})
+    assert "log" in out["results"]
+    assert "trace" in out["skipped"] and "combined" in out["skipped"]
+    eng.shutdown()
+
+
+# -- attrsvc e2e --------------------------------------------------------------
+
+
+def test_attrsvc_submit_e2e(monkeypatch, fake_llm_server):
+    import importlib
+    import urllib.request
+
+    monkeypatch.setenv(
+        "TPURX_LLM_BASE_URL", f"http://127.0.0.1:{fake_llm_server.server_port}"
+    )
+    from tpu_resiliency.services import attrsvc as svc
+
+    importlib.reload(svc)  # rebuild STATE with the env-configured LLM
+    assert svc.STATE.llm_fn is not None
+    server = svc.serve(host="127.0.0.1", port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{server.server_port}"
+
+    def post(path, payload):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        # no rule matches -> the fake LLM decides (fallback mode)
+        sub = post(
+            "/submit",
+            {"text": "bizarre error qwerty-77\n", "markers": _markers(stale_rank=1)},
+        )
+        job_id = sub["job_id"]
+        with urllib.request.urlopen(
+            f"{base}/result/{job_id}?wait=30", timeout=40
+        ) as r:
+            out = json.loads(r.read().decode())
+        assert out["done"], out
+        assert set(out["results"]) == {"log", "trace", "combined"}
+        assert out["results"]["log"]["category"] == "network"  # fake LLM verdict
+        assert out["results"]["log"]["culprit_ranks"] == [5]
+        assert fake_llm_server.requests  # the endpoint was really consulted
+        # unknown job id -> 404
+        try:
+            urllib.request.urlopen(f"{base}/result/nope", timeout=10)
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        # stats reflect the job + llm backend
+        with urllib.request.urlopen(f"{base}/stats", timeout=10) as r:
+            stats = json.loads(r.read().decode())
+        assert stats["jobs_submitted"] == 1 and stats["llm_backend"] is True
+    finally:
+        server.shutdown()
+        server.server_close()
+        importlib.reload(svc)  # restore module-level STATE without the env

@@ -3,17 +3,26 @@
 - :class:`AttributionPipeline` — composable preprocess → attribute →
   postprocess pipeline (reference ``base.py:95-300``).
 - :mod:`log_analyzer` — rule-based error extraction + root-cause + resume
-  verdict from worker/cycle logs (the reference's LogSage/LLM analyzer is an
-  optional extra there too; the rule engine is the always-on layer, and an
-  LLM backend can be injected as a callable).
+  verdict from worker/cycle logs; an LLM backend (``llm.py``, reference
+  ``log_analyzer/nvrx_logsage.py``) plugs in as ``llm_fn`` and is consulted
+  per the analyzer's ``consult_llm`` mode.
+- :class:`AnalysisEngine` — multi-analysis DAG scheduling over one failure
+  submission (reference ``analyzer/engine.py``).
 """
 
 from .base import AttributionPipeline, AttributionResult
+from .engine import AnalysisEngine, AnalysisSpec, default_engine
+from .llm import LLMClient, llm_from_env
 from .log_analyzer import LogAnalyzer, FailureCategory, AnalysisVerdict
 
 __all__ = [
     "AttributionPipeline",
     "AttributionResult",
+    "AnalysisEngine",
+    "AnalysisSpec",
+    "default_engine",
+    "LLMClient",
+    "llm_from_env",
     "LogAnalyzer",
     "FailureCategory",
     "AnalysisVerdict",

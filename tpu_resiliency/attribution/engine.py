@@ -1,0 +1,322 @@
+"""Multi-analysis scheduling engine.
+
+Reference analog: ``attribution/analyzer/engine.py`` (812 LoC) — orchestrates
+several analyses over one failure submission.  Here: a small DAG executor on
+a thread pool.  Each analysis declares dependencies; dependent analyses
+receive upstream RESULTS (the combined verdict reuses the log + trace
+verdicts instead of recomputing them), failures are isolated per analysis,
+and every analysis has its own timeout.
+
+Built-in registry (``default_engine``):
+
+    log       rule-engine (+optional LLM) log attribution
+    trace     progress-marker trace attribution
+    combined  joint verdict from log + trace results
+
+Submissions are jobs: ``submit`` returns a job id immediately; ``result``
+polls/waits.  ``run_all`` is the synchronous convenience.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import get_logger
+from .base import AttributionResult
+
+log = get_logger("attribution.engine")
+
+
+@dataclasses.dataclass
+class AnalysisSpec:
+    """One analysis: ``fn(payload, upstream_results, ctx) -> AttributionResult``.
+
+    ``applicable(payload) -> bool`` lets an analysis skip itself when its
+    input is absent (e.g. trace analysis without markers)."""
+
+    name: str
+    fn: Callable[[dict, Dict[str, AttributionResult], dict], AttributionResult]
+    depends_on: List[str] = dataclasses.field(default_factory=list)
+    timeout_s: float = 120.0
+    applicable: Callable[[dict], bool] = lambda payload: True
+
+
+@dataclasses.dataclass
+class Job:
+    job_id: str
+    payload: dict
+    requested: List[str]
+    results: Dict[str, AttributionResult] = dataclasses.field(default_factory=dict)
+    errors: Dict[str, str] = dataclasses.field(default_factory=dict)
+    skipped: List[str] = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
+    started_at: float = dataclasses.field(default_factory=time.time)
+    finished_at: Optional[float] = None
+    # guards results/errors/skipped: the runner writes while HTTP handler
+    # threads snapshot via result()
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+
+
+class AnalysisEngine:
+    def __init__(self, specs: List[AnalysisSpec], max_workers: int = 4,
+                 job_ttl_s: float = 3600.0):
+        self.specs = {s.name: s for s in specs}
+        for s in specs:
+            for dep in s.depends_on:
+                if dep not in self.specs:
+                    raise ValueError(f"analysis {s.name!r} depends on unknown {dep!r}")
+        self.max_workers = max_workers  # concurrent analyses per job wave
+        self._jobs: Dict[str, Job] = {}
+        self._lock = threading.Lock()
+        self.job_ttl_s = job_ttl_s
+        self.leaked_threads = 0  # timed-out analyses whose thread still runs
+
+    # -- public -------------------------------------------------------------
+
+    def submit(self, payload: dict, analyses: Optional[List[str]] = None) -> str:
+        """Schedule analyses (dependency-closed) over one payload; returns a
+        job id immediately."""
+        requested = self._close_over_deps(analyses or list(self.specs))
+        job = Job(job_id=uuid.uuid4().hex[:16], payload=payload, requested=requested)
+        with self._lock:
+            self._gc_jobs()
+            self._jobs[job.job_id] = job
+        # orchestration gets its own thread: a job runner blocking inside
+        # the analysis pool would starve the analyses it is waiting for
+        threading.Thread(
+            target=self._run_job, args=(job,),
+            name=f"tpurx-attr-job-{job.job_id[:6]}", daemon=True,
+        ).start()
+        return job.job_id
+
+    def result(self, job_id: str, timeout: Optional[float] = None) -> Optional[dict]:
+        with self._lock:
+            job = self._jobs.get(job_id)
+        if job is None:
+            return None
+        if timeout is not None:
+            job.done.wait(timeout)
+        return self._job_to_dict(job)
+
+    def run_all(self, payload: dict, analyses: Optional[List[str]] = None,
+                timeout: float = 300.0) -> dict:
+        job_id = self.submit(payload, analyses)
+        out = self.result(job_id, timeout=timeout)
+        assert out is not None
+        return out
+
+    def shutdown(self) -> None:
+        """Kept for API symmetry; analysis threads are daemons and die with
+        the process."""
+
+    # -- internals ----------------------------------------------------------
+
+    def _close_over_deps(self, names: List[str]) -> List[str]:
+        out: List[str] = []
+        stack = list(names)
+        while stack:
+            name = stack.pop()
+            if name not in self.specs:
+                raise ValueError(f"unknown analysis {name!r}")
+            if name in out:
+                continue
+            out.append(name)
+            stack.extend(self.specs[name].depends_on)
+        return out
+
+    def _run_job(self, job: Job) -> None:
+        ctx: dict = {"job_id": job.job_id, "stage_times": {}}
+        pending = {n for n in job.requested}
+        try:
+            while pending:
+                with job.lock:
+                    ready = [
+                        n for n in pending
+                        if all(
+                            d in job.results or d in job.errors or d in job.skipped
+                            for d in self.specs[n].depends_on
+                        )
+                    ]
+                if not ready:  # unreachable with validated deps; guard anyway
+                    with job.lock:
+                        for n in pending:
+                            job.errors[n] = "dependency cycle"
+                    break
+                wave = []
+                for name in ready:
+                    pending.discard(name)
+                    spec = self.specs[name]
+                    if not spec.applicable(job.payload):
+                        with job.lock:
+                            job.skipped.append(name)
+                        continue
+                    with job.lock:
+                        upstream_failed = any(
+                            d in job.errors for d in spec.depends_on
+                        )
+                    if upstream_failed:
+                        with job.lock:
+                            job.errors[name] = "upstream analysis failed"
+                        continue
+                    wave.append(spec)
+                # one DEDICATED daemon thread per analysis: a wedged analysis
+                # leaks its thread (counted) instead of permanently occupying
+                # a shared pool worker and starving every later job
+                for batch_start in range(0, len(wave), self.max_workers):
+                    batch = wave[batch_start:batch_start + self.max_workers]
+                    threads = []
+                    for spec in batch:
+                        box: dict = {}
+                        t = threading.Thread(
+                            target=self._run_one, args=(spec, job, ctx, box),
+                            name=f"tpurx-attr-{spec.name}", daemon=True,
+                        )
+                        t.start()
+                        threads.append((spec, t, box))
+                    for spec, t, box in threads:
+                        t.join(timeout=spec.timeout_s)
+                        with job.lock:
+                            if t.is_alive():
+                                self.leaked_threads += 1
+                                job.errors[spec.name] = (
+                                    f"timed out after {spec.timeout_s}s "
+                                    "(analysis thread abandoned)"
+                                )
+                            elif "error" in box:
+                                job.errors[spec.name] = box["error"]
+                            elif box.get("result") is None:
+                                job.skipped.append(spec.name)
+                            else:
+                                job.results[spec.name] = box["result"]
+        finally:
+            job.finished_at = time.time()
+            job.done.set()
+
+    def _run_one(self, spec: AnalysisSpec, job: Job, ctx: dict, box: dict):
+        t0 = time.time()
+        try:
+            with job.lock:
+                upstream = dict(job.results)
+            box["result"] = spec.fn(job.payload, upstream, ctx)
+        except Exception as exc:  # noqa: BLE001
+            log.exception("analysis %s failed", spec.name)
+            box["error"] = repr(exc)
+        finally:
+            ctx["stage_times"][spec.name] = time.time() - t0
+
+    def _gc_jobs(self) -> None:
+        cutoff = time.time() - self.job_ttl_s
+        for jid in [
+            j for j, job in self._jobs.items()
+            if job.finished_at is not None and job.finished_at < cutoff
+        ]:
+            del self._jobs[jid]
+
+    @staticmethod
+    def _job_to_dict(job: Job) -> dict:
+        def res_dict(r: AttributionResult) -> dict:
+            return {
+                "category": r.category,
+                "should_resume": r.should_resume,
+                "confidence": r.confidence,
+                "culprit_ranks": r.culprit_ranks,
+                "summary": r.summary,
+                "evidence": r.evidence[:20],
+            }
+
+        with job.lock:
+            return {
+                "job_id": job.job_id,
+                "done": job.done.is_set(),
+                "results": {n: res_dict(r) for n, r in job.results.items()},
+                "errors": dict(job.errors),
+                "skipped": list(job.skipped),
+                "elapsed_s": round(
+                    (job.finished_at or time.time()) - job.started_at, 3
+                ),
+            }
+
+
+# -- built-in analyses -------------------------------------------------------
+
+
+def _parse_markers(payload: dict):
+    from .trace_analyzer import ProgressMarker
+
+    raw = payload.get("markers") or {}
+    return {
+        int(r): (ProgressMarker(**m) if isinstance(m, dict) else None)
+        for r, m in raw.items()
+    }
+
+
+def _log_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
+    from .log_analyzer import LogAnalyzer
+
+    v = LogAnalyzer(
+        llm_fn=payload.get("llm_fn"),
+        consult_llm=payload.get("consult_llm", "fallback"),
+    ).analyze_text(payload.get("text", ""))
+    return AttributionResult(
+        category=v.category.value,
+        confidence=v.confidence,
+        culprit_ranks=v.culprit_ranks,
+        summary=v.summary,
+        evidence=v.evidence,
+        should_resume=v.should_resume,
+    )
+
+
+def _trace_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
+    from .trace_analyzer import analyze_markers
+
+    return analyze_markers(
+        _parse_markers(payload),
+        stale_after_s=payload.get("stale_after_s", 30.0),
+    )
+
+
+def _combined_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
+    from .combined import combine
+    from .log_analyzer import AnalysisVerdict, FailureCategory
+
+    log_res = upstream.get("log")
+    trace_res = upstream.get("trace")
+    if log_res is None or trace_res is None:
+        return None
+    log_verdict = AnalysisVerdict(
+        category=FailureCategory(log_res.category)
+        if log_res.category in FailureCategory._value2member_map_
+        else FailureCategory.UNKNOWN,
+        should_resume=log_res.should_resume,
+        confidence=log_res.confidence,
+        culprit_ranks=log_res.culprit_ranks,
+        evidence=log_res.evidence,
+        summary=log_res.summary,
+    )
+    return combine(log_verdict, trace_res)
+
+
+def default_engine(max_workers: int = 4) -> AnalysisEngine:
+    return AnalysisEngine(
+        [
+            AnalysisSpec(
+                name="log", fn=_log_analysis,
+                applicable=lambda p: bool(p.get("text")),
+            ),
+            AnalysisSpec(
+                name="trace", fn=_trace_analysis,
+                applicable=lambda p: bool(p.get("markers")),
+            ),
+            AnalysisSpec(
+                name="combined", fn=_combined_analysis,
+                depends_on=["log", "trace"],
+                applicable=lambda p: bool(p.get("text")) and bool(p.get("markers")),
+            ),
+        ],
+        max_workers=max_workers,
+    )

@@ -1,0 +1,190 @@
+"""LLM attribution backend: OpenAI-compatible chat client + structured
+failure-attribution prompting.
+
+Reference analog: ``attribution/log_analyzer/nvrx_logsage.py:12-40`` — the
+LogSage path (error extraction → root-cause attribution → auto-resume
+decision) built on langchain/ChatOpenAI.  Rebuilt on stdlib HTTP against any
+OpenAI-compatible endpoint (vLLM, llama.cpp server, a hosted API, or the
+fake server in the tests), so the flagship attribution capability ships
+working with zero extra dependencies.
+
+Configuration (env, all optional — unset base URL disables the backend):
+
+    TPURX_LLM_BASE_URL   e.g. http://localhost:8000/v1
+    TPURX_LLM_API_KEY    bearer token (optional for local endpoints)
+    TPURX_LLM_MODEL      model name passed through (default "default")
+    TPURX_LLM_TIMEOUT_S  per-request timeout (default 30)
+
+Usage::
+
+    analyzer = LogAnalyzer(llm_fn=llm_from_env())       # None -> rules only
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+
+log = get_logger("attribution.llm")
+
+
+class LLMError(RuntimeError):
+    pass
+
+
+class LLMClient:
+    """Minimal OpenAI-compatible ``/chat/completions`` client.
+
+    Callable as ``client(prompt) -> str`` so it plugs directly into
+    ``LogAnalyzer(llm_fn=...)``.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        api_key: str = "",
+        model: str = "default",
+        timeout_s: float = 30.0,
+        max_retries: int = 2,
+        temperature: float = 0.0,
+        system_prompt: str = (
+            "You are a distributed-training failure analyst for JAX/TPU "
+            "workloads. Answer concisely and exactly in the requested format."
+        ),
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.model = model
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.temperature = temperature
+        self.system_prompt = system_prompt
+
+    def chat(self, messages: List[Dict[str, str]]) -> str:
+        payload = json.dumps(
+            {
+                "model": self.model,
+                "messages": messages,
+                "temperature": self.temperature,
+            }
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        url = f"{self.base_url}/chat/completions"
+        last_exc: Optional[Exception] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                req = urllib.request.Request(url, data=payload, headers=headers)
+                with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                    body = json.loads(resp.read().decode())
+                return body["choices"][0]["message"]["content"]
+            except urllib.error.HTTPError as exc:
+                if 400 <= exc.code < 500:
+                    # misconfiguration (bad key/model/path) — retrying only
+                    # adds dead time to every attribution and hides the status
+                    raise LLMError(f"HTTP {exc.code} from {url}: {exc.reason}")
+                last_exc = exc
+                if attempt < self.max_retries:
+                    time.sleep(0.5 * (attempt + 1))
+            except (urllib.error.URLError, OSError, KeyError, IndexError,
+                    json.JSONDecodeError) as exc:
+                last_exc = exc
+                if attempt < self.max_retries:
+                    time.sleep(0.5 * (attempt + 1))
+        raise LLMError(f"chat completion failed after retries: {last_exc!r}")
+
+    def __call__(self, prompt: str) -> str:
+        return self.chat(
+            [
+                {"role": "system", "content": self.system_prompt},
+                {"role": "user", "content": prompt},
+            ]
+        )
+
+
+def llm_from_env() -> Optional[LLMClient]:
+    """Build the client from ``TPURX_LLM_*`` env; None when unconfigured."""
+    base_url = os.environ.get("TPURX_LLM_BASE_URL", "").strip()
+    if not base_url:
+        return None
+    return LLMClient(
+        base_url=base_url,
+        api_key=os.environ.get("TPURX_LLM_API_KEY", ""),
+        model=os.environ.get("TPURX_LLM_MODEL", "default"),
+        timeout_s=float(os.environ.get("TPURX_LLM_TIMEOUT_S", "30")),
+    )
+
+
+# -- structured attribution prompting ----------------------------------------
+
+ATTRIBUTION_PROMPT = """\
+A distributed JAX/TPU training job failed. Below are the error-candidate log
+lines (with original line numbers) extracted by a rule engine{rules_note}.
+
+Known categories and whether an automatic restart can help:
+  device_error (resume), oom_host (no), oom_hbm (no), numerics (no),
+  data (no), preemption (resume), network (resume), hang_kill (resume),
+  user_code (no), unknown (resume)
+
+Respond with ONLY a JSON object, no prose:
+{{"category": "<one of the categories above>",
+  "should_resume": true/false,
+  "confidence": <0.0-1.0>,
+  "culprit_ranks": [<rank ints, [] if unknown>],
+  "reason": "<one line root cause>"}}
+
+Log lines:
+{lines}
+"""
+
+
+def build_attribution_prompt(
+    candidates: List, rule_verdict: Optional[dict] = None, max_lines: int = 60
+) -> str:
+    """Prompt from the rule engine's extracted candidates (and, when the
+    rules DID match, their verdict — the LLM then confirms/overrides)."""
+    lines = "\n".join(
+        f"L{lineno}: {line.strip()[:300]}" for lineno, line in candidates[:max_lines]
+    )
+    rules_note = ""
+    if rule_verdict:
+        rules_note = (
+            f"; the rule engine's own verdict was {json.dumps(rule_verdict)} "
+            "— confirm or override it"
+        )
+    return ATTRIBUTION_PROMPT.format(rules_note=rules_note, lines=lines)
+
+
+_JSON_RE = re.compile(r"\{.*\}", re.DOTALL)
+
+
+def parse_attribution_response(answer: str) -> Optional[dict]:
+    """Extract + validate the JSON verdict from a model response (models wrap
+    JSON in prose/markdown fences routinely)."""
+    m = _JSON_RE.search(answer)
+    if not m:
+        return None
+    try:
+        obj = json.loads(m.group(0))
+    except json.JSONDecodeError:
+        return None
+    if not isinstance(obj, dict) or "category" not in obj:
+        return None
+    out = {
+        "category": str(obj.get("category", "unknown")).strip().lower(),
+        "should_resume": bool(obj.get("should_resume", True)),
+        "confidence": max(0.0, min(1.0, float(obj.get("confidence", 0.5)))),
+        "culprit_ranks": sorted(
+            int(r) for r in obj.get("culprit_ranks", []) if isinstance(r, (int, float))
+        ),
+        "reason": str(obj.get("reason", ""))[:500],
+    }
+    return out

@@ -113,9 +113,27 @@ _RANK_RE = re.compile(r"\[r(\d+)\]|rank[=\s](\d+)", re.IGNORECASE)
 
 
 class LogAnalyzer:
-    def __init__(self, llm_fn: Optional[Callable[[str], str]] = None, context_lines: int = 3):
+    """``consult_llm`` modes (reference LogSage layering,
+    ``log_analyzer/nvrx_logsage.py:12-40``):
+
+    - ``"fallback"`` (default): LLM consulted only when no rule matched;
+    - ``"always"``: LLM sees the rule verdict too and may confirm (confidence
+      boost) or override it (override taken only when the LLM is MORE
+      confident than the rules);
+    - ``"never"``: rules only, even if ``llm_fn`` is set.
+    """
+
+    def __init__(
+        self,
+        llm_fn: Optional[Callable[[str], str]] = None,
+        context_lines: int = 3,
+        consult_llm: str = "fallback",
+    ):
+        if consult_llm not in ("never", "fallback", "always"):
+            raise ValueError(f"consult_llm must be never|fallback|always, got {consult_llm!r}")
         self.llm_fn = llm_fn
         self.context_lines = context_lines
+        self.consult_llm = consult_llm
         self.pipeline = AttributionPipeline(
             attribute=self._attribute,
             preprocess=[self._extract_errors],
@@ -154,9 +172,12 @@ class LogAnalyzer:
                         if rank not in ranks:
                             ranks.append(rank)
                     break
+        llm_on = self.llm_fn is not None and self.consult_llm != "never"
         if best is None:
-            if self.llm_fn is not None and candidates:
-                return self._llm_attribute(candidates, ctx)
+            if llm_on and candidates:
+                llm = self._llm_attribute(candidates, ctx, rule_verdict=None)
+                if llm is not None:
+                    return llm
             return AttributionResult(
                 category=FailureCategory.UNKNOWN.value,
                 confidence=0.1,
@@ -164,7 +185,7 @@ class LogAnalyzer:
                 should_resume=True,
             )
         category, resume, conf = best
-        return AttributionResult(
+        result = AttributionResult(
             category=category.value,
             confidence=conf,
             culprit_ranks=sorted(ranks),
@@ -172,27 +193,53 @@ class LogAnalyzer:
             evidence=evidence[:20],
             should_resume=resume,
         )
+        if llm_on and self.consult_llm == "always":
+            rule_verdict = {
+                "category": result.category,
+                "should_resume": result.should_resume,
+                "confidence": result.confidence,
+            }
+            llm = self._llm_attribute(candidates, ctx, rule_verdict=rule_verdict)
+            if llm is not None:
+                if llm.category == result.category:
+                    result.confidence = min(0.99, max(result.confidence, llm.confidence) + 0.05)
+                    result.summary += f"; llm concurs: {llm.summary}"
+                    result.culprit_ranks = sorted(
+                        set(result.culprit_ranks) | set(llm.culprit_ranks)
+                    )
+                elif (
+                    llm.category != FailureCategory.UNKNOWN.value
+                    and llm.confidence > result.confidence
+                ):
+                    # a hallucinated (out-of-taxonomy -> unknown) category
+                    # must never displace a concrete rule verdict
+                    llm.summary += f" (overrode rules' {result.category})"
+                    llm.evidence = result.evidence
+                    result = llm
+                ctx["llm_consulted"] = True
+        return result
 
-    def _llm_attribute(self, candidates, ctx) -> AttributionResult:
-        snippet = "\n".join(line for _, line in candidates[:50])
+    def _llm_attribute(self, candidates, ctx, rule_verdict=None) -> Optional[AttributionResult]:
+        from .llm import build_attribution_prompt, parse_attribution_response
+
         try:
-            answer = self.llm_fn(
-                "Classify this distributed-training failure and answer with "
-                "'<category>|<resume:yes/no>|<one-line reason>':\n" + snippet
-            )
-            category, resume_s, reason = (answer.split("|") + ["", ""])[:3]
-            return AttributionResult(
-                category=category.strip() or FailureCategory.UNKNOWN.value,
-                confidence=0.6,
-                summary=reason.strip(),
-                should_resume="yes" in resume_s.lower(),
-            )
+            answer = self.llm_fn(build_attribution_prompt(candidates, rule_verdict))
+            parsed = parse_attribution_response(answer)
         except Exception:  # noqa: BLE001
-            log.exception("llm attribution failed; falling back to unknown")
-            return AttributionResult(
-                category=FailureCategory.UNKNOWN.value, confidence=0.1,
-                summary="llm backend failed", should_resume=True,
-            )
+            log.exception("llm attribution failed; falling back to rules")
+            return None
+        if parsed is None:
+            log.warning("unparseable llm attribution response: %.200s", answer)
+            return None
+        known = parsed["category"] in FailureCategory._value2member_map_
+        return AttributionResult(
+            category=parsed["category"] if known else FailureCategory.UNKNOWN.value,
+            confidence=parsed["confidence"],
+            culprit_ranks=parsed["culprit_ranks"],
+            summary=parsed["reason"] or "llm attribution",
+            should_resume=parsed["should_resume"],
+            extra={"source": "llm"},
+        )
 
     # -- public ------------------------------------------------------------
 

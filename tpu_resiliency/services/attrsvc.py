@@ -7,8 +7,15 @@ the stdlib http server (no web-framework dependency):
     POST /analyze           {"text": "..."} or {"path": "/logs/cycle_3.log"}
     POST /analyze_trace     {"markers": {rank: markerJson | null}}
     POST /analyze_combined  {"text": ..., "markers": ...}  (joint verdict)
+    POST /submit            one submission, ALL analyses scheduled by the
+                            engine (log + trace + combined); returns job_id
+    GET  /result/<job_id>   poll (blocks up to ?wait= seconds)
     GET  /health
     GET  /stats
+
+The LLM backend (``TPURX_LLM_BASE_URL`` etc., see ``attribution/llm.py``) is
+picked up from env at startup and consulted per the ``consult_llm`` field of
+each submission (default "fallback").
 
 Run: python -m tpu_resiliency.services.attrsvc --port 8950
 """
@@ -23,6 +30,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ..attribution import LogAnalyzer
+from ..attribution.engine import default_engine
+from ..attribution.llm import llm_from_env
 from ..attribution.trace_analyzer import ProgressMarker, analyze_markers
 from ..utils.logging import get_logger, setup_logger
 
@@ -31,18 +40,30 @@ log = get_logger("attrsvc")
 
 class _State:
     def __init__(self):
-        self.analyzer = LogAnalyzer()
+        self.llm_fn = llm_from_env()
+        self.analyzer = LogAnalyzer(llm_fn=self.llm_fn)
+        self.engine = default_engine()
         self.cache: Dict[str, dict] = {}
         self.lock = threading.Lock()
         self.requests = 0
         self.cache_hits = 0
         self.coalesced = 0
+        self.jobs_submitted = 0
         # digest -> Event; concurrent identical requests wait for the first
         # (reference coalescing/coalescer.py analog)
         self.in_flight: Dict[str, threading.Event] = {}
 
 
 STATE = _State()
+
+
+def _read_tail(path: str, tail_bytes: int = 1 << 20) -> str:
+    """Seek-based tail read: multi-GB worker logs must not be slurped."""
+    with open(path, "rb") as f:
+        f.seek(0, 2)
+        size = f.tell()
+        f.seek(max(0, size - tail_bytes))
+        return f.read().decode(errors="replace")
 
 
 def _verdict_to_dict(v) -> dict:
@@ -82,8 +103,24 @@ class Handler(BaseHTTPRequestHandler):
                         "cache_hits": STATE.cache_hits,
                         "coalesced": STATE.coalesced,
                         "cache_entries": len(STATE.cache),
+                        "jobs_submitted": STATE.jobs_submitted,
+                        "llm_backend": STATE.llm_fn is not None,
                     },
                 )
+        if self.path.startswith("/result/"):
+            rest = self.path[len("/result/"):]
+            job_id, _, query = rest.partition("?")
+            wait = 0.0
+            for part in query.split("&"):
+                if part.startswith("wait="):
+                    try:
+                        wait = min(120.0, float(part[5:]))
+                    except ValueError:
+                        pass
+            out = STATE.engine.result(job_id, timeout=wait or None)
+            if out is None:
+                return self._send(404, {"error": f"unknown job {job_id}"})
+            return self._send(200, out)
         return self._send(404, {"error": "unknown path"})
 
     def do_POST(self):
@@ -100,7 +137,36 @@ class Handler(BaseHTTPRequestHandler):
             return self._analyze_trace(body)
         if self.path == "/analyze_combined":
             return self._analyze_combined(body)
+        if self.path == "/submit":
+            return self._submit(body)
         return self._send(404, {"error": "unknown path"})
+
+    def _submit(self, body: dict):
+        consult_llm = body.get("consult_llm", "fallback")
+        if consult_llm not in ("never", "fallback", "always"):
+            return self._send(
+                400, {"error": f"bad consult_llm {consult_llm!r}"}
+            )
+        payload = {
+            "text": body.get("text", ""),
+            "markers": body.get("markers"),
+            "stale_after_s": body.get("stale_after_s", 30.0),
+            "consult_llm": consult_llm,
+            "llm_fn": STATE.llm_fn,
+        }
+        if body.get("path") and not payload["text"]:
+            try:
+                payload["text"] = _read_tail(body["path"])
+            except OSError as exc:
+                return self._send(400, {"error": f"cannot read {body['path']}: {exc}"})
+        analyses = body.get("analyses")
+        try:
+            job_id = STATE.engine.submit(payload, analyses)
+        except ValueError as exc:
+            return self._send(400, {"error": str(exc)})
+        with STATE.lock:
+            STATE.jobs_submitted += 1
+        return self._send(200, {"job_id": job_id})
 
     def _analyze_combined(self, body: dict):
         from ..attribution.combined import analyze_combined
@@ -135,8 +201,7 @@ class Handler(BaseHTTPRequestHandler):
             return self._send(400, {"error": "need 'text' or 'path'"})
         try:
             if text is None:
-                with open(path, "rb") as f:
-                    text = f.read()[-(1 << 20):].decode(errors="replace")
+                text = _read_tail(path)
         except OSError as exc:
             return self._send(400, {"error": f"cannot read {path}: {exc}"})
         digest = hashlib.sha256(text.encode()).hexdigest()
