@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import signal
 import subprocess
@@ -46,6 +47,7 @@ from .per_cycle_logs import CycleLogRouter
 from .progress_tracker import TrainingProgressTracker
 from .rank_monitor_server import RankMonitorServer
 from .rendezvous import (
+    K_ACTIVE_ROUND,
     K_SHUTDOWN,
     NodeDesc,
     NodeRole,
@@ -56,6 +58,8 @@ from .rendezvous import (
     UnhealthyNodeError,
     is_next_round_open,
     k_restart_req,
+    k_result,
+    k_shutdown_ack,
     request_restart,
 )
 
@@ -372,9 +376,16 @@ class ElasticAgent:
                 result = joiner.join(timeout=self.cfg.rdzv_round_timeout)
             except RendezvousClosedError as exc:
                 log.info("rendezvous closed: %s", exc)
+                self._ack_shutdown()
                 return 0 if "success" in str(exc) else 1
             except UnhealthyNodeError as exc:
                 log.error("node unhealthy, leaving the job: %s", exc)
+                self._ack_shutdown()
+                return 1
+            except StoreError as exc:
+                # Store host tore down while we were joining/parked (e.g. the
+                # job finished without us): clean shutdown, not a traceback.
+                log.warning("store unreachable during rendezvous: %s", exc)
                 return 1
             if result.role != NodeRole.PARTICIPANT:
                 continue
@@ -387,8 +398,10 @@ class ElasticAgent:
                     self.store.set(K_SHUTDOWN, "success")
                 except StoreError:
                     pass  # store host already gone — job is over either way
+                self._ack_shutdown()
                 return 0
             if outcome == "shutdown":
+                self._ack_shutdown()
                 return 1
             if outcome == "excluded":
                 joiner.desc.excluded = True
@@ -467,7 +480,8 @@ class ElasticAgent:
                 # dying ranks' final output (tracebacks) before the
                 # attribution gate reads the cycle log.
                 self._stop_workers()
-                time.sleep(0.2)  # reader threads flush after pipe EOF
+                if not self.log_router.join_readers(timeout=2.0):
+                    log.warning("per-cycle log readers still draining at deadline")
                 if not self._restart_allowed():
                     self.store.set(K_SHUTDOWN, "restart budget exhausted")
                     return "shutdown"
@@ -524,6 +538,82 @@ class ElasticAgent:
             return False
         return True
 
+    def _ack_shutdown(self) -> None:
+        """Record that this node has observed the shutdown flag (best-effort —
+        the store host may already be gone).  Only acks when the flag actually
+        exists: an excluded node exiting on a closed rendezvous must not leave
+        a premature ack that would later satisfy the host's wait spuriously."""
+        try:
+            if self.store.try_get(K_SHUTDOWN) is not None:
+                self.store.set(k_shutdown_ack(self.node_id), "1")
+        except (StoreError, OSError):
+            pass
+
+    def _await_shutdown_acks(self, timeout: float = 3.0) -> None:
+        """Store-hosting agent: wait until every participant of the latest
+        closed round has acked the shutdown flag (or the deadline passes)
+        before the store disappears.  Replaces the old fixed grace sleep — a
+        loaded host no longer races its peers' final ``try_get(K_SHUTDOWN)``.
+
+        Runs on a dedicated short-timeout connection: this is reachable from
+        the SIGTERM handler, where reusing ``self.store`` could re-enter its
+        lock mid-frame of an interrupted request and desync the wire protocol.
+        """
+        try:
+            store = StoreClient(
+                self.store.host, self.store.port, timeout=2.0, connect_timeout=2.0
+            )
+        except (StoreError, OSError):
+            return
+        try:
+            if store.try_get(K_SHUTDOWN) is None:
+                # tearing down without a published flag (SIGTERM on the host,
+                # unhealthy exit): publish one so peers can observe and ack
+                # instead of stalling the full deadline for acks that can
+                # never arrive
+                store.set(K_SHUTDOWN, "host terminated")
+            peers = [
+                n for n in self._latest_participants(store) if n != self.node_id
+            ]
+            keys = [k_shutdown_ack(n) for n in peers]
+            deadline = time.monotonic() + timeout
+            while peers and time.monotonic() < deadline:
+                if store.check(keys):
+                    break
+                time.sleep(0.05)
+            else:
+                if peers:
+                    log.warning(
+                        "peers did not all ack shutdown within %.1fs: %s",
+                        timeout, peers,
+                    )
+            # Standby spares and mid-join nodes are not in the ack set; they
+            # poll the store on a ~0.25 s cadence.  Hold the store one poll
+            # interval past the participant acks so they observe the flag and
+            # exit cleanly instead of hitting a dead store.
+            time.sleep(0.5)
+        except (StoreError, OSError):
+            return
+        finally:
+            store.close()
+
+    def _latest_participants(self, store) -> List[str]:
+        """Participants of the latest closed rendezvous round, read from the
+        store — ``self._result`` can be stale (e.g. this host was excluded
+        after its last participant round while the fleet moved on)."""
+        try:
+            raw_n = store.try_get(K_ACTIVE_ROUND)
+            if raw_n is not None:
+                for rnd in (int(raw_n), int(raw_n) - 1):
+                    if rnd < 0:
+                        continue
+                    raw = store.try_get(k_result(rnd))
+                    if raw:
+                        return list(json.loads(raw)["participants"])
+        except (StoreError, OSError, ValueError, KeyError):
+            pass
+        return list(self._result.participants) if self._result else []
+
     def _teardown(self) -> None:
         self.ipc.stop_receiving()
         for proc, ctrl, _ in self.monitors:
@@ -539,9 +629,10 @@ class ElasticAgent:
             self._host_loop.stop()
         self.log_router.close()
         if self._store_server:
-            # give peers a window to observe the shutdown flag before the
-            # store disappears (they tolerate store loss after that)
-            time.sleep(3.0)
+            # peers must observe the shutdown flag before the store disappears
+            # (they tolerate store loss after that); wait for their explicit
+            # acks rather than sleeping a fixed grace period
+            self._await_shutdown_acks(timeout=3.0)
             self._store_server.stop()
 
 

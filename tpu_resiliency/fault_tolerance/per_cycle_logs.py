@@ -15,8 +15,10 @@ thread per worker stream.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 from typing import Dict, IO, Optional, Tuple
 
 from ..utils.logging import get_logger
@@ -50,13 +52,11 @@ class CycleLogRouter:
         if funnel:
             # stream worker lines into the cluster log funnel as well
             try:
-                import logging as _logging
-
                 from ..utils.log_funnel import LogForwarder
 
                 host, _, port = funnel.rpartition(":")
                 fwd = LogForwarder(host, int(port))
-                fwd.setFormatter(_logging.Formatter("%(message)s"))
+                fwd.setFormatter(logging.Formatter("%(message)s"))
                 self._funnel = fwd
             except Exception:  # noqa: BLE001 - funnel is best-effort
                 log.exception("could not attach log funnel %s", funnel)
@@ -65,6 +65,18 @@ class CycleLogRouter:
 
     def start_cycle(self, cycle: int) -> None:
         with self._file_lock:
+            # forget the previous cycle's readers so join_readers() only ever
+            # waits on the current cycle; a reader stuck on a leaked write-fd
+            # (grandchild outliving SIGKILL) must not tax every later restart.
+            # Done under the same lock the readers' identity check takes, so
+            # no stale line can slip into the new cycle's file.
+            stale = [k for k, r in self._readers.items() if r.is_alive()]
+            if stale:
+                log.warning(
+                    "dropping %d still-draining reader(s) from prior cycles: %s",
+                    len(stale), stale,
+                )
+            self._readers = {}
             if self._file:
                 self._file.close()
                 self._file = None
@@ -91,11 +103,20 @@ class CycleLogRouter:
 
     def _drain(self, r_fd: int, rank: int, stream_name: str) -> None:
         prefix = f"[r{rank}]"
+        me = threading.current_thread()
         with os.fdopen(r_fd, "r", errors="replace") as rf:
             for line in rf:
                 line = line.rstrip("\n")
                 out = f"{prefix} {line}"
                 with self._file_lock:
+                    # checked under the lock that start_cycle holds while
+                    # swapping files: a reader replaced by a new cycle (leaked
+                    # write-fd in a grandchild kept its pipe open) must not
+                    # write stale output into the new cycle's log — the
+                    # attribution gate reads it; closing the fd EPIPEs the
+                    # holdout
+                    if self._readers.get((rank, stream_name)) is not me:
+                        break
                     if self._file and not self._truncated:
                         self._file.write(out + "\n")
                         self._written += len(out) + 1
@@ -107,12 +128,26 @@ class CycleLogRouter:
                             )
                             self._truncated = True
                 if self._funnel is not None:
-                    record = __import__("logging").LogRecord(
-                        "worker", 20, "", 0, out, None, None
+                    record = logging.LogRecord(
+                        "worker", logging.INFO, "", 0, out, None, None
                     )
                     self._funnel.emit(record)
                 if self.tee:
                     print(out, flush=True)
+
+    def join_readers(self, timeout: float = 2.0) -> bool:
+        """Wait until every reader thread has drained its pipe to EOF.
+
+        Called after the workers are stopped (their pipe write ends closed)
+        so the per-cycle file provably contains the final output — e.g. the
+        traceback the attribution gate is about to read — instead of relying
+        on a fixed sleep.  Returns False if some reader is still running at
+        the deadline (worker fd leaked to a grandchild that is still alive).
+        """
+        deadline = time.monotonic() + timeout
+        for reader in list(self._readers.values()):
+            reader.join(timeout=max(0.0, deadline - time.monotonic()))
+        return not any(r.is_alive() for r in self._readers.values())
 
     def close(self) -> None:
         with self._file_lock:

@@ -46,6 +46,13 @@ def k_restart_req(n: int) -> str:
     return f"rdzv/restart_req/{n}"
 
 
+def k_shutdown_ack(node_id: str) -> str:
+    """Per-node acknowledgement that ``K_SHUTDOWN`` was observed.  The agent
+    hosting the store waits for these before tearing the store down, so peers
+    provably saw the flag instead of racing a fixed grace sleep."""
+    return f"{K_SHUTDOWN}/ack/{node_id}"
+
+
 def request_restart(store, reason: str = "") -> None:
     """Any agent may request a new round after a failure; the host's round
     loop observes this and opens round N+1 (reference: any agent calls
