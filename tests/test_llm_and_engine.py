@@ -126,6 +126,13 @@ def test_parse_attribution_response_robust():
     assert out["confidence"] == 1.0  # clamped
     assert out["culprit_ranks"] == [2, 2]
     assert out["should_resume"] is False
+    # mistyped-but-valid JSON is salvaged, not raised on
+    out = parse_attribution_response(
+        '{"category": "network", "culprit_ranks": null, "confidence": "high"}'
+    )
+    assert out["category"] == "network"
+    assert out["culprit_ranks"] == []
+    assert out["confidence"] == 0.5
 
 
 def test_prompt_carries_rule_verdict():
@@ -246,6 +253,41 @@ def test_engine_isolates_failures_and_skips():
     assert out["errors"]["b"] == "upstream analysis failed"
     assert out["skipped"] == ["c"]
     eng.shutdown()
+
+
+def test_engine_survives_raising_applicable():
+    # a user predicate that raises must surface as that analysis's error —
+    # not kill the job runner and report a silently-empty done job
+    def ok_fn(payload, upstream, ctx):
+        return AttributionResult(category="network", confidence=0.5)
+
+    eng = AnalysisEngine(
+        [
+            AnalysisSpec(name="bad", fn=ok_fn,
+                         applicable=lambda p: p["missing"] is not None),
+            AnalysisSpec(name="good", fn=ok_fn),
+        ]
+    )
+    out = eng.run_all({})
+    assert out["done"]
+    assert "applicable() raised" in out["errors"]["bad"]
+    assert "good" in out["results"]
+
+
+def test_parse_markers_validation():
+    from tpu_resiliency.attribution.trace_analyzer import parse_markers
+
+    assert parse_markers(None) == {}
+    parsed = parse_markers({"3": None, "1": {"rank": 1, "iteration": 0, "step": 5}})
+    assert parsed[3] is None and parsed[1].step == 5
+    with pytest.raises(ValueError):
+        parse_markers("not a dict")
+    with pytest.raises(ValueError):
+        parse_markers({"x": None})
+    with pytest.raises(ValueError):
+        parse_markers({"1": {"bogus": 1}})
+    with pytest.raises(ValueError):
+        parse_markers({"1": 42})
 
 
 def test_default_engine_three_analyses():

@@ -95,6 +95,7 @@ class AnalysisEngine:
 
     def result(self, job_id: str, timeout: Optional[float] = None) -> Optional[dict]:
         with self._lock:
+            self._gc_jobs()  # an idle service must not pin expired payloads
             job = self._jobs.get(job_id)
         if job is None:
             return None
@@ -150,7 +151,13 @@ class AnalysisEngine:
                 for name in ready:
                     pending.discard(name)
                     spec = self.specs[name]
-                    if not spec.applicable(job.payload):
+                    try:
+                        applicable = bool(spec.applicable(job.payload))
+                    except Exception as exc:  # noqa: BLE001 - user predicate
+                        with job.lock:
+                            job.errors[name] = f"applicable() raised: {exc!r}"
+                        continue
+                    if not applicable:
                         with job.lock:
                             job.skipped.append(name)
                         continue
@@ -192,6 +199,11 @@ class AnalysisEngine:
                                 job.skipped.append(spec.name)
                             else:
                                 job.results[spec.name] = box["result"]
+        except Exception as exc:  # noqa: BLE001 - runner must never die silently
+            log.exception("job %s runner failed", job.job_id)
+            with job.lock:
+                for n in pending:
+                    job.errors.setdefault(n, f"job runner failed: {exc!r}")
         finally:
             job.finished_at = time.time()
             job.done.set()
@@ -244,16 +256,6 @@ class AnalysisEngine:
 # -- built-in analyses -------------------------------------------------------
 
 
-def _parse_markers(payload: dict):
-    from .trace_analyzer import ProgressMarker
-
-    raw = payload.get("markers") or {}
-    return {
-        int(r): (ProgressMarker(**m) if isinstance(m, dict) else None)
-        for r, m in raw.items()
-    }
-
-
 def _log_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
     from .log_analyzer import LogAnalyzer
 
@@ -272,10 +274,10 @@ def _log_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
 
 
 def _trace_analysis(payload, upstream, ctx) -> Optional[AttributionResult]:
-    from .trace_analyzer import analyze_markers
+    from .trace_analyzer import analyze_markers, parse_markers
 
     return analyze_markers(
-        _parse_markers(payload),
+        parse_markers(payload.get("markers")),
         stale_after_s=payload.get("stale_after_s", 30.0),
     )
 

@@ -178,12 +178,21 @@ def parse_attribution_response(answer: str) -> Optional[dict]:
         return None
     if not isinstance(obj, dict) or "category" not in obj:
         return None
+    # tolerate mistyped fields (confidence: "high", culprit_ranks: null):
+    # a model that produced valid JSON with a category is worth salvaging
+    try:
+        confidence = max(0.0, min(1.0, float(obj.get("confidence", 0.5))))
+    except (TypeError, ValueError):
+        confidence = 0.5
+    raw_ranks = obj.get("culprit_ranks")
+    if not isinstance(raw_ranks, (list, tuple)):
+        raw_ranks = []
     out = {
         "category": str(obj.get("category", "unknown")).strip().lower(),
         "should_resume": bool(obj.get("should_resume", True)),
-        "confidence": max(0.0, min(1.0, float(obj.get("confidence", 0.5)))),
+        "confidence": confidence,
         "culprit_ranks": sorted(
-            int(r) for r in obj.get("culprit_ranks", []) if isinstance(r, (int, float))
+            int(r) for r in raw_ranks if isinstance(r, (int, float))
         ),
         "reason": str(obj.get("reason", ""))[:500],
     }

@@ -47,6 +47,37 @@ class ProgressMarker:
         return cls(**json.loads(raw if isinstance(raw, str) else raw.decode()))
 
 
+def parse_markers(raw) -> Dict[int, Optional["ProgressMarker"]]:
+    """Parse the wire form ``{rank: markerObject | null}`` with validation.
+
+    The single parser behind attrsvc's /analyze_trace, /analyze_combined and
+    the analysis engine's trace analysis — raises ``ValueError`` (with a
+    client-presentable message) on any malformed input.
+    """
+    if raw is None:
+        raw = {}
+    if not isinstance(raw, dict):
+        raise ValueError("markers must be an object of rank -> marker|null")
+    out: Dict[int, Optional[ProgressMarker]] = {}
+    for r, m in raw.items():
+        try:
+            rank = int(r)
+        except (TypeError, ValueError):
+            raise ValueError(f"bad rank key {r!r}") from None
+        if m is None:
+            out[rank] = None
+        elif isinstance(m, dict):
+            try:
+                out[rank] = ProgressMarker(**m)
+            except TypeError as exc:
+                raise ValueError(f"bad marker for rank {rank}: {exc}") from None
+        else:
+            raise ValueError(
+                f"bad marker for rank {rank}: expected object or null"
+            )
+    return out
+
+
 class ProgressTraceRecorder:
     """Rank-side: publish a marker every ``every`` steps (one tiny store
     write; off the step critical path when called after dispatch)."""

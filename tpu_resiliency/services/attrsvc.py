@@ -32,7 +32,7 @@ from typing import Dict, Optional
 from ..attribution import LogAnalyzer
 from ..attribution.engine import default_engine
 from ..attribution.llm import llm_from_env
-from ..attribution.trace_analyzer import ProgressMarker, analyze_markers
+from ..attribution.trace_analyzer import analyze_markers, parse_markers
 from ..utils.logging import get_logger, setup_logger
 
 log = get_logger("attrsvc")
@@ -172,13 +172,9 @@ class Handler(BaseHTTPRequestHandler):
         from ..attribution.combined import analyze_combined
 
         text = body.get("text", "")
-        raw_markers = body.get("markers") or {}
         try:
-            markers = {
-                int(r): (ProgressMarker(**m) if isinstance(m, dict) else None)
-                for r, m in raw_markers.items()
-            }
-        except (TypeError, ValueError) as exc:
+            markers = parse_markers(body.get("markers"))
+        except ValueError as exc:
             return self._send(400, {"error": f"bad markers: {exc}"})
         result = analyze_combined(
             text, markers, stale_after_s=body.get("stale_after_s", 30.0)
@@ -231,16 +227,11 @@ class Handler(BaseHTTPRequestHandler):
         return self._send(200, verdict)
 
     def _analyze_trace(self, body: dict):
-        raw_markers = body.get("markers")
-        if not isinstance(raw_markers, dict):
+        if not isinstance(body.get("markers"), dict):
             return self._send(400, {"error": "need 'markers' dict"})
-        markers = {}
         try:
-            for rank_s, m in raw_markers.items():
-                markers[int(rank_s)] = (
-                    ProgressMarker(**m) if isinstance(m, dict) else None
-                )
-        except (TypeError, ValueError) as exc:
+            markers = parse_markers(body["markers"])
+        except ValueError as exc:
             return self._send(400, {"error": f"bad markers: {exc}"})
         result = analyze_markers(markers, stale_after_s=body.get("stale_after_s", 30.0))
         return self._send(
