@@ -1,0 +1,327 @@
+"""Deep health-check suite: TPU sysfs, kernel log, windowed counters,
+node-health daemon, distributed storage, and the monitor-hosted health loop.
+
+Reference analog: ``tests/shared_utils`` health-check unit coverage plus the
+watchdog-hosted GPU/NIC loops (``rank_monitor_server.py:122``).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.health import (
+    CounterDeltaWindowCheck,
+    DistributedStorageHealthCheck,
+    KernelLogHealthCheck,
+    NodeHealthDaemonCheck,
+    TpuSysHealthCheck,
+    WindowedErrorCounter,
+    build_passive_checks,
+)
+from tpu_resiliency.health.device import DeviceHealthCheck
+
+
+# -- tpu sysfs ---------------------------------------------------------------
+
+
+def _fake_accel_tree(tmp_path, n):
+    sys_accel = tmp_path / "sys_accel"
+    sys_accel.mkdir(exist_ok=True)
+    for i in range(n):
+        (sys_accel / f"accel{i}").mkdir(exist_ok=True)
+    return str(sys_accel)
+
+
+def test_tpu_sys_counts_chips(tmp_path):
+    root = _fake_accel_tree(tmp_path, 4)
+    check = TpuSysHealthCheck(sys_accel=root, dev_glob=str(tmp_path / "none*"))
+    r = check.run()
+    assert r.healthy and "4 accel" in r.message
+
+
+def test_tpu_sys_expected_chips(tmp_path):
+    root = _fake_accel_tree(tmp_path, 2)
+    check = TpuSysHealthCheck(
+        sys_accel=root, dev_glob=str(tmp_path / "none*"), expected_chips=4
+    )
+    r = check.run()
+    assert not r.healthy and "expected 4" in r.message
+
+
+def test_tpu_sys_learns_count_and_detects_drop(tmp_path):
+    root = _fake_accel_tree(tmp_path, 4)
+    check = TpuSysHealthCheck(sys_accel=root, dev_glob=str(tmp_path / "none*"))
+    assert check.run().healthy
+    # a chip falls off the bus
+    os.rmdir(os.path.join(root, "accel3"))
+    r = check.run()
+    assert not r.healthy and "expected 4" in r.message
+
+
+def test_tpu_sys_absent_driver_skips_unless_required(tmp_path):
+    check = TpuSysHealthCheck(
+        sys_accel=str(tmp_path / "missing"), dev_glob=str(tmp_path / "none*")
+    )
+    assert check.run().healthy  # dev box: skip, don't fail
+    required = TpuSysHealthCheck(
+        sys_accel=str(tmp_path / "missing"),
+        dev_glob=str(tmp_path / "none*"),
+        required=True,
+    )
+    assert not required.run().healthy
+
+
+# -- kernel log --------------------------------------------------------------
+
+
+def test_kernel_log_baselines_then_detects(tmp_path):
+    path = tmp_path / "kern.log"
+    path.write_text("old: tpu error before monitor started\n")
+    check = KernelLogHealthCheck(source=str(path), window_s=60.0)
+    assert check.run().healthy  # history is baseline, not failure
+    with open(path, "a") as f:
+        f.write("normal line\naccel accel0: fatal error, chip reset\n")
+    r = check.run()
+    assert not r.healthy and "chip reset" in r.message
+
+
+def test_kernel_log_threshold_and_window(tmp_path):
+    path = tmp_path / "kern.log"
+    path.write_text("")
+    check = KernelLogHealthCheck(
+        source=str(path), window_s=0.3, threshold=2
+    )
+    assert check.run().healthy
+    with open(path, "a") as f:
+        f.write("pcieport 0000:00:01.0: AER: error received\n")
+    assert check.run().healthy  # 1 < threshold 2
+    with open(path, "a") as f:
+        f.write("EDAC MC0: 1 UE on chip\n")
+    assert not check.run().healthy  # 2 within window
+    time.sleep(0.35)
+    assert check.run().healthy  # window expired
+
+
+def test_kernel_log_rotation(tmp_path):
+    path = tmp_path / "kern.log"
+    path.write_text("x" * 100)
+    check = KernelLogHealthCheck(source=str(path), window_s=60.0)
+    assert check.run().healthy
+    path.write_text("Machine Check event\n")  # rotated: smaller than offset
+    assert not check.run().healthy
+
+
+# -- windowed counters -------------------------------------------------------
+
+
+def test_windowed_counter_math():
+    w = WindowedErrorCounter(window_s=10.0)
+    w.record(3, now=100.0)
+    w.record(2, now=105.0)
+    assert w.count(now=106.0) == 5
+    assert w.count(now=111.0) == 2  # first event aged out
+    assert w.count(now=200.0) == 0
+
+
+def test_counter_delta_window(tmp_path):
+    f1 = tmp_path / "rx_errors"
+    f1.write_text("1000")
+    check = CounterDeltaWindowCheck(
+        counter_globs=[str(tmp_path / "*_errors")], window_s=0.3, threshold=2
+    )
+    assert check.run().healthy  # baseline
+    f1.write_text("1001")
+    assert check.run().healthy  # 1 < threshold
+    f1.write_text("1003")
+    r = check.run()
+    assert not r.healthy and "3 counter error" in r.message
+    time.sleep(0.35)
+    assert check.run().healthy  # window expired
+    f1.write_text("5")  # counter reset (driver reload) -> re-baseline
+    assert check.run().healthy
+
+
+# -- node-health daemon ------------------------------------------------------
+
+
+class _FakeDaemon:
+    def __init__(self, reply):
+        self.reply = reply
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._t = threading.Thread(target=self._serve, daemon=True)
+        self._t.start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            with conn:
+                conn.recv(4096)
+                conn.sendall(json.dumps(self.reply).encode() + b"\n")
+
+    def close(self):
+        self.sock.close()
+
+
+def test_daemon_healthy_and_unhealthy():
+    d = _FakeDaemon({"healthy": True})
+    try:
+        assert NodeHealthDaemonCheck(f"127.0.0.1:{d.port}").run().healthy
+        d.reply = {"healthy": False, "reason": "ICI link flap storm"}
+        r = NodeHealthDaemonCheck(f"127.0.0.1:{d.port}").run()
+        assert not r.healthy and "ICI link flap" in r.message
+    finally:
+        d.close()
+
+
+def test_daemon_malformed_endpoint_honors_required():
+    # 'unix:/x' (single slash) and 'myhost' (no port) are config mistakes,
+    # not node failures: they must not exclude nodes when the daemon is
+    # optional
+    r = NodeHealthDaemonCheck("unix:/run/health.sock").run()
+    assert r.healthy and "bad health daemon endpoint" in r.message
+    assert NodeHealthDaemonCheck("myhost").run().healthy
+    assert not NodeHealthDaemonCheck("myhost", required=True).run().healthy
+
+
+def test_daemon_optional_vs_required(monkeypatch):
+    monkeypatch.delenv("TPURX_NODE_HEALTH_ENDPOINT", raising=False)
+    assert NodeHealthDaemonCheck().run().healthy  # unconfigured -> skip
+    assert not NodeHealthDaemonCheck(required=True).run().healthy
+    # unreachable endpoint: degraded observability unless required
+    assert NodeHealthDaemonCheck("127.0.0.1:1", timeout=0.5).run().healthy
+    assert not NodeHealthDaemonCheck(
+        "127.0.0.1:1", timeout=0.5, required=True
+    ).run().healthy
+
+
+# -- distributed storage -----------------------------------------------------
+
+
+def test_distributed_storage_gathers(store, store_server, tmp_path):
+    from tpu_resiliency.store import StoreClient
+
+    path = str(tmp_path / "shared_ckpt")
+    other = StoreClient("127.0.0.1", store_server.port)
+
+    def rank1():
+        DistributedStorageHealthCheck(
+            other, rank=1, world=2, path=path, gather_timeout=10.0
+        ).run()
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    r = DistributedStorageHealthCheck(
+        store, rank=0, world=2, path=path, gather_timeout=10.0
+    ).run()
+    t.join()
+    other.close()
+    assert r.healthy and "all 2 rank" in r.message
+
+
+def test_distributed_storage_reports_missing_rank(store, tmp_path):
+    r = DistributedStorageHealthCheck(
+        store, rank=0, world=2, path=str(tmp_path / "p"), gather_timeout=0.5
+    ).run()
+    assert not r.healthy and "no storage report from ranks [1]" in r.message
+
+
+# -- device probe stats ------------------------------------------------------
+
+
+def test_device_probe_judges_hbm_leak():
+    check = DeviceHealthCheck(max_idle_hbm_frac=0.5)
+    stats = [{"id": 0, "kind": "TPU v5", "platform": "tpu",
+              "bytes_in_use": 9 << 30, "bytes_limit": 16 << 30}]
+    r = check._judge_stats("TPURX_DEVICE_OK " + json.dumps(stats))
+    assert not r.healthy and "leaked grants" in r.message
+    stats[0]["bytes_in_use"] = 1 << 20
+    r = check._judge_stats("TPURX_DEVICE_OK " + json.dumps(stats))
+    assert r.healthy and "TPU v5" in r.message
+
+
+# -- factory -----------------------------------------------------------------
+
+
+def test_build_passive_checks_spec(tmp_path):
+    chain = build_passive_checks(
+        "node_resources,kernel_log",
+        kernel_log_source=str(tmp_path / "k.log"),
+    )
+    assert len(chain.checks) == 2
+    with pytest.raises(ValueError):
+        build_passive_checks("device")  # intrusive probe is not passive
+    # storage_path only materializes when a path is configured
+    assert len(build_passive_checks("storage_path").checks) == 0
+    assert len(
+        build_passive_checks("storage_path", storage_path=str(tmp_path)).checks
+    ) == 1
+
+
+# -- monitor-hosted health loop ---------------------------------------------
+
+
+def test_monitor_survives_bad_health_spec(tmp_path):
+    """A typo'd check spec must not take the watchdog (hang detection!) down."""
+    from tpu_resiliency.fault_tolerance import FaultToleranceConfig
+    from tpu_resiliency.fault_tolerance.rank_monitor_server import RankMonitorServer
+
+    cfg = FaultToleranceConfig(
+        workload_check_interval=0.1,
+        monitor_health_check_interval=0.1,
+        monitor_health_checks="kernel-log",  # typo: dash, not underscore
+    )
+    sock_path = str(tmp_path / "monitor.sock")
+    proc, ctrl = RankMonitorServer.run_in_subprocess(cfg, sock_path)
+    try:
+        time.sleep(0.5)
+        assert proc.is_alive()  # watchdog survived the bad spec
+    finally:
+        ctrl.send({"cmd": "shutdown"})
+        proc.join(timeout=5)
+        if proc.is_alive():
+            proc.terminate()
+
+
+def test_monitor_emits_health_failure_event(tmp_path):
+    from tpu_resiliency.fault_tolerance import FaultToleranceConfig
+    from tpu_resiliency.fault_tolerance.rank_monitor_server import RankMonitorServer
+
+    klog = tmp_path / "kern.log"
+    klog.write_text("")
+    cfg = FaultToleranceConfig(
+        workload_check_interval=0.1,
+        monitor_health_check_interval=0.1,
+        monitor_health_checks="kernel_log",
+        monitor_health_kernel_log=str(klog),
+    )
+    sock_path = str(tmp_path / "monitor.sock")
+    proc, ctrl = RankMonitorServer.run_in_subprocess(cfg, sock_path)
+    try:
+        time.sleep(0.4)  # a few healthy iterations first
+        assert not ctrl.poll(0)
+        with open(klog, "a") as f:
+            f.write("accel accel0: hardware fault, link down\n")
+        deadline = time.monotonic() + 10
+        evt = None
+        while time.monotonic() < deadline:
+            if ctrl.poll(0.1):
+                evt = ctrl.recv()
+                break
+        assert evt is not None, "no health event from monitor"
+        assert evt["event"] == "health_failure"
+        assert "hardware fault" in evt["message"]
+    finally:
+        ctrl.send({"cmd": "shutdown"})
+        proc.join(timeout=5)
+        if proc.is_alive():
+            proc.terminate()

@@ -144,3 +144,71 @@ def test_two_nodes_crash_restart_native_store(tmp_path):
     assert a.returncode == 0 and b.returncode == 0
     assert int((tmp_path / "progress.txt").read_text()) == 12
     assert "hosting native C++ store" in out_a
+
+
+def test_monitor_health_failure_excludes_node_midcycle(tmp_path):
+    """A node's rank-monitor health loop trips mid-cycle (injected kernel-log
+    fault); the launcher excludes the node WITHOUT waiting for a worker
+    failure or the pre-join gate, a spare takes its place, and the job
+    completes.  Reference: watchdog-hosted health loops feeding node
+    exclusion (``rank_monitor_server.py:122``)."""
+    port = free_port()
+    iters = 60
+    env = base_env(tmp_path, iters=iters)
+    env["TOY_STEP_TIME"] = "0.1"  # ~6s cycle: room to trip health mid-cycle
+    klog = tmp_path / "nodeB_kern.log"
+    klog.write_text("")
+    env_b = dict(env)
+    env_b.update(
+        {
+            "TPURX_FT_MONITOR_HEALTH_CHECK_INTERVAL": "0.2",
+            "TPURX_FT_MONITOR_HEALTH_CHECKS": "kernel_log",
+            "TPURX_FT_MONITOR_HEALTH_KERNEL_LOG": str(klog),
+        }
+    )
+    procs = {}
+    procs["A"] = subprocess.Popen(
+        launcher_cmd(port, "2:2", "nodeA", host_store=True),
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(0.5)
+    procs["B"] = subprocess.Popen(
+        launcher_cmd(port, "2:2", "nodeB"),
+        cwd=str(REPO), env=env_b, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # B must join before C so B is a participant and C the hot spare
+    time.sleep(1.0)
+    procs["C"] = subprocess.Popen(
+        launcher_cmd(port, "2:2", "nodeC"),
+        cwd=str(REPO), env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    # Inject the hardware fault only once cycle 0 is provably running (the
+    # kernel-log check correctly baselines past anything written before the
+    # monitor started — injecting earlier would be silently ignored).
+    prog = tmp_path / "progress.txt"
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        try:
+            if int(prog.read_text() or "0") >= 5:
+                break
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.2)
+    else:
+        raise AssertionError("cycle 0 never made progress")
+    with open(klog, "a") as f:
+        f.write("accel accel0: fatal hardware fault, chip reset\n")
+    outs = {}
+    for name, p in procs.items():
+        try:
+            outs[name], _ = p.communicate(timeout=150)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            outs[name], _ = p.communicate()
+    if procs["A"].returncode != 0 or procs["C"].returncode != 0:
+        for name in outs:
+            print(f"=== {name} ===\n", outs[name][-4000:])
+    assert procs["A"].returncode == 0
+    assert procs["C"].returncode == 0
+    assert "excluding this node" in outs["B"]
+    assert int((tmp_path / "progress.txt").read_text()) == iters

@@ -49,6 +49,15 @@ class FaultToleranceConfig:
     enable_device_health_check: bool = True
     enable_storage_health_check: bool = False
     storage_health_check_path: Optional[str] = None
+    # --- monitor-hosted periodic health loop (passive checks only;
+    #     reference hosts GPU/NIC loops in the watchdog,
+    #     rank_monitor_server.py:122) ---
+    monitor_health_check_interval: float = 0.0  # seconds; 0 disables
+    monitor_health_checks: str = (
+        "node_resources,nic_link,tpu_sys,kernel_log,counter_window,node_daemon"
+    )
+    # kernel log source override: "auto" | "kmsg" | "dmesg" | a file path
+    monitor_health_kernel_log: Optional[str] = None
     # --- progress tracking ---
     enable_progress_tracking: bool = True
     progress_iteration_file: Optional[str] = None

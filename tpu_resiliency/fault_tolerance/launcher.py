@@ -174,9 +174,18 @@ class ElasticAgent:
     def setup_rank_monitors_early(self) -> None:
         """Fork monitor processes before any threads exist (reference
         constraint, ``launcher.py:703-759``)."""
+        if self.cfg.monitor_health_check_interval > 0:
+            # fail fast on a bad spec HERE — inside the monitor it could only
+            # be logged, and a typo would silently disable the health loop
+            from ..health import build_passive_checks
+
+            build_passive_checks(self.cfg.monitor_health_checks)
         for lr in range(self.spec.nproc_per_node):
             sock = os.path.join(self._run_dir, f"monitor_{lr}.sock")
-            proc, ctrl = RankMonitorServer.run_in_subprocess(self.cfg, sock)
+            # the node-scope health loop runs in exactly one monitor per host
+            proc, ctrl = RankMonitorServer.run_in_subprocess(
+                self.cfg, sock, host_health_loop=(lr == 0)
+            )
             self.monitors.append((proc, ctrl, sock))
 
     def _setup_store(self) -> None:
@@ -430,9 +439,38 @@ class ElasticAgent:
                 self._stop_workers()
                 return "shutdown"
 
+    def _poll_monitor_events(self) -> None:
+        """Drain health events the rank-monitor watchdogs push over their
+        control pipes.  Polled at the top of every monitor tick so a node
+        health failure turns into exclusion BEFORE a possibly-coincident
+        worker failure turns into a plain restart (restarting on a sick node
+        just fails again)."""
+        for _, ctrl, _ in self.monitors:
+            try:
+                while ctrl.poll(0):
+                    evt = ctrl.recv()
+                    if not isinstance(evt, dict):
+                        continue
+                    if evt.get("event") == "health_failure":
+                        log.error(
+                            "monitor reported node health failure (%s): %s — "
+                            "excluding this node",
+                            evt.get("check"), evt.get("message"),
+                        )
+                        record_event(
+                            ProfilingEvent.NODE_EXCLUDE_REQUESTED,
+                            node=self.node_id,
+                            check=evt.get("check"),
+                            message=evt.get("message"),
+                        )
+                        self._pending_exclude = True
+            except (EOFError, OSError):
+                continue
+
     def _monitor_tick(self, result: RendezvousResult) -> str:
         while True:
             time.sleep(self.spec.monitor_interval)
+            self._poll_monitor_events()
             if self._pending_shutdown:
                 log.warning("shutting down workload: %s", self._pending_shutdown)
                 self.store.set(K_SHUTDOWN, self._pending_shutdown)

@@ -79,10 +79,15 @@ class RankMonitorServer:
         socket_path: str,
         ctrl_conn=None,
         kill_fn: Optional[Callable[[int, str], None]] = None,
+        host_health_loop: bool = True,
     ):
         self.cfg = cfg
         self.socket_path = socket_path
         self.ctrl_conn = ctrl_conn
+        # the health loop is NODE-scope: on multi-worker hosts only one of
+        # the per-rank monitors should run it (duplicated dmesg/daemon/sysfs
+        # sweeps and duplicate failure events otherwise)
+        self.host_health_loop = host_health_loop
         self._kill_fn = kill_fn or self._default_kill
         self.hb_timeouts = HeartbeatTimeouts(
             initial=cfg.initial_rank_heartbeat_timeout,
@@ -162,6 +167,68 @@ class RankMonitorServer:
             reason = self._check_timeouts()
             if reason is not None:
                 self._shutdown_rank(reason)
+
+    async def _periodic_health(self) -> None:
+        """Monitor-hosted node health loop (reference hosts GPU/NIC check
+        loops inside the watchdog, ``rank_monitor_server.py:122``).  Runs
+        only PASSIVE checks — the watchdog must never initialize the TPU
+        runtime beside its worker — and reports failures to the launcher
+        over the control pipe, which excludes the node mid-cycle instead of
+        waiting for the pre-join gate."""
+        from ..health import build_passive_checks
+
+        try:
+            chain = build_passive_checks(
+                self.cfg.monitor_health_checks,
+                kernel_log_source=self.cfg.monitor_health_kernel_log,
+                storage_path=(
+                    self.cfg.storage_health_check_path
+                    if self.cfg.enable_storage_health_check
+                    else None
+                ),
+            )
+        except ValueError:
+            # a bad check spec must not take the whole watchdog down with it
+            # (hang detection matters more than the health loop); the spec is
+            # also validated launcher-side so this is double-walled
+            log.exception("invalid monitor_health_checks; health loop disabled")
+            return
+        log.info(
+            "monitor health loop enabled: every %.1fs, checks=%s",
+            self.cfg.monitor_health_check_interval, self.cfg.monitor_health_checks,
+        )
+        loop = asyncio.get_running_loop()
+        was_healthy = True
+        while True:
+            await asyncio.sleep(self.cfg.monitor_health_check_interval)
+            # run_in_executor: a wedged probe (hung mount, stuck dmesg) must
+            # not stall heartbeat timeout checks on the event loop
+            result = await loop.run_in_executor(None, chain.run)
+            if result.healthy:
+                was_healthy = True
+                continue
+            if not was_healthy:
+                continue  # edge-trigger: one report per failure episode
+            was_healthy = False
+            log.error(
+                "node health failure (check=%s): %s", result.name, result.message
+            )
+            record_event(
+                ProfilingEvent.HEALTH_FAILURE,
+                check=result.name, message=result.message, cycle=self.cycle,
+            )
+            if self.ctrl_conn is not None:
+                try:
+                    self.ctrl_conn.send(
+                        {
+                            "event": "health_failure",
+                            "check": result.name,
+                            "message": result.message,
+                            "cycle": self.cycle,
+                        }
+                    )
+                except (OSError, BrokenPipeError):
+                    pass
 
     # -- message handling --------------------------------------------------
 
@@ -290,6 +357,8 @@ class RankMonitorServer:
         if started_evt is not None:
             started_evt.set()
         tasks = [asyncio.create_task(self._periodic_check())]
+        if self.cfg.monitor_health_check_interval > 0 and self.host_health_loop:
+            tasks.append(asyncio.create_task(self._periodic_health()))
         if self.ctrl_conn is not None:
             tasks.append(asyncio.create_task(self._poll_ctrl()))
         try:
@@ -302,9 +371,10 @@ class RankMonitorServer:
                 t.cancel()
 
     @classmethod
-    def _proc_main(cls, cfg, socket_path, ctrl_conn, started_evt) -> None:
+    def _proc_main(cls, cfg, socket_path, ctrl_conn, started_evt,
+                   host_health_loop=True) -> None:
         setup_logger()
-        server = cls(cfg, socket_path, ctrl_conn)
+        server = cls(cfg, socket_path, ctrl_conn, host_health_loop=host_health_loop)
         try:
             asyncio.run(server.run_async(started_evt))
         except KeyboardInterrupt:
@@ -312,7 +382,8 @@ class RankMonitorServer:
 
     @classmethod
     def run_in_subprocess(
-        cls, cfg: FaultToleranceConfig, socket_path: str, mp_ctx=None
+        cls, cfg: FaultToleranceConfig, socket_path: str, mp_ctx=None,
+        host_health_loop: bool = True,
     ) -> tuple[mp.Process, Any]:
         """Fork the monitor process; returns (process, control_conn).
 
@@ -324,7 +395,7 @@ class RankMonitorServer:
         started_evt = ctx.Event()
         proc = ctx.Process(
             target=cls._proc_main,
-            args=(cfg, socket_path, child_conn, started_evt),
+            args=(cfg, socket_path, child_conn, started_evt, host_health_loop),
             name=f"tpurx-rank-monitor:{os.path.basename(socket_path)}",
             daemon=True,
         )

@@ -1,17 +1,80 @@
 """Node/device/storage health checks (reference: ``shared_utils/health_check.py``).
 
-TPU re-design of the reference's check suite: NVML GPU-recovery-action and
-NVLink checks become a device probe that must NOT touch JAX in-process (a
-launcher that initializes the TPU would steal the chips from its workers —
-the probe runs in a short-lived subprocess instead); IB ``link_downed``
-counters become generic NIC link-state reads under ``/sys/class/net``;
-Lustre/NFS storage probes keep their shape (timed write/read/delete).
+TPU re-design of the reference's check suite, split by intrusiveness:
+
+- **Passive checks** never touch the TPU runtime, so the rank-monitor
+  watchdog can run them periodically while a worker owns the chips: accel
+  sysfs presence (``tpu.py``), host resources + NIC link state (``node.py``),
+  kernel-ring fault scan (``kmsg.py``), windowed error counters
+  (``window.py``), external node-health daemon (``daemon.py``), storage path
+  probes (``storage.py``).
+- **The intrusive runtime probe** (``device.py``) initializes JAX in a
+  subprocess and runs one op — it would steal the chips from a live worker,
+  so it is reserved for the pre-rendezvous gate when the chips are free.
+  (The reference can run NVML checks beside a live job because NVML is a
+  side channel; the TPU runtime has no equivalent, hence the split.)
 """
 
+from typing import Optional
+
 from .base import ChainedHealthCheck, HealthCheck, HealthCheckResult
+from .daemon import NodeHealthDaemonCheck
 from .device import DeviceHealthCheck
+from .kmsg import KernelLogHealthCheck
 from .node import NicLinkHealthCheck, NodeResourceHealthCheck
-from .storage import StoragePathHealthCheck
+from .storage import DistributedStorageHealthCheck, StoragePathHealthCheck
+from .tpu import TpuSysHealthCheck
+from .window import CounterDeltaWindowCheck, WindowedErrorCounter
+
+#: checks safe to run beside a live worker (no TPU runtime init)
+PASSIVE_CHECKS = (
+    "node_resources",
+    "nic_link",
+    "tpu_sys",
+    "kernel_log",
+    "counter_window",
+    "node_daemon",
+    "storage_path",
+)
+
+
+def build_passive_checks(
+    spec: str,
+    kernel_log_source: Optional[str] = None,
+    storage_path: Optional[str] = None,
+) -> ChainedHealthCheck:
+    """Build the monitor-hosted passive chain from a comma-separated spec.
+
+    Instances persist across runs (callers keep the chain), which is what the
+    windowed checks need: baselines and sliding windows live in the check.
+    """
+    checks: list[HealthCheck] = []
+    for name in (s.strip() for s in spec.split(",")):
+        if not name:
+            continue
+        if name == "node_resources":
+            checks.append(NodeResourceHealthCheck())
+        elif name == "nic_link":
+            checks.append(NicLinkHealthCheck())
+        elif name == "tpu_sys":
+            checks.append(TpuSysHealthCheck())
+        elif name == "kernel_log":
+            checks.append(KernelLogHealthCheck(source=kernel_log_source or "auto"))
+        elif name == "counter_window":
+            checks.append(CounterDeltaWindowCheck())
+        elif name == "node_daemon":
+            checks.append(NodeHealthDaemonCheck())
+        elif name == "storage_path":
+            if storage_path:
+                checks.append(StoragePathHealthCheck(storage_path))
+        else:
+            raise ValueError(
+                f"unknown passive health check {name!r} (known: {PASSIVE_CHECKS})"
+            )
+    # fail_fast=False: aggregate every failing probe — "which checks failed"
+    # is the signal the exclusion decision and attribution want
+    return ChainedHealthCheck(checks, fail_fast=False)
+
 
 __all__ = [
     "HealthCheck",
@@ -21,4 +84,12 @@ __all__ = [
     "NodeResourceHealthCheck",
     "NicLinkHealthCheck",
     "StoragePathHealthCheck",
+    "DistributedStorageHealthCheck",
+    "TpuSysHealthCheck",
+    "KernelLogHealthCheck",
+    "CounterDeltaWindowCheck",
+    "WindowedErrorCounter",
+    "NodeHealthDaemonCheck",
+    "PASSIVE_CHECKS",
+    "build_passive_checks",
 ]

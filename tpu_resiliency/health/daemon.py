@@ -1,0 +1,99 @@
+"""External node-health daemon client.
+
+Reference analog: ``NodeHealthCheck`` (``shared_utils/health_check.py:1418``)
+— a gRPC client to a cluster-provided per-node health daemon; the check
+resolves the channel target, queries node status, and treats daemon-reported
+degradation as node failure.
+
+TPU fleets run node-problem-detector-style daemons too; this client speaks
+newline-delimited JSON over a unix socket or TCP (no gRPC dependency):
+
+    -> {"query": "node_health"}
+    <- {"healthy": true/false, "reason": "...", ...}
+
+Endpoint resolution order: constructor arg, ``TPURX_NODE_HEALTH_ENDPOINT``
+env (``unix:///run/health.sock`` or ``host:port``).  Without an endpoint the
+check passes with a note (the daemon is optional infrastructure), unless
+``required=True``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from typing import Optional
+
+from .base import HealthCheck, HealthCheckResult
+
+ENDPOINT_ENV = "TPURX_NODE_HEALTH_ENDPOINT"
+
+
+class NodeHealthDaemonCheck(HealthCheck):
+    name = "node_daemon"
+
+    def __init__(
+        self,
+        endpoint: Optional[str] = None,
+        timeout: float = 5.0,
+        required: bool = False,
+    ):
+        self.endpoint = endpoint
+        self.timeout = timeout
+        self.required = required
+
+    def _resolve(self) -> Optional[str]:
+        return self.endpoint or os.environ.get(ENDPOINT_ENV) or None
+
+    def _connect(self, target: str) -> socket.socket:
+        if target.startswith("unix://"):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self.timeout)
+            sock.connect(target[len("unix://"):])
+            return sock
+        host, _, port = target.rpartition(":")
+        sock = socket.create_connection((host or "127.0.0.1", int(port)),
+                                        timeout=self.timeout)
+        return sock
+
+    def _check(self) -> HealthCheckResult:
+        target = self._resolve()
+        if not target:
+            if self.required:
+                return HealthCheckResult(False, "no node-health daemon endpoint")
+            return HealthCheckResult(True, "no node-health daemon configured (skipped)")
+        try:
+            sock = self._connect(target)
+        except ValueError:
+            # malformed endpoint ('unix:/x', missing port): a config mistake,
+            # reported under the same required semantics as unreachability —
+            # it must not exclude nodes when the daemon is optional
+            return HealthCheckResult(
+                not self.required, f"bad health daemon endpoint {target!r}"
+            )
+        except OSError as exc:
+            # unreachable daemon: the reference treats this as a failed check
+            # only when required; otherwise degraded observability, not a
+            # node failure
+            msg = f"health daemon {target} unreachable: {exc}"
+            return HealthCheckResult(not self.required, msg)
+        try:
+            sock.sendall(json.dumps({"query": "node_health"}).encode() + b"\n")
+            buf = b""
+            while b"\n" not in buf and len(buf) < 1 << 16:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    break
+                buf += chunk
+            reply = json.loads(buf.split(b"\n", 1)[0].decode())
+        except (OSError, ValueError) as exc:
+            return HealthCheckResult(
+                not self.required, f"health daemon {target} bad reply: {exc}"
+            )
+        finally:
+            sock.close()
+        if reply.get("healthy", False):
+            return HealthCheckResult(True, f"daemon: healthy ({target})")
+        return HealthCheckResult(
+            False, f"daemon reports unhealthy: {reply.get('reason', 'unspecified')}"
+        )

@@ -23,6 +23,7 @@ from typing import Optional
 from .base import HealthCheck, HealthCheckResult
 
 _PROBE_CODE = r"""
+import json
 import os
 os.environ.setdefault("TPU_PROCESS_BOUNDS", "")
 import jax
@@ -32,7 +33,20 @@ import jax.numpy as jnp
 x = jnp.ones((8, 8))
 y = (x @ x).sum()
 assert float(y) == 512.0, float(y)
-print("TPURX_DEVICE_OK", len(devs))
+stats = []
+for d in devs:
+    try:
+        ms = d.memory_stats() or {}
+    except Exception:
+        ms = {}
+    stats.append({
+        "id": d.id,
+        "kind": getattr(d, "device_kind", "?"),
+        "platform": getattr(d, "platform", "?"),
+        "bytes_in_use": ms.get("bytes_in_use"),
+        "bytes_limit": ms.get("bytes_limit"),
+    })
+print("TPURX_DEVICE_OK", json.dumps(stats))
 """
 
 
@@ -41,10 +55,22 @@ class DeviceHealthCheck(HealthCheck):
 
     _cache: Optional[tuple[float, HealthCheckResult]] = None
 
-    def __init__(self, timeout: float = 120.0, cache_ttl: float = 300.0, env=None):
+    def __init__(
+        self,
+        timeout: float = 120.0,
+        cache_ttl: float = 300.0,
+        env=None,
+        max_idle_hbm_frac: Optional[float] = None,
+    ):
         self.timeout = timeout
         self.cache_ttl = cache_ttl
         self.env = env
+        # The probe is a FRESH runtime client, so high bytes_in_use at probe
+        # time means grants leaked by dead processes are still pinned in HBM
+        # (the TPU analog of the reference's "GPU memory not reclaimed" gate,
+        # which the launcher polls before respawn).  None disables the gate.
+        self.max_idle_hbm_frac = max_idle_hbm_frac
+        self.last_stats: list = []
 
     def _check(self) -> HealthCheckResult:
         cached = type(self)._cache
@@ -66,8 +92,7 @@ class DeviceHealthCheck(HealthCheck):
             type(self)._cache = (time.monotonic(), result)
             return result
         if out.returncode == 0 and "TPURX_DEVICE_OK" in out.stdout:
-            n = out.stdout.strip().rsplit(" ", 1)[-1]
-            result = HealthCheckResult(True, f"{n} device(s) healthy")
+            result = self._judge_stats(out.stdout)
         else:
             tail = (out.stderr or out.stdout).strip().splitlines()[-3:]
             result = HealthCheckResult(
@@ -75,6 +100,31 @@ class DeviceHealthCheck(HealthCheck):
             )
         type(self)._cache = (time.monotonic(), result)
         return result
+
+    def _judge_stats(self, stdout: str) -> HealthCheckResult:
+        import json
+
+        line = next(
+            (l for l in stdout.splitlines() if l.startswith("TPURX_DEVICE_OK")), ""
+        )
+        raw = line.partition(" ")[2].strip()
+        try:
+            stats = json.loads(raw) if raw.startswith("[") else []
+        except ValueError:
+            stats = []
+        self.last_stats = stats
+        n = len(stats) or raw or "?"
+        if self.max_idle_hbm_frac is not None:
+            for d in stats:
+                used, limit = d.get("bytes_in_use"), d.get("bytes_limit")
+                if used and limit and used / limit > self.max_idle_hbm_frac:
+                    return HealthCheckResult(
+                        False,
+                        f"device {d['id']} HBM {used / limit:.0%} in use at idle "
+                        f"(leaked grants?)",
+                    )
+        kinds = {d.get("kind") for d in stats} or {"?"}
+        return HealthCheckResult(True, f"{n} device(s) healthy ({', '.join(map(str, kinds))})")
 
     @classmethod
     def clear_cache(cls) -> None:

@@ -1,0 +1,213 @@
+"""Kernel log scanning for hardware fault signatures.
+
+Reference analog: the reference's checks read NVML/IB error state directly;
+on TPU hosts the richest passive fault feed is the kernel ring buffer —
+accel-driver resets, PCIe AER storms, ECC/MCE events, and NIC link flaps all
+land there before (or instead of) surfacing anywhere else.
+
+Design: tail the log incrementally (baseline at attach — history from before
+the monitor started must not fail a healthy node), match fault patterns on
+NEW lines only, and judge matches over a sliding window via
+:class:`tpu_resiliency.health.window.WindowedErrorCounter`.
+
+Sources, in preference order when ``source='auto'``:
+  1. ``/dev/kmsg`` — a persistent non-blocking fd; each read drains only new
+     records (exactly the incremental semantics wanted).
+  2. a log file path (``/var/log/kern.log``) — byte-offset tracking.
+  3. the ``dmesg`` CLI — full snapshots; new lines found by remembering the
+     last seen kernel timestamp.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+from typing import List, Optional, Pattern, Sequence
+
+from .base import HealthCheck, HealthCheckResult
+from .window import WindowedErrorCounter
+
+DEFAULT_FAULT_PATTERNS: Sequence[str] = (
+    r"accel.*(?:error|fault|timeout|reset)",
+    r"tpu.*(?:error|fault|timeout|reset)",
+    r"(?:pcieport|AER).*(?:error|failed)",
+    r"Machine Check",
+    r"\bMCE\b",
+    r"ECC (?:error|warning)",
+    r"EDAC .*(?:CE|UE)",
+    r"Link (?:is )?[Dd]own",
+    r"I/O error",
+    r"(?:EXT4|XFS|NFS|FUSE)[^\n]*error",
+    r"Out of memory: Killed",
+    r"hung_task",
+)
+
+
+class KernelLogHealthCheck(HealthCheck):
+    """Windowed fault-pattern scan over new kernel log lines."""
+
+    name = "kernel_log"
+
+    def __init__(
+        self,
+        source: str = "auto",
+        patterns: Optional[Sequence[str]] = None,
+        window_s: float = 600.0,
+        threshold: int = 1,
+        max_bytes_per_scan: int = 1 << 20,
+    ):
+        self.source = source
+        self.patterns: List[Pattern[str]] = [
+            re.compile(p, re.IGNORECASE) for p in (patterns or DEFAULT_FAULT_PATTERNS)
+        ]
+        self.threshold = threshold
+        self.max_bytes = max_bytes_per_scan
+        self._window = WindowedErrorCounter(window_s)
+        self._kmsg_fd: Optional[int] = None
+        self._file_pos: Optional[int] = None
+        self._dmesg_last_ts: float = -1.0
+        self._dmesg_last_count: int = 0
+        self._mode: Optional[str] = None
+        self.last_matches: List[str] = []
+
+    # -- source attachment (lazy; baselines on first contact) ---------------
+
+    def _attach(self) -> str:
+        if self._mode is not None:
+            return self._mode
+        if self.source == "auto" or self.source == "kmsg":
+            try:
+                fd = os.open("/dev/kmsg", os.O_RDONLY | os.O_NONBLOCK)
+                # baseline: seek to the end so history never counts
+                os.lseek(fd, 0, os.SEEK_END)
+                self._kmsg_fd = fd
+                self._mode = "kmsg"
+                return self._mode
+            except OSError:
+                if self.source == "kmsg":
+                    self._mode = "none"
+                    return self._mode
+        if self.source not in ("auto", "kmsg", "dmesg"):
+            # an explicit file path
+            self._mode = "file"
+            try:
+                self._file_pos = os.path.getsize(self.source)
+            except OSError:
+                self._file_pos = 0
+            return self._mode
+        if self.source in ("auto", "dmesg"):
+            try:
+                out = self._run_dmesg()
+                self._dmesg_last_ts = self._max_ts(out)
+                # timestamp-less output (printk.time=0, busybox): fall back
+                # to line-count tracking so history is still baselined
+                self._dmesg_last_count = len(out.splitlines())
+                self._mode = "dmesg"
+                return self._mode
+            except (OSError, subprocess.SubprocessError):
+                pass
+        self._mode = "none"
+        return self._mode
+
+    @staticmethod
+    def _run_dmesg() -> str:
+        return subprocess.run(
+            ["dmesg"], capture_output=True, text=True, timeout=10, check=True
+        ).stdout
+
+    _TS_RE = re.compile(r"^[<\[]?(?:\d+[>\]]?,?\d*,?)?\[?\s*(\d+\.\d+)\]")
+
+    @classmethod
+    def _max_ts(cls, text: str) -> float:
+        best = -1.0
+        for line in text.splitlines():
+            m = cls._TS_RE.match(line)
+            if m:
+                best = max(best, float(m.group(1)))
+        return best
+
+    # -- incremental reads --------------------------------------------------
+
+    def _new_lines(self) -> List[str]:
+        mode = self._attach()
+        if mode == "kmsg":
+            lines: List[str] = []
+            assert self._kmsg_fd is not None
+            read = 0
+            while read < self.max_bytes:
+                try:
+                    rec = os.read(self._kmsg_fd, 8192)
+                except BlockingIOError:
+                    break
+                except OSError:
+                    break  # ring buffer overrun (EPIPE): skip to next scan
+                if not rec:
+                    break
+                read += len(rec)
+                # /dev/kmsg record: "pri,seq,usec,flags;message\n"
+                text = rec.decode(errors="replace")
+                lines.append(text.split(";", 1)[-1].strip())
+            return lines
+        if mode == "file":
+            try:
+                size = os.path.getsize(self.source)
+                if self._file_pos is None or size < self._file_pos:
+                    self._file_pos = 0  # rotation
+                if size == self._file_pos:
+                    return []
+                with open(self.source, "r", errors="replace") as f:
+                    f.seek(self._file_pos)
+                    chunk = f.read(self.max_bytes)
+                    self._file_pos = f.tell()
+                return chunk.splitlines()
+            except OSError:
+                return []
+        if mode == "dmesg":
+            try:
+                out = self._run_dmesg()
+            except (OSError, subprocess.SubprocessError):
+                return []
+            all_lines = out.splitlines()
+            if self._dmesg_last_ts < 0:
+                # no parseable timestamps: slice by line count (ring-buffer
+                # eviction makes this approximate, erring towards missing
+                # lines rather than re-counting history every scan)
+                fresh = all_lines[self._dmesg_last_count:]
+                self._dmesg_last_count = len(all_lines)
+                return fresh
+            fresh = []
+            for line in all_lines:
+                m = self._TS_RE.match(line)
+                if m and float(m.group(1)) <= self._dmesg_last_ts:
+                    continue
+                fresh.append(line)
+            self._dmesg_last_ts = max(self._dmesg_last_ts, self._max_ts(out))
+            return fresh
+        return []
+
+    def _check(self) -> HealthCheckResult:
+        lines = self._new_lines()
+        if self._mode == "none":
+            return HealthCheckResult(True, "no kernel log source available (skipped)")
+        self.last_matches = [
+            line for line in lines if any(p.search(line) for p in self.patterns)
+        ]
+        if self.last_matches:
+            self._window.record(len(self.last_matches))
+        total = self._window.count()
+        if total >= self.threshold:
+            sample = "; ".join(m[:160] for m in self.last_matches[:3])
+            return HealthCheckResult(
+                False,
+                f"{total} kernel fault line(s) in {self._window.window_s:.0f}s"
+                + (f": {sample}" if sample else ""),
+            )
+        return HealthCheckResult(True, f"{total} windowed fault line(s)")
+
+    def close(self) -> None:
+        if self._kmsg_fd is not None:
+            try:
+                os.close(self._kmsg_fd)
+            finally:
+                self._kmsg_fd = None

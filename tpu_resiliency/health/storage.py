@@ -48,3 +48,72 @@ class StoragePathHealthCheck(HealthCheck):
                 return HealthCheckResult(
                     False, f"storage probe on {self.path} hung (> {self.timeout}s)"
                 )
+
+
+class DistributedStorageHealthCheck(HealthCheck):
+    """All ranks probe the shared path; results are gathered through the KV
+    store so every rank (and the launcher) sees WHICH nodes lost the mount.
+
+    Reference analog: ``DistributedStorageHealthCheck``
+    (``shared_utils/health_check.py:1606-1732``) — Lustre health + per-node
+    storage checks aggregated across the job.  The TPU design replaces the
+    torch-distributed gather with the framework's own store: rank ``r`` sets
+    ``health/storage/<cycle>/<r>``, then reads its peers with a bounded wait.
+    """
+
+    name = "storage_distributed"
+
+    def __init__(
+        self,
+        store,
+        rank: int,
+        world: int,
+        path: str,
+        cycle: int = 0,
+        probe_timeout: float = 30.0,
+        gather_timeout: float = 60.0,
+    ):
+        self.store = store
+        self.rank = rank
+        self.world = world
+        self.path = path
+        self.cycle = cycle
+        self.probe_timeout = probe_timeout
+        self.gather_timeout = gather_timeout
+
+    def _key(self, rank: int) -> str:
+        return f"health/storage/{self.cycle}/{rank}"
+
+    def _check(self) -> HealthCheckResult:
+        import json as _json
+        import time as _time
+
+        local = StoragePathHealthCheck(self.path, timeout=self.probe_timeout).run()
+        self.store.set(
+            self._key(self.rank),
+            _json.dumps({"healthy": local.healthy, "message": local.message}),
+        )
+        deadline = _time.monotonic() + self.gather_timeout
+        missing = set(range(self.world)) - {self.rank}
+        bad = [] if local.healthy else [self.rank]
+        while missing and _time.monotonic() < deadline:
+            for r in sorted(missing):
+                raw = self.store.try_get(self._key(r))
+                if raw is not None:
+                    obj = _json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+                    if not obj["healthy"]:
+                        bad.append(r)
+                    missing.discard(r)
+            if missing:
+                _time.sleep(0.1)
+        if missing:
+            return HealthCheckResult(
+                False, f"no storage report from ranks {sorted(missing)}"
+            )
+        if bad:
+            return HealthCheckResult(
+                False, f"storage unhealthy on ranks {sorted(bad)}: {self.path}"
+            )
+        return HealthCheckResult(
+            True, f"storage healthy on all {self.world} rank(s)"
+        )

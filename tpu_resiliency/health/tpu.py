@@ -1,0 +1,82 @@
+"""TPU-deep host-side health checks.
+
+Reference analogs: ``GPUHealthCheck`` driver/recovery-action inspection
+(``shared_utils/health_check.py:253-447``) and the GB200 static topology
+mapping (``:115-199``).  TPUs expose no NVML; the host-visible surface is the
+accel driver's sysfs class (``/sys/class/accel/accel*`` on TPU VMs, one entry
+per chip) plus the device nodes (``/dev/accel*``).  These checks are
+**passive** — they never initialize the TPU runtime, so they are safe to run
+from the rank-monitor watchdog while a worker owns the chips (the intrusive
+runtime probe lives in :class:`tpu_resiliency.health.DeviceHealthCheck` and
+is reserved for the pre-rendezvous gate when the chips are free).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+from .base import HealthCheck, HealthCheckResult
+
+
+class TpuSysHealthCheck(HealthCheck):
+    """Presence + readability of the accel devices the host is supposed to
+    have.  Catches the "chip fell off the bus" / driver-wedge class of
+    failures (reference: NVML device-count and recovery-action queries,
+    ``health_check.py:352-447``) without touching the runtime.
+    """
+
+    name = "tpu_sys"
+
+    def __init__(
+        self,
+        sys_accel: str = "/sys/class/accel",
+        dev_glob: str = "/dev/accel*",
+        expected_chips: Optional[int] = None,
+        required: bool = False,
+    ):
+        self.sys_accel = sys_accel
+        self.dev_glob = dev_glob
+        # None -> learn the count on the first healthy observation; a later
+        # drop below the learned count fails (the windowed-baseline idea the
+        # reference applies to NIC link state, ``health_check.py:757``)
+        self.expected_chips = expected_chips
+        self._learned: Optional[int] = None
+        # required=False: hosts without an accel driver (CPU CI, dev boxes)
+        # pass with a note instead of failing every chain they appear in
+        self.required = required
+
+    def _list_chips(self) -> list[str]:
+        try:
+            sys_devs = sorted(
+                d for d in os.listdir(self.sys_accel) if d.startswith("accel")
+            )
+        except OSError:
+            sys_devs = []
+        dev_nodes = sorted(glob.glob(self.dev_glob))
+        # either surface is sufficient evidence of a chip; prefer sysfs names
+        return sys_devs or [os.path.basename(p) for p in dev_nodes]
+
+    def _check(self) -> HealthCheckResult:
+        chips = self._list_chips()
+        if not chips:
+            if self.required or self.expected_chips:
+                return HealthCheckResult(False, "no accel devices visible")
+            return HealthCheckResult(True, "no accel driver on this host (skipped)")
+        expected = self.expected_chips or self._learned
+        if expected is not None and len(chips) < expected:
+            return HealthCheckResult(
+                False, f"{len(chips)} accel device(s) visible, expected {expected}"
+            )
+        # unreadable sysfs entries indicate a wedged/unbound driver
+        unreadable = []
+        for chip in chips:
+            path = os.path.join(self.sys_accel, chip)
+            if os.path.isdir(path) and not os.access(path, os.R_OK):
+                unreadable.append(chip)
+        if unreadable:
+            return HealthCheckResult(False, f"unreadable accel sysfs: {unreadable}")
+        if self.expected_chips is None:
+            self._learned = max(self._learned or 0, len(chips))
+        return HealthCheckResult(True, f"{len(chips)} accel device(s) present")

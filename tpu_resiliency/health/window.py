@@ -1,0 +1,108 @@
+"""Sliding-window error counters.
+
+Reference analog: ``NVLinkWindowHealthCheck``
+(``shared_utils/health_check.py:995-1416``) — continuously sampled per-port
+NVLink error counters, judged over a sliding time window so a burst of link
+errors fails the node while ancient history does not.
+
+TPU hosts have no NVLink, but the same *shape* of signal exists wherever the
+kernel exports monotonically increasing error counters: NIC statistics
+(``/sys/class/net/*/statistics/{rx,tx}_errors``, ``carrier_changes`` — the
+DCN side of a pod), EDAC/ECC counters, and any accel-driver counter files an
+operator points the glob at.  :class:`CounterDeltaWindowCheck` samples the
+counters each run, converts increases into timestamped events, and fails when
+the windowed sum crosses the threshold.
+"""
+
+from __future__ import annotations
+
+import glob
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Sequence, Tuple
+
+from .base import HealthCheck, HealthCheckResult
+
+DEFAULT_COUNTER_GLOBS = (
+    "/sys/class/net/e*/statistics/rx_errors",
+    "/sys/class/net/e*/statistics/tx_errors",
+    "/sys/class/net/e*/carrier_changes",
+)
+
+
+class WindowedErrorCounter:
+    """Timestamped event accumulator over a sliding window."""
+
+    def __init__(self, window_s: float):
+        self.window_s = window_s
+        self._events: Deque[Tuple[float, int]] = deque()
+
+    def record(self, n: int = 1, now: Optional[float] = None) -> None:
+        if n > 0:
+            self._events.append((time.monotonic() if now is None else now, n))
+
+    def count(self, now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        while self._events and now - self._events[0][0] > self.window_s:
+            self._events.popleft()
+        return sum(n for _, n in self._events)
+
+
+class CounterDeltaWindowCheck(HealthCheck):
+    """Fail when monotonically increasing counter files grow by more than
+    ``threshold`` within ``window_s``.
+
+    The first observation of each file is its baseline (pre-existing error
+    totals — like the reference's NIC link-state baseline,
+    ``health_check.py:757`` — must not fail a freshly started monitor).
+    Counter resets (value decreasing, e.g. driver reload) re-baseline.
+    """
+
+    name = "counter_window"
+
+    def __init__(
+        self,
+        counter_globs: Sequence[str] = DEFAULT_COUNTER_GLOBS,
+        window_s: float = 600.0,
+        threshold: int = 1,
+    ):
+        self.counter_globs = list(counter_globs)
+        self.threshold = threshold
+        self._last: Dict[str, int] = {}
+        self._window = WindowedErrorCounter(window_s)
+        self._last_deltas: Dict[str, int] = {}
+
+    def _read(self, path: str) -> Optional[int]:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _check(self) -> HealthCheckResult:
+        now = time.monotonic()
+        self._last_deltas = {}
+        for pattern in self.counter_globs:
+            for path in glob.glob(pattern):
+                value = self._read(path)
+                if value is None:
+                    continue
+                prev = self._last.get(path)
+                self._last[path] = value
+                if prev is None or value < prev:
+                    continue  # baseline / counter reset
+                delta = value - prev
+                if delta:
+                    self._window.record(delta, now=now)
+                    self._last_deltas[path] = delta
+        total = self._window.count(now=now)
+        if total >= self.threshold:
+            worst = sorted(
+                self._last_deltas.items(), key=lambda kv: -kv[1]
+            )[:3]
+            return HealthCheckResult(
+                False,
+                f"{total} counter error(s) in {self._window.window_s:.0f}s window"
+                + (f"; recent: {worst}" if worst else ""),
+            )
+        return HealthCheckResult(True, f"{total} windowed error(s), below threshold")

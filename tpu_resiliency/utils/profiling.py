@@ -43,6 +43,8 @@ class ProfilingEvent(str, enum.Enum):
     # Health
     HEALTH_CHECK_STARTED = "health_check_started"
     HEALTH_CHECK_COMPLETED = "health_check_completed"
+    HEALTH_FAILURE = "health_failure"
+    NODE_EXCLUDE_REQUESTED = "node_exclude_requested"
 
 
 class ProfilingRecorder:
