@@ -194,3 +194,136 @@ def test_failover_store_client(store_server):
     c.set("k", b"v")
     assert c.get("k") == b"v"
     c.close()
+
+
+# -- on-disk journal ---------------------------------------------------------
+
+
+def _journal_server(tmp_path, **kw):
+    from tpu_resiliency.store import StoreServer
+
+    return StoreServer(
+        host="127.0.0.1", port=0, journal_path=str(tmp_path / "store.journal"), **kw
+    ).start_in_thread()
+
+
+def test_journal_restart_restores_state(tmp_path):
+    from tpu_resiliency.store import StoreClient
+
+    s1 = _journal_server(tmp_path)
+    c = StoreClient("127.0.0.1", s1.port)
+    c.set("rdzv/active_round", b"7")
+    c.set("rdzv/cycle", b"12")
+    c.add("counter", 5)
+    c.append("log", b"abc")
+    c.append("log", b"def")
+    c.compare_set("cas", b"", b"v1")
+    c.set("gone", b"x")
+    c.delete("gone")
+    c.close()
+    s1.stop()
+
+    s2 = _journal_server(tmp_path)
+    assert s2.replayed_keys == 5
+    c2 = StoreClient("127.0.0.1", s2.port)
+    assert c2.get("rdzv/active_round") == b"7"
+    assert c2.get("rdzv/cycle") == b"12"
+    assert c2.get("counter") == b"5"
+    assert c2.get("log") == b"abcdef"
+    assert c2.get("cas") == b"v1"
+    assert c2.try_get("gone") is None
+    # mutations continue journaling after a restart
+    assert c2.add("counter", 1) == 6
+    c2.close()
+    s2.stop()
+    s3 = _journal_server(tmp_path)
+    c3 = StoreClient("127.0.0.1", s3.port)
+    assert c3.get("counter") == b"6"
+    c3.close()
+    s3.stop()
+
+
+def test_journal_tolerates_torn_tail(tmp_path):
+    from tpu_resiliency.store import StoreClient
+
+    s1 = _journal_server(tmp_path)
+    c = StoreClient("127.0.0.1", s1.port)
+    c.set("good", b"kept")
+    c.close()
+    s1.stop()
+    # crash mid-append: a partial record at the tail
+    with open(tmp_path / "store.journal", "ab") as f:
+        f.write(b"S" + (123456).to_bytes(4, "little") + b"partial-key-then-noth")
+    s2 = _journal_server(tmp_path)
+    c2 = StoreClient("127.0.0.1", s2.port)
+    assert c2.get("good") == b"kept"
+    assert s2.replayed_keys == 1
+    # the torn tail was truncated: new writes land on a clean boundary
+    c2.set("after", b"crash")
+    c2.close()
+    s2.stop()
+    s3 = _journal_server(tmp_path)
+    c3 = StoreClient("127.0.0.1", s3.port)
+    assert c3.get("after") == b"crash" and c3.get("good") == b"kept"
+    c3.close()
+    s3.stop()
+
+
+def test_journal_compaction_bounds_size(tmp_path):
+    from tpu_resiliency.store import StoreClient
+
+    s1 = _journal_server(tmp_path, journal_max_bytes=4096)
+    c = StoreClient("127.0.0.1", s1.port)
+    for i in range(500):
+        c.set("hot", b"x" * 64 + str(i).encode())  # same key rewritten
+    c.close()
+    s1.stop()
+    size = (tmp_path / "store.journal").stat().st_size
+    assert size < 8192, size  # compacted: not 500 * ~80 bytes
+    s2 = _journal_server(tmp_path)
+    c2 = StoreClient("127.0.0.1", s2.port)
+    assert c2.get("hot").endswith(b"499")
+    c2.close()
+    s2.stop()
+
+
+def test_control_plane_restart_keeps_cycle_numbering(tmp_path):
+    """The VERDICT ask: a restarted control plane continues cycle numbers."""
+    from tpu_resiliency.fault_tolerance.rendezvous import (
+        K_CYCLE,
+        RendezvousHost,
+        k_done,
+    )
+    from tpu_resiliency.store import StoreClient
+
+    s1 = _journal_server(tmp_path)
+    c = StoreClient("127.0.0.1", s1.port)
+    host = RendezvousHost(c, min_nodes=1)
+    host.bootstrap()
+    host.open_round()   # round 0, cycle 0
+    assert int(c.get(K_CYCLE)) == 1
+    c.set(k_done(0), b"1")  # round 0 completed before the control plane died
+    c.close()
+    s1.stop()
+
+    # control plane restarts from the journal
+    s2 = _journal_server(tmp_path)
+    c2 = StoreClient("127.0.0.1", s2.port)
+    host2 = RendezvousHost(c2, min_nodes=1)
+    host2.bootstrap()  # must be a no-op on restored state
+    assert host2.current_round() == 0  # round pointer survived
+    n = host2.open_round()
+    assert n == 1       # advances past the completed round 0
+    assert int(c2.get(K_CYCLE)) == 2  # cycle numbering continued, no reset
+    c2.close()
+    s2.stop()
+
+    # a mid-round restart resumes the SAME open round (no spurious advance)
+    s3 = _journal_server(tmp_path)
+    c3 = StoreClient("127.0.0.1", s3.port)
+    host3 = RendezvousHost(c3, min_nodes=1)
+    host3.bootstrap()
+    assert host3.open_round() == 1  # round 1 still open: resume it
+    assert int(c3.get(K_CYCLE)) == 2
+    c3.close()
+    s3.stop()

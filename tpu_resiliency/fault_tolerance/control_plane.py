@@ -35,13 +35,30 @@ def run(
     round_timeout: float,
     settle_time: float,
     native: bool = False,
+    journal: str | None = None,
 ) -> int:
     if native:
+        if journal:
+            log.warning("--journal is not supported by the native store yet; ignored")
         from ..store.native import NativeStoreServer
 
         server = NativeStoreServer(host=host, port=port).start()
     else:
-        server = StoreServer(host=host, port=port).start_in_thread()
+        # rounds/cycle numbering must survive a control-plane restart, but
+        # job-terminal state must not: a replayed shutdown flag (+ acks)
+        # would terminate the next job, so it is stripped during replay —
+        # BEFORE the listener opens (an agent connecting in a post-listen
+        # cleanup window could read the stale flag and self-terminate)
+        server = StoreServer(
+            host=host, port=port, journal_path=journal,
+            journal_strip_prefixes=[K_SHUTDOWN.encode()],
+        ).start_in_thread()
+        if journal and server.replayed_keys:
+            log.info(
+                "control-plane state restored from %s (%d keys): cycle "
+                "numbering and rendezvous rounds continue",
+                journal, server.replayed_keys,
+            )
     client = StoreClient("127.0.0.1", server.port, timeout=round_timeout)
     rdzv = RendezvousHost(
         client, min_nodes=min_nodes, max_nodes=max_nodes, settle_time=settle_time
@@ -87,11 +104,16 @@ def main(argv=None) -> None:
         "--native-store", action="store_true",
         help="serve the KV store from the C++ epoll server",
     )
+    p.add_argument(
+        "--journal", default=None,
+        help="journal file: control-plane restarts keep cycle numbering",
+    )
     args = p.parse_args(argv)
     sys.exit(
         run(
             args.host, args.port, args.min_nodes, args.max_nodes,
             args.round_timeout, args.settle_time, native=args.native_store,
+            journal=args.journal,
         )
     )
 

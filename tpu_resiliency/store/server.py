@@ -30,9 +30,26 @@ _U32 = struct.Struct("<I")
 
 
 class StoreServer:
-    """In-memory KV store with blocking waits, served over TCP."""
+    """In-memory KV store with blocking waits, served over TCP.
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    With ``journal_path`` every mutation is also appended to an on-disk
+    journal (key-state records, crash-tolerant replay, periodic fsync,
+    snapshot compaction).  A restarted control plane re-hosting the store
+    from the same journal keeps all rendezvous state — cycle numbering,
+    round counters, learned timeouts — instead of starting the world from
+    zero (reference keeps this state inside the long-lived rendezvous host
+    process; our store host is restartable by design, hence the journal).
+    """
+
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        journal_path: Optional[str] = None,
+        journal_max_bytes: int = 64 << 20,
+        journal_fsync_interval: float = 1.0,
+        journal_strip_prefixes: Optional[List[bytes]] = None,
+    ):
         self.host = host
         self.port = port
         self._data: Dict[bytes, bytes] = {}
@@ -41,6 +58,150 @@ class StoreServer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        self.journal_path = journal_path
+        self.journal_max_bytes = journal_max_bytes
+        self.journal_fsync_interval = journal_fsync_interval
+        # keys matching these prefixes are dropped during replay, BEFORE the
+        # listener opens — terminal state from the previous job (shutdown
+        # flag + acks) must never be observable by a new job's agents
+        self.journal_strip_prefixes = journal_strip_prefixes or []
+        self._journal_file = None
+        self._journal_bytes = 0
+        self._journal_compact_at = journal_max_bytes
+        self._journal_dirty = False
+        self._fsync_task: Optional[asyncio.Task] = None
+        self.replayed_keys = 0
+
+    # -- journal -----------------------------------------------------------
+    # Record formats (final-state records; replay order reconstructs _data):
+    #   b"S" u32(klen) key u32(vlen) value     -- key set to value
+    #   b"D" u32(klen) key                     -- key deleted
+
+    def _open_journal(self) -> None:
+        if not self.journal_path:
+            return
+        good = 0
+        try:
+            with open(self.journal_path, "rb") as f:
+                buf = f.read()
+            good = self._replay(buf)
+        except OSError:
+            buf = b""
+        if good < len(buf):
+            log.warning(
+                "journal %s: truncated/garbled tail at byte %d of %d "
+                "(crash mid-write); discarding the tail",
+                self.journal_path, good, len(buf),
+            )
+        self.replayed_keys = len(self._data)
+        if self.replayed_keys:
+            log.info(
+                "journal %s: restored %d key(s)",
+                self.journal_path, self.replayed_keys,
+            )
+        self._journal_file = open(self.journal_path, "ab")
+        if good < len(buf):
+            self._journal_file.truncate(good)
+        self._journal_bytes = good
+        self._journal_compact_at = self.journal_max_bytes
+        for prefix in self.journal_strip_prefixes:
+            for key in [k for k in self._data if k.startswith(prefix)]:
+                del self._data[key]
+                self._journal_append(key, None)  # D record: stays stripped
+                self.replayed_keys -= 1
+
+    def _replay(self, buf: bytes) -> int:
+        """Apply journal records to ``_data``; returns the offset of the last
+        complete record (a crash mid-append leaves a partial tail)."""
+        i, n, good = 0, len(buf), 0
+        while i < n:
+            tag = buf[i:i + 1]
+            if tag == b"S":
+                if i + 5 > n:
+                    break
+                (kl,) = _U32.unpack_from(buf, i + 1)
+                if i + 5 + kl + 4 > n:
+                    break
+                key = buf[i + 5:i + 5 + kl]
+                (vl,) = _U32.unpack_from(buf, i + 5 + kl)
+                end = i + 9 + kl + vl
+                if end > n:
+                    break
+                self._data[key] = buf[i + 9 + kl:end]
+                i = end
+            elif tag == b"D":
+                if i + 5 > n:
+                    break
+                (kl,) = _U32.unpack_from(buf, i + 1)
+                end = i + 5 + kl
+                if end > n:
+                    break
+                self._data.pop(buf[i + 5:end], None)
+                i = end
+            else:
+                break
+            good = i
+        return good
+
+    @staticmethod
+    def _encode_record(key: bytes, value: Optional[bytes]) -> bytes:
+        if value is None:
+            return b"D" + _U32.pack(len(key)) + key
+        return b"S" + _U32.pack(len(key)) + key + _U32.pack(len(value)) + value
+
+    def _journal_append(self, key: bytes, value: Optional[bytes]) -> None:
+        if self._journal_file is None:
+            return
+        rec = self._encode_record(key, value)
+        try:
+            self._journal_file.write(rec)
+            self._journal_file.flush()
+        except OSError:
+            log.exception("journal write failed; disabling journal")
+            self._journal_file = None
+            return
+        self._journal_bytes += len(rec)
+        self._journal_dirty = True
+        if self._journal_bytes > self._journal_compact_at:
+            self._compact_journal()
+
+    def _compact_journal(self) -> None:
+        """Rewrite the journal as a snapshot of the live data (single-threaded
+        event loop: atomic with respect to requests)."""
+        tmp = self.journal_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                for key, value in self._data.items():
+                    f.write(self._encode_record(key, value))
+                f.flush()
+                os.fsync(f.fileno())
+            self._journal_file.close()
+            os.replace(tmp, self.journal_path)
+            self._journal_file = open(self.journal_path, "ab")
+            self._journal_bytes = os.path.getsize(self.journal_path)
+            # when the live snapshot itself exceeds the cap, compacting on
+            # every subsequent mutation would fsync O(total state) per SET on
+            # the event loop; re-arm only at 2x the snapshot size
+            self._journal_compact_at = max(
+                self.journal_max_bytes, 2 * self._journal_bytes
+            )
+            log.info(
+                "journal compacted to %d bytes (%d keys)",
+                self._journal_bytes, len(self._data),
+            )
+        except OSError:
+            log.exception("journal compaction failed; disabling journal")
+            self._journal_file = None
+
+    async def _fsync_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.journal_fsync_interval)
+            if self._journal_dirty and self._journal_file is not None:
+                self._journal_dirty = False
+                try:
+                    os.fsync(self._journal_file.fileno())
+                except (OSError, ValueError):
+                    pass
 
     # -- storage ops (run on the event loop; atomic wrt each other) --------
 
@@ -50,6 +211,7 @@ class StoreServer:
 
     def _set(self, key: bytes, value: bytes) -> None:
         self._data[key] = value
+        self._journal_append(key, value)
         self._notify(key)
 
     async def _wait_for_keys(self, keys: List[bytes], timeout_ms: int) -> Status:
@@ -111,7 +273,9 @@ class StoreServer:
             return encode_response(Status.OK, b"1" if ok else b"0")
         if op == Op.DELETE:
             existed = args[0] in data
-            data.pop(args[0], None)
+            if existed:
+                data.pop(args[0], None)
+                self._journal_append(args[0], None)
             return encode_response(Status.OK, b"1" if existed else b"0")
         if op == Op.NUM_KEYS:
             return encode_response(Status.OK, itob(len(data)))
@@ -189,8 +353,13 @@ class StoreServer:
 
     async def start_async(self) -> None:
         self._loop = asyncio.get_running_loop()
+        self._open_journal()  # replay BEFORE accepting connections
         self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if self._journal_file is not None:
+            # keep a strong reference: the loop's task set is weak, and a
+            # GC'd fsync task would silently stop flushing the page cache
+            self._fsync_task = self._loop.create_task(self._fsync_loop())
         self._started.set()
         log.info("store server listening on %s:%s", self.host, self.port)
 
@@ -227,19 +396,30 @@ class StoreServer:
                 pass
         if self._thread:
             self._thread.join(timeout=5)
+        if self._journal_file is not None:
+            try:
+                os.fsync(self._journal_file.fileno())
+                self._journal_file.close()
+            except (OSError, ValueError):
+                pass
+            self._journal_file = None
 
 
-def serve_forever(host: str, port: int) -> None:
-    asyncio.run(StoreServer(host, port).serve_async())
+def serve_forever(host: str, port: int, journal: Optional[str] = None) -> None:
+    asyncio.run(StoreServer(host, port, journal_path=journal).serve_async())
 
 
 def main() -> None:
     parser = argparse.ArgumentParser(description="tpurx KV store server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=29500)
+    parser.add_argument(
+        "--journal", default=None,
+        help="on-disk journal path: state survives a store restart",
+    )
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
-    serve_forever(args.host, args.port)
+    serve_forever(args.host, args.port, journal=args.journal)
 
 
 if __name__ == "__main__":
