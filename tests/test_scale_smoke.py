@@ -1,0 +1,23 @@
+"""Scale smoke: the control-plane protocol at 64 concurrent agents.
+
+The full 64/128/256 sweep lives in ``benchmarks/bench_control_plane.py``
+(results in ``docs/SCALE.md``); this keeps the 64-agent path green in CI.
+"""
+
+from benchmarks.bench_control_plane import (
+    bench_barrier,
+    bench_consensus,
+    bench_rendezvous,
+)
+
+
+def test_rendezvous_64_agents(store_server):
+    out = bench_rendezvous(store_server.port, 64)
+    assert out["round_close_s"] < 30.0
+    assert out["result_fanout_s"] < 30.0
+
+
+def test_barrier_and_consensus_64_agents(store_server):
+    assert bench_barrier(store_server.port, 64)["barrier_fanin_s"] < 30.0
+    out = bench_consensus(store_server.port, 64, calls=2)
+    assert out["consensus_per_call_s"] < 15.0

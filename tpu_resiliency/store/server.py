@@ -66,10 +66,15 @@ class StoreServer:
         # flag + acks) must never be observable by a new job's agents
         self.journal_strip_prefixes = journal_strip_prefixes or []
         self._journal_file = None
+        self._journal_lock_fd: Optional[int] = None
         self._journal_bytes = 0
         self._journal_compact_at = journal_max_bytes
         self._journal_dirty = False
         self._fsync_task: Optional[asyncio.Task] = None
+        self._compact_task: Optional[asyncio.Task] = None
+        # while a compaction snapshot is being written off-loop, new records
+        # land here and are flushed to the fresh journal after the swap
+        self._compact_buffer: Optional[List[bytes]] = None
         self.replayed_keys = 0
 
     # -- journal -----------------------------------------------------------
@@ -80,6 +85,24 @@ class StoreServer:
     def _open_journal(self) -> None:
         if not self.journal_path:
             return
+        # Exclusive lockfile for the server's lifetime: two instances on one
+        # journal would interleave appends and orphan each other's fd at the
+        # compaction os.replace — losing exactly the state the journal
+        # exists to preserve.  A sidecar lockfile (not the journal fd) stays
+        # valid across the inode swap compaction performs.
+        import fcntl
+
+        lock_path = self.journal_path + ".lock"
+        self._journal_lock_fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(self._journal_lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(self._journal_lock_fd)
+            self._journal_lock_fd = None
+            raise RuntimeError(
+                f"journal {self.journal_path} is locked by another store "
+                f"instance (stale control plane still running?)"
+            )
         good = 0
         try:
             with open(self.journal_path, "rb") as f:
@@ -153,6 +176,11 @@ class StoreServer:
         if self._journal_file is None:
             return
         rec = self._encode_record(key, value)
+        if self._compact_buffer is not None:
+            # a compaction snapshot is being written off-loop; records buffer
+            # in memory and land on the fresh journal right after the swap
+            self._compact_buffer.append(rec)
+            return
         try:
             self._journal_file.write(rec)
             self._journal_file.flush()
@@ -162,46 +190,100 @@ class StoreServer:
             return
         self._journal_bytes += len(rec)
         self._journal_dirty = True
-        if self._journal_bytes > self._journal_compact_at:
-            self._compact_journal()
+        if (
+            self._journal_bytes > self._journal_compact_at
+            and self._loop is not None
+            and self._compact_task is None
+        ):
+            self._compact_task = self._loop.create_task(self._compact_journal())
 
-    def _compact_journal(self) -> None:
-        """Rewrite the journal as a snapshot of the live data (single-threaded
-        event loop: atomic with respect to requests)."""
+    async def _compact_journal(self) -> None:
+        """Rewrite the journal as a snapshot of the live data.  The snapshot
+        write + fsync (potentially tens of MB) runs in an executor so store
+        traffic — rendezvous waits, heartbeat reads — is never stalled behind
+        the disk; mutations made meanwhile buffer in memory and are appended
+        to the fresh journal after the atomic swap."""
         tmp = self.journal_path + ".tmp"
-        try:
+        snapshot = list(self._data.items())
+        self._compact_buffer = []
+
+        def write_snapshot() -> None:
             with open(tmp, "wb") as f:
-                for key, value in self._data.items():
+                for key, value in snapshot:
                     f.write(self._encode_record(key, value))
                 f.flush()
                 os.fsync(f.fileno())
+
+        try:
+            await self._loop.run_in_executor(None, write_snapshot)
+            # swap + drain the buffer inline (fast, no disk sync): atomic
+            # with respect to requests on this single-threaded loop
+            buffered = b"".join(self._compact_buffer)
             self._journal_file.close()
             os.replace(tmp, self.journal_path)
             self._journal_file = open(self.journal_path, "ab")
-            self._journal_bytes = os.path.getsize(self.journal_path)
+            if buffered:
+                self._journal_file.write(buffered)
+                self._journal_file.flush()
+                self._journal_dirty = True
+            self._journal_bytes = self._journal_file.tell()
             # when the live snapshot itself exceeds the cap, compacting on
-            # every subsequent mutation would fsync O(total state) per SET on
-            # the event loop; re-arm only at 2x the snapshot size
+            # every subsequent mutation would rewrite O(total state) per SET;
+            # re-arm only at 2x the snapshot size
             self._journal_compact_at = max(
                 self.journal_max_bytes, 2 * self._journal_bytes
             )
             log.info(
                 "journal compacted to %d bytes (%d keys)",
-                self._journal_bytes, len(self._data),
+                self._journal_bytes, len(snapshot),
             )
-        except OSError:
-            log.exception("journal compaction failed; disabling journal")
-            self._journal_file = None
-
-    async def _fsync_loop(self) -> None:
-        while True:
-            await asyncio.sleep(self.journal_fsync_interval)
-            if self._journal_dirty and self._journal_file is not None:
-                self._journal_dirty = False
+        except asyncio.CancelledError:
+            # server stopping mid-snapshot: flush buffered records to the OLD
+            # journal (still open) so acked mutations survive the restart
+            buffered = b"".join(self._compact_buffer or [])
+            self._compact_buffer = None
+            if buffered and self._journal_file is not None:
                 try:
+                    self._journal_file.write(buffered)
+                    self._journal_file.flush()
                     os.fsync(self._journal_file.fileno())
                 except (OSError, ValueError):
                     pass
+            raise
+        except OSError:
+            log.exception("journal compaction failed; disabling journal")
+            self._journal_file = None
+        finally:
+            self._compact_buffer = None
+            self._compact_task = None
+
+    async def _fsync_loop(self) -> None:
+        import errno
+
+        while True:
+            await asyncio.sleep(self.journal_fsync_interval)
+            if (
+                not self._journal_dirty
+                or self._journal_file is None
+                or self._compact_task is not None  # compaction fsyncs itself
+            ):
+                continue
+            self._journal_dirty = False
+            fd = self._journal_file.fileno()
+            try:
+                # off-loop: a slow disk (NFS, EIO retry storm) must not stall
+                # every GET/WAIT the control plane is serving
+                await self._loop.run_in_executor(None, os.fsync, fd)
+            except ValueError:
+                continue  # file swapped mid-flush by compaction: benign
+            except OSError as exc:
+                if exc.errno == errno.EBADF:
+                    continue  # fd closed under us by compaction: benign
+                # after a failed fsync the kernel may have dropped the dirty
+                # pages: acking further writes would be silent data loss
+                log.exception("journal fsync failed; disabling journal")
+                self._journal_file = None
+                return
 
     # -- storage ops (run on the event loop; atomic wrt each other) --------
 
@@ -370,17 +452,23 @@ class StoreServer:
 
     def start_in_thread(self) -> "StoreServer":
         """Host the store on a daemon thread (used by launchers and tests)."""
+        self._start_error: Optional[BaseException] = None
 
         def _run():
             try:
                 asyncio.run(self.serve_async())
             except asyncio.CancelledError:
                 pass
+            except BaseException as exc:  # noqa: BLE001 - surface to starter
+                self._start_error = exc
+                self._started.set()  # unblock the waiter with the real error
 
         self._thread = threading.Thread(target=_run, name="tpurx-store", daemon=True)
         self._thread.start()
         if not self._started.wait(timeout=10):
             raise RuntimeError("store server failed to start")
+        if self._start_error is not None:
+            raise self._start_error
         return self
 
     def stop(self) -> None:
@@ -403,10 +491,26 @@ class StoreServer:
             except (OSError, ValueError):
                 pass
             self._journal_file = None
+        if self._journal_lock_fd is not None:
+            try:
+                os.close(self._journal_lock_fd)  # releases the flock
+            except OSError:
+                pass
+            self._journal_lock_fd = None
 
 
-def serve_forever(host: str, port: int, journal: Optional[str] = None) -> None:
-    asyncio.run(StoreServer(host, port, journal_path=journal).serve_async())
+def serve_forever(
+    host: str,
+    port: int,
+    journal: Optional[str] = None,
+    journal_strip_prefixes: Optional[List[bytes]] = None,
+) -> None:
+    asyncio.run(
+        StoreServer(
+            host, port, journal_path=journal,
+            journal_strip_prefixes=journal_strip_prefixes,
+        ).serve_async()
+    )
 
 
 def main() -> None:
@@ -417,9 +521,17 @@ def main() -> None:
         "--journal", default=None,
         help="on-disk journal path: state survives a store restart",
     )
+    parser.add_argument(
+        "--journal-keep-terminal", action="store_true",
+        help="replay job-terminal keys (rdzv/shutdown*) too; by default they "
+             "are stripped so a restarted store does not instantly terminate "
+             "the next job with the previous job's shutdown flag",
+    )
     args = parser.parse_args()
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
-    serve_forever(args.host, args.port, journal=args.journal)
+    strip = None if args.journal_keep_terminal else [b"rdzv/shutdown"]
+    serve_forever(args.host, args.port, journal=args.journal,
+                  journal_strip_prefixes=strip)
 
 
 if __name__ == "__main__":
