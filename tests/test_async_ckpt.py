@@ -129,6 +129,73 @@ def test_store_sync_fn_consensus(store):
     assert sync0(1, True) is True
 
 
+class _ApplyThenRaiseAdd:
+    """Store proxy: ADD applies server-side, then the client sees a failure —
+    the ambiguous window the client never retries (bytes left, op non-idempotent)."""
+
+    def __init__(self, store, fail_times: int):
+        self._s = store
+        self.fail_times = fail_times
+        self.add_calls = 0
+
+    def add(self, key, amount: int = 1) -> int:
+        from tpu_resiliency.store.client import StoreError
+
+        self.add_calls += 1
+        out = self._s.add(key, amount)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise StoreError("connection lost after send")
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._s, name)
+
+
+def test_store_sync_fn_ambiguous_add_never_overcounts(store):
+    """An ADD that applied but raised client-side must not be re-applied by a
+    later sync() call: the counter must never exceed the true vouch count, or
+    finalize would commit a torn checkpoint."""
+    flaky = _ApplyThenRaiseAdd(store, fail_times=1)
+    sync0 = store_sync_fn(flaky, rank=0, world_size=2, namespace="amb")
+    # rank 0's ADD applies but raises; swallowed, marker remains the truth
+    assert sync0(0, True) is False
+    # repeated polls must not bump the counter again
+    assert sync0(0, True) is False
+    assert sync0(0, True) is False
+    assert int(store.try_get("amb/done_count/0")) == 1
+    sync1 = store_sync_fn(store, rank=1, world_size=2, namespace="amb")
+    assert sync1(0, True) is True
+    assert sync0(0, True) is True
+
+
+def test_store_sync_fn_recreated_closure_is_idempotent(store):
+    """Recreating the sync closure mid-cycle (last_published resets) must not
+    double-vouch: world_size must never be reached while a rank is unfinished."""
+    sync0a = store_sync_fn(store, rank=0, world_size=2, namespace="rec")
+    assert sync0a(2, True) is False  # vouches calls 0..2
+    # closure recreated (e.g. checkpointer rebuilt mid-cycle)
+    sync0b = store_sync_fn(store, rank=0, world_size=2, namespace="rec")
+    assert sync0b(2, True) is False  # must NOT re-bump counters 0..2
+    for idx in range(3):
+        assert int(store.try_get(f"rec/done_count/{idx}")) == 1
+    sync1 = store_sync_fn(store, rank=1, world_size=2, namespace="rec")
+    assert sync1(2, True) is True
+
+
+def test_store_sync_fn_heals_lost_add(store):
+    """If an ADD is lost entirely (marker set, counter short), the marker
+    recount must still reach consensus and repair the counter write-through."""
+    # simulate rank 0's lost ADD: marker present, counter never bumped
+    store.set("heal/vouch/0/r0", b"1")
+    sync1 = store_sync_fn(store, rank=1, world_size=2, namespace="heal")
+    # the recount path is throttled; within ~1s of polls it must heal
+    healed = any(sync1(0, True) for _ in range(25))
+    assert healed  # marker recount: 2 markers >= world
+    # write-through repair for other pollers' fast path
+    assert int(store.try_get("heal/done_count/0")) >= 2
+
+
 def test_uncommitted_checkpoint_rejected(tmp_path):
     d = tmp_path / "partial"
     d.mkdir()

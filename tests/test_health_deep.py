@@ -92,18 +92,52 @@ def test_kernel_log_baselines_then_detects(tmp_path):
 def test_kernel_log_threshold_and_window(tmp_path):
     path = tmp_path / "kern.log"
     path.write_text("")
-    check = KernelLogHealthCheck(
-        source=str(path), window_s=0.3, threshold=2
-    )
+    check = KernelLogHealthCheck(source=str(path), window_s=0.3, threshold=2)
+    assert check.run().healthy
+    with open(path, "a") as f:
+        f.write("EDAC MC0: 1 UE on chip\n")
+    assert check.run().healthy  # 1 hard < threshold 2
+    with open(path, "a") as f:
+        f.write("Machine Check event\n")
+    assert not check.run().healthy  # 2 hard within window
+    time.sleep(0.35)
+    assert check.run().healthy  # window expired
+
+
+def test_kernel_log_soft_faults_need_repeats(tmp_path):
+    """A single transient event (AER spam, link flap, one NFS hiccup) must
+    NOT exclude the node — exclusion is sticky; soft faults trip only on
+    repetition within the window (ADVICE r2: threshold=1 + broad patterns
+    made any benign event a permanent exclusion)."""
+    path = tmp_path / "kern.log"
+    path.write_text("")
+    check = KernelLogHealthCheck(source=str(path), window_s=60.0)
     assert check.run().healthy
     with open(path, "a") as f:
         f.write("pcieport 0000:00:01.0: AER: error received\n")
-    assert check.run().healthy  # 1 < threshold 2
+    assert check.run().healthy  # one transient: fine
     with open(path, "a") as f:
-        f.write("EDAC MC0: 1 UE on chip\n")
-    assert not check.run().healthy  # 2 within window
-    time.sleep(0.35)
-    assert check.run().healthy  # window expired
+        f.write("eth0: Link is Down\n")
+    assert check.run().healthy  # two transients: still fine
+    with open(path, "a") as f:
+        f.write("nfs: server storage1 not responding, I/O error\n")
+    r = check.run()
+    assert not r.healthy and "transient" in r.message  # third trips
+
+
+def test_kernel_log_oom_scoped_to_workers(tmp_path):
+    """A host cgroup OOM of an unrelated process must never count; a worker
+    OOM counts as a (soft) fault."""
+    path = tmp_path / "kern.log"
+    path.write_text("")
+    check = KernelLogHealthCheck(source=str(path), window_s=60.0, soft_threshold=1)
+    assert check.run().healthy
+    with open(path, "a") as f:
+        f.write("Out of memory: Killed process 1234 (chrome) total-vm:1kB\n")
+    assert check.run().healthy  # unrelated process: ignored
+    with open(path, "a") as f:
+        f.write("Out of memory: Killed process 999 (python3) total-vm:1kB\n")
+    assert not check.run().healthy
 
 
 def test_kernel_log_rotation(tmp_path):

@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ...store.client import StoreError
+from ...store.protocol import itob
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
 
@@ -365,13 +367,24 @@ class CheckpointSaveError(RuntimeError):
 def store_sync_fn(store, rank: int, world_size: int, namespace: Optional[str] = None):
     """Cross-rank completion consensus over the KV store.
 
-    Each rank bumps a store-side atomic counter per call index the first time
-    it observes that call locally done; a call is globally done when its
-    counter reaches ``world_size``.  One ADD per (rank, call) + ONE read per
-    check — at 256+ ranks the old per-rank-key scheme cost O(world) reads per
-    poll (VERDICT weak #8: consensus read amplification), and the reference
-    burns an NCCL all_reduce per check (``core.py:279-291``); neither touches
-    the device here.
+    Fast path is unchanged from the counter scheme (one ADD per (rank, call)
+    + one counter read per poll — the reference burns an NCCL all_reduce per
+    check, ``core.py:279-291``), but the counter is no longer trusted for
+    correctness, only for speed:
+
+    - **Over-count is impossible.**  Before bumping the counter a rank claims
+      a per-(rank, call) marker key (idempotent SET, retry-safe), and the ADD
+      is attempted at most once per claim — an ambiguous ADD failure (the
+      client refuses to resend non-idempotent ops after the bytes left) is
+      swallowed, never retried.  A recreated sync closure re-reads its own
+      markers and skips the ADD for already-claimed calls, so restarted or
+      re-entered loops can never inflate the counter and finalize a torn
+      checkpoint.
+    - **Under-count self-heals.**  The markers are the exact truth (a marker
+      exists iff that rank observed the call locally done).  When the counter
+      poll comes up short, a throttled LIST_KEYS over the call's marker
+      prefix (one roundtrip) recounts exactly; on success the counter is
+      repaired write-through so other pollers take the fast path again.
 
     The namespace defaults to being fenced by the restart cycle
     (``TPURX_CYCLE``): call indices reset on restart, and stale counters from
@@ -380,19 +393,59 @@ def store_sync_fn(store, rank: int, world_size: int, namespace: Optional[str] = 
     if namespace is None:
         namespace = f"ckpt/c{os.environ.get('TPURX_CYCLE', '0')}"
     last_published = -1
+    # per-call poll bookkeeping for the healing scan: call_idx -> polls since
+    # the last exact recount
+    polls_since_scan: dict = {}
+    _SCAN_EVERY = 20  # ~1s of blocking polls (0.05s cadence) between recounts
+
+    def _vouch(idx: int) -> None:
+        marker = f"{namespace}/vouch/{idx}/r{rank}"
+        if store.try_get(marker) is not None:
+            return  # claimed by a previous incarnation; ADD must not repeat
+        store.set(marker, b"1")
+        try:
+            store.add(f"{namespace}/done_count/{idx}", 1)
+        except StoreError:
+            # Ambiguous: the ADD may or may not have applied.  Retrying risks
+            # double-count (torn checkpoint); skipping risks a short counter,
+            # which the marker recount in sync() heals.  Fail safe.
+            pass
 
     def sync(call_idx: int, locally_done: bool) -> bool:
         nonlocal last_published
         if not locally_done:
             return False
-        if call_idx > last_published:
-            # completing call N implies calls <= N are done on this rank
-            # (the async queue finalizes in order): bump every counter this
-            # rank has not vouched for yet
-            for idx in range(last_published + 1, call_idx + 1):
-                store.add(f"{namespace}/done_count/{idx}", 1)
-            last_published = call_idx
+        # completing call N implies calls <= N are done on this rank (the
+        # async queue finalizes in order); advance after EACH call so a fault
+        # mid-loop never re-claims already-vouched calls on re-entry
+        for idx in range(last_published + 1, call_idx + 1):
+            _vouch(idx)
+            last_published = idx
         raw = store.try_get(f"{namespace}/done_count/{call_idx}")
-        return raw is not None and int(raw) >= world_size
+        if raw is not None and int(raw) >= world_size:
+            _done(call_idx)
+            return True
+        n = polls_since_scan.get(call_idx, 0) + 1
+        if n >= _SCAN_EVERY:  # peers lagging ~1s past our own completion
+            polls_since_scan[call_idx] = 0
+            markers = store.list_keys(prefix=f"{namespace}/vouch/{call_idx}/")
+            if len(markers) >= world_size:
+                # exact truth says done; repair the counter for other pollers
+                store.set(f"{namespace}/done_count/{call_idx}", itob(world_size))
+                _done(call_idx)
+                return True
+        else:
+            polls_since_scan[call_idx] = n
+        return False
+
+    def _done(call_idx: int) -> None:
+        polls_since_scan.pop(call_idx, None)
+        # Consensus is durable in the counter now; drop this rank's marker so
+        # the key table doesn't grow by world_size keys per call for the life
+        # of the job (the healing recount is only ever needed pre-consensus).
+        try:
+            store.delete(f"{namespace}/vouch/{call_idx}/r{rank}")
+        except StoreError:
+            pass  # litter, not corruption
 
     return sync

@@ -42,6 +42,10 @@ class FaultToleranceConfig:
     numa_binding: bool = False
     # --- rendezvous ---
     rdzv_round_timeout: float = 600.0
+    # how long an agent keeps retrying a vanished store before giving up —
+    # must exceed a control-plane restart (--journal re-hosts state) or the
+    # fleet is gone by the time the restored store comes back
+    store_rejoin_window: float = 180.0
     min_nodes: int = 1
     max_nodes: Optional[int] = None
     node_group_key: Optional[str] = None  # TPU slice/ICI-domain segment constraint

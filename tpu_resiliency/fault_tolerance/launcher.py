@@ -168,6 +168,7 @@ class ElasticAgent:
         self._pending_exclude = False
         self._pending_shutdown: Optional[str] = None
         self._result: Optional[RendezvousResult] = None
+        self._last_store_ok = 0.0
 
     # -- setup -------------------------------------------------------------
 
@@ -380,9 +381,11 @@ class ElasticAgent:
         pre_rendezvous_health_check(self.cfg, self.node_id, current_cycle=cycle)
 
     def _run_loop(self, joiner: RendezvousJoiner) -> int:
+        store_down_since: Optional[float] = None
         while True:
             try:
                 result = joiner.join(timeout=self.cfg.rdzv_round_timeout)
+                store_down_since = None
             except RendezvousClosedError as exc:
                 log.info("rendezvous closed: %s", exc)
                 self._ack_shutdown()
@@ -392,9 +395,27 @@ class ElasticAgent:
                 self._ack_shutdown()
                 return 1
             except StoreError as exc:
-                # Store host tore down while we were joining/parked (e.g. the
-                # job finished without us): clean shutdown, not a traceback.
-                log.warning("store unreachable during rendezvous: %s", exc)
+                # Store host unreachable.  Either the job finished without us
+                # (host tore the store down) or the control plane is
+                # restarting and --journal will re-host the state.  Keep the
+                # fleet: retry joining for a bounded rejoin window before
+                # concluding the job is gone.
+                now = time.monotonic()
+                if store_down_since is None:
+                    store_down_since = now
+                waited = now - store_down_since
+                if waited < self.cfg.store_rejoin_window:
+                    log.warning(
+                        "store unreachable during rendezvous (%.0fs/%.0fs "
+                        "rejoin window): %s",
+                        waited, self.cfg.store_rejoin_window, exc,
+                    )
+                    time.sleep(min(5.0, max(1.0, waited / 4)))
+                    continue
+                log.warning(
+                    "store unreachable past the %.0fs rejoin window, giving "
+                    "up: %s", self.cfg.store_rejoin_window, exc,
+                )
                 return 1
             if result.role != NodeRole.PARTICIPANT:
                 continue
@@ -425,17 +446,38 @@ class ElasticAgent:
 
     def _monitor_until_event(self, result: RendezvousResult) -> str:
         """Hot loop (reference ``launcher.py:629-697``). Returns outcome."""
+        store_down_since: Optional[float] = None
         while True:
             try:
-                return self._monitor_tick(result)
-            except StoreError:
-                # Store host vanished: if our workers are done, the job most
-                # likely succeeded and the host tore down first; otherwise
-                # treat it as a fatal shutdown.
+                outcome = self._monitor_tick(result)
+                return outcome
+            except StoreError as exc:
+                # Store host unreachable mid-training.  If our workers are
+                # done, the job most likely succeeded and the host tore down
+                # first.  Otherwise ride out a control-plane restart
+                # (--journal re-hosts the state): workers keep training on
+                # ICI and don't need the store until the next event, so keep
+                # them alive for the rejoin window before giving up.
                 status = self._workers_status()
-                log.warning("store unreachable in monitor loop (workers: %s)", status)
                 if status == "succeeded":
                     return "succeeded"
+                now = time.monotonic()
+                if store_down_since is None or self._last_store_ok > store_down_since:
+                    store_down_since = now  # fresh outage, fresh window
+                waited = now - store_down_since
+                if waited < self.cfg.store_rejoin_window:
+                    log.warning(
+                        "store unreachable in monitor loop (workers: %s; "
+                        "%.0fs/%.0fs rejoin window): %s",
+                        status, waited, self.cfg.store_rejoin_window, exc,
+                    )
+                    time.sleep(min(5.0, max(1.0, waited / 4)))
+                    continue
+                log.warning(
+                    "store unreachable past the %.0fs rejoin window "
+                    "(workers: %s) — shutting down: %s",
+                    self.cfg.store_rejoin_window, status, exc,
+                )
                 self._stop_workers()
                 return "shutdown"
 
@@ -480,6 +522,7 @@ class ElasticAgent:
                 self._pending_exclude = False
                 return "excluded"
             shutdown = self.store.try_get(K_SHUTDOWN)
+            self._last_store_ok = time.monotonic()
             if shutdown == b"success":
                 # Peers finished; let local workers drain instead of killing
                 # them mid-final-step, then report success.

@@ -28,19 +28,39 @@ from typing import List, Optional, Pattern, Sequence
 from .base import HealthCheck, HealthCheckResult
 from .window import WindowedErrorCounter
 
-DEFAULT_FAULT_PATTERNS: Sequence[str] = (
+# Hard faults: a single occurrence indicates broken hardware on THIS node —
+# accelerator resets, machine checks, uncorrectable memory errors.  One event
+# justifies sticky exclusion.
+DEFAULT_HARD_PATTERNS: Sequence[str] = (
     r"accel.*(?:error|fault|timeout|reset)",
     r"tpu.*(?:error|fault|timeout|reset)",
-    r"(?:pcieport|AER).*(?:error|failed)",
     r"Machine Check",
     r"\bMCE\b",
-    r"ECC (?:error|warning)",
-    r"EDAC .*(?:CE|UE)",
+    r"EDAC .*UE",
+    r"ECC (?:uncorrectable|error)",
+)
+
+# Soft faults: individually common / transient (a stray AER message, one NFS
+# hiccup, a link flap during switch maintenance, a worker OOM kill).  These
+# must REPEAT within the window before the node is excluded — exclusion is
+# sticky for the rest of the job, and the reference's windowed link check
+# likewise fails only on sustained error rates, never a single event.
+# The OOM pattern is scoped to accelerator-workload process names so an
+# unrelated host cgroup OOM never counts against the node.
+DEFAULT_SOFT_PATTERNS: Sequence[str] = (
+    r"(?:pcieport|AER).*(?:error|failed)",
+    r"EDAC .*CE",
+    r"ECC warning",
     r"Link (?:is )?[Dd]own",
     r"I/O error",
     r"(?:EXT4|XFS|NFS|FUSE)[^\n]*error",
-    r"Out of memory: Killed",
+    r"Out of memory: Killed process \d+ \([^)]*(?:python|jax|tpu|worker|train)",
     r"hung_task",
+)
+
+# Back-compat alias (pre-round-3 single-class list).
+DEFAULT_FAULT_PATTERNS: Sequence[str] = tuple(DEFAULT_HARD_PATTERNS) + tuple(
+    DEFAULT_SOFT_PATTERNS
 )
 
 
@@ -55,15 +75,30 @@ class KernelLogHealthCheck(HealthCheck):
         patterns: Optional[Sequence[str]] = None,
         window_s: float = 600.0,
         threshold: int = 1,
+        soft_patterns: Optional[Sequence[str]] = None,
+        soft_threshold: int = 3,
         max_bytes_per_scan: int = 1 << 20,
     ):
         self.source = source
+        if patterns is not None:
+            # explicit single-class list (back-compat): everything is hard,
+            # judged at `threshold`, and no soft class unless also explicit
+            hard = patterns
+            soft = soft_patterns or ()
+        else:
+            hard = DEFAULT_HARD_PATTERNS
+            soft = DEFAULT_SOFT_PATTERNS if soft_patterns is None else soft_patterns
         self.patterns: List[Pattern[str]] = [
-            re.compile(p, re.IGNORECASE) for p in (patterns or DEFAULT_FAULT_PATTERNS)
+            re.compile(p, re.IGNORECASE) for p in hard
+        ]
+        self.soft_patterns: List[Pattern[str]] = [
+            re.compile(p, re.IGNORECASE) for p in soft
         ]
         self.threshold = threshold
+        self.soft_threshold = soft_threshold
         self.max_bytes = max_bytes_per_scan
         self._window = WindowedErrorCounter(window_s)
+        self._soft_window = WindowedErrorCounter(window_s)
         self._kmsg_fd: Optional[int] = None
         self._file_pos: Optional[int] = None
         self._dmesg_last_ts: float = -1.0
@@ -190,20 +225,38 @@ class KernelLogHealthCheck(HealthCheck):
         lines = self._new_lines()
         if self._mode == "none":
             return HealthCheckResult(True, "no kernel log source available (skipped)")
-        self.last_matches = [
-            line for line in lines if any(p.search(line) for p in self.patterns)
-        ]
-        if self.last_matches:
-            self._window.record(len(self.last_matches))
-        total = self._window.count()
-        if total >= self.threshold:
-            sample = "; ".join(m[:160] for m in self.last_matches[:3])
+        hard_matches: List[str] = []
+        soft_matches: List[str] = []
+        for line in lines:  # hard wins when a line matches both classes
+            if any(p.search(line) for p in self.patterns):
+                hard_matches.append(line)
+            elif any(p.search(line) for p in self.soft_patterns):
+                soft_matches.append(line)
+        self.last_matches = hard_matches + soft_matches
+        if hard_matches:
+            self._window.record(len(hard_matches))
+        if soft_matches:
+            self._soft_window.record(len(soft_matches))
+        hard_total = self._window.count()
+        soft_total = self._soft_window.count()
+        if hard_total >= self.threshold:
+            sample = "; ".join(m[:160] for m in hard_matches[:3])
             return HealthCheckResult(
                 False,
-                f"{total} kernel fault line(s) in {self._window.window_s:.0f}s"
-                + (f": {sample}" if sample else ""),
+                f"{hard_total} hard kernel fault line(s) in "
+                f"{self._window.window_s:.0f}s" + (f": {sample}" if sample else ""),
             )
-        return HealthCheckResult(True, f"{total} windowed fault line(s)")
+        if self.soft_patterns and soft_total >= self.soft_threshold:
+            sample = "; ".join(m[:160] for m in soft_matches[:3])
+            return HealthCheckResult(
+                False,
+                f"{soft_total} transient kernel fault line(s) in "
+                f"{self._soft_window.window_s:.0f}s (threshold "
+                f"{self.soft_threshold})" + (f": {sample}" if sample else ""),
+            )
+        return HealthCheckResult(
+            True, f"{hard_total} hard / {soft_total} transient windowed fault line(s)"
+        )
 
     def close(self) -> None:
         if self._kmsg_fd is not None:

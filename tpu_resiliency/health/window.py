@@ -23,10 +23,12 @@ from typing import Deque, Dict, Optional, Sequence, Tuple
 
 from .base import HealthCheck, HealthCheckResult
 
+# carrier_changes is deliberately NOT here: it increments on link-up as well
+# as link-down, so a single planned bounce would double-count; operators who
+# want it can add the glob with a raised threshold.
 DEFAULT_COUNTER_GLOBS = (
     "/sys/class/net/e*/statistics/rx_errors",
     "/sys/class/net/e*/statistics/tx_errors",
-    "/sys/class/net/e*/carrier_changes",
 )
 
 
@@ -56,6 +58,10 @@ class CounterDeltaWindowCheck(HealthCheck):
     totals — like the reference's NIC link-state baseline,
     ``health_check.py:757`` — must not fail a freshly started monitor).
     Counter resets (value decreasing, e.g. driver reload) re-baseline.
+
+    The default threshold requires a sustained error rate, not a single
+    stray packet error: exclusion is sticky for the rest of the job, and the
+    reference's windowed NVLink check likewise fails only on sustained rates.
     """
 
     name = "counter_window"
@@ -64,7 +70,7 @@ class CounterDeltaWindowCheck(HealthCheck):
         self,
         counter_globs: Sequence[str] = DEFAULT_COUNTER_GLOBS,
         window_s: float = 600.0,
-        threshold: int = 1,
+        threshold: int = 25,
     ):
         self.counter_globs = list(counter_globs)
         self.threshold = threshold

@@ -172,6 +172,16 @@ class StoreServer:
             return b"D" + _U32.pack(len(key)) + key
         return b"S" + _U32.pack(len(key)) + key + _U32.pack(len(value)) + value
 
+    def _disable_journal(self) -> None:
+        """Best-effort close before dropping the reference — otherwise the fd
+        leaks for the process lifetime and stop()'s final fsync is skipped."""
+        f, self._journal_file = self._journal_file, None
+        if f is not None:
+            try:
+                f.close()
+            except (OSError, ValueError):
+                pass
+
     def _journal_append(self, key: bytes, value: Optional[bytes]) -> None:
         if self._journal_file is None:
             return
@@ -186,12 +196,16 @@ class StoreServer:
             self._journal_file.flush()
         except OSError:
             log.exception("journal write failed; disabling journal")
-            self._journal_file = None
+            self._disable_journal()
             return
         self._journal_bytes += len(rec)
         self._journal_dirty = True
+        self._maybe_rearm_compaction()
+
+    def _maybe_rearm_compaction(self) -> None:
         if (
-            self._journal_bytes > self._journal_compact_at
+            self._journal_file is not None
+            and self._journal_bytes > self._journal_compact_at
             and self._loop is not None
             and self._compact_task is None
         ):
@@ -222,6 +236,7 @@ class StoreServer:
             self._journal_file.close()
             os.replace(tmp, self.journal_path)
             self._journal_file = open(self.journal_path, "ab")
+            snapshot_bytes = self._journal_file.tell()
             if buffered:
                 self._journal_file.write(buffered)
                 self._journal_file.flush()
@@ -229,14 +244,21 @@ class StoreServer:
             self._journal_bytes = self._journal_file.tell()
             # when the live snapshot itself exceeds the cap, compacting on
             # every subsequent mutation would rewrite O(total state) per SET;
-            # re-arm only at 2x the snapshot size
+            # re-arm only at 2x the snapshot size (NOT snapshot + the records
+            # buffered during this compaction — those are rewrite-able churn
+            # and must not inflate the trigger)
             self._journal_compact_at = max(
-                self.journal_max_bytes, 2 * self._journal_bytes
+                self.journal_max_bytes, 2 * snapshot_bytes
             )
             log.info(
                 "journal compacted to %d bytes (%d keys)",
                 self._journal_bytes, len(snapshot),
             )
+            if self._journal_bytes > self._journal_compact_at:
+                # a mutation burst landed while the snapshot was being
+                # written; those buffered records bypassed the append-path
+                # size trigger, so chain a follow-up compaction now
+                self._loop.call_soon(self._maybe_rearm_compaction)
         except asyncio.CancelledError:
             # server stopping mid-snapshot: flush buffered records to the OLD
             # journal (still open) so acked mutations survive the restart
@@ -252,7 +274,7 @@ class StoreServer:
             raise
         except OSError:
             log.exception("journal compaction failed; disabling journal")
-            self._journal_file = None
+            self._disable_journal()
         finally:
             self._compact_buffer = None
             self._compact_task = None
@@ -282,7 +304,7 @@ class StoreServer:
                 # after a failed fsync the kernel may have dropped the dirty
                 # pages: acking further writes would be silent data loss
                 log.exception("journal fsync failed; disabling journal")
-                self._journal_file = None
+                self._disable_journal()
                 return
 
     # -- storage ops (run on the event loop; atomic wrt each other) --------
