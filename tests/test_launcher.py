@@ -102,6 +102,36 @@ def test_restart_after_hang_detection(tmp_path):
     assert "failure_detected" in prof
 
 
+def test_quorum_trip_restarts_cycle_before_heartbeat_timeout(tmp_path):
+    """VERDICT r2 #1, in-job ring: a quorum trip sends
+    WorkloadControlRequest(RestartWorkload) through the rank-monitor IPC and
+    the launcher restarts the cycle NOW — the heartbeat timeout (set to an
+    hour) never gets a chance to fire."""
+    t0 = time.monotonic()
+    proc, ckpt = run_launcher(
+        tmp_path,
+        extra_env={
+            "TOY_QUORUM_HANG": "0:0:4",
+            "JAX_PLATFORMS": "cpu",
+            # the host heartbeat ring is deliberately glacial: detection can
+            # only have come from the quorum tripwire
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "3600",
+            "TPURX_FT_INITIAL_RANK_HEARTBEAT_TIMEOUT": "3600",
+        },
+        iters=10,
+        timeout=120,
+    )
+    elapsed = time.monotonic() - t0
+    assert int(ckpt.read_text()) == 10
+    assert "injecting quorum-stall" in proc.stdout
+    combined = proc.stdout + proc.stderr
+    assert "in-workload restart request" in combined
+    assert "ICI quorum" in combined
+    assert elapsed < 100, elapsed
+    prof = (tmp_path / "profiling.jsonl").read_text()
+    assert "failure_detected" in prof
+
+
 def test_restart_budget_exhausted(tmp_path):
     # rank 0 crashes at iter 0 of every cycle; 1 restart allowed -> rc 1
     env = {"TOY_FAIL": "0:0:0"}

@@ -90,6 +90,44 @@ def test_quorum_age_wrap_safe():
         q.now_stamp_ms = orig
 
 
+def test_quorum_identify_names_stale_device():
+    """identify=True returns (age, device_idx) from the SAME single int32
+    pmax (host-side packing, ops/quorum.py::pack_age_device)."""
+    mesh = make_mesh(("all",), (8,))
+    fn = make_quorum_fn(mesh, use_pallas=False, identify=True)
+    now = now_stamp_ms()
+    stamps = np.full(8, now, dtype=np.int64)
+    stamps[5] = now - 5000
+    age, dev = fn(stamps)
+    assert 5000 <= age < 7000, age
+    assert dev == 5
+    # saturation: ages past the 15-bit cap still compare and identify
+    stamps[2] = now - 10_000_000
+    age2, dev2 = fn(stamps)
+    assert dev2 == 2
+    from tpu_resiliency.ops.quorum import _AGE_CAP
+    assert age2 == _AGE_CAP
+
+
+def test_quorum_monitor_identify_passes_device_to_on_stale():
+    mesh = make_mesh(("all",), (8,))
+    hits = []
+    mon = QuorumMonitor(
+        mesh, budget_ms=100.0, interval=0.01,
+        on_stale=lambda age, dev: hits.append((age, dev)),
+        use_pallas=False, identify=True,
+    )
+    mon.start()
+    deadline = time.monotonic() + 5.0
+    while not hits and time.monotonic() < deadline:
+        time.sleep(0.01)
+    mon.stop()
+    assert hits
+    age, dev = hits[0]
+    assert age > 100
+    assert 0 <= dev < 8
+
+
 def test_quorum_monitor_detects_stale():
     mesh = make_mesh(("all",), (8,))
     hits = []

@@ -54,6 +54,15 @@ def train(call_wrapper=None):
             if "crash" in SCENARIO:
                 print("crashing", flush=True)
                 os._exit(31)
+            if SCENARIO == "quorum_hang":
+                # stop beating: the ICI quorum collective must detect the
+                # stale stamp and trip the restart ring — the host-side
+                # soft/hard/sibling timeouts are set far too large to fire.
+                # Python-level stall (not one long C sleep) so the monitor
+                # thread's async raise can land and the SAME process recovers.
+                print("quorum-hanging", flush=True)
+                while True:
+                    time.sleep(0.1)
             if "hang" in SCENARIO:
                 print("hanging", flush=True)
                 time.sleep(3600)  # stops pinging; GIL released
@@ -97,6 +106,27 @@ def main():
         )
     else:
         assignment = ShiftRanks()
+    quorum_kw = {}
+    if SCENARIO == "quorum_hang":
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            # the axon sitecustomize force-selects the TPU platform through
+            # jax.config, overriding the env var — override it back (same
+            # dance as tests/conftest.py)
+            jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        from jax.sharding import Mesh
+
+        quorum_kw = dict(
+            quorum_mesh=Mesh(np.array(jax.devices()), ("d",)),
+            quorum_budget_ms=float(os.environ.get("QUORUM_BUDGET_MS", "500")),
+            quorum_interval=0.02,
+            # manual ping() is the only beat source: a stopped training loop
+            # means stale stamps (progress semantics, not just liveness)
+            quorum_auto_beat_interval=None,
+            quorum_calibrate=False,
+        )
     wrapper = Wrapper(
         rank_assignment=assignment,
         soft_timeout=float(os.environ.get("SOFT_TIMEOUT", "1.0")),
@@ -105,8 +135,9 @@ def main():
         monitor_thread_interval=0.1,
         last_call_wait=0.2,
         heartbeat_interval=0.2,
-        sibling_timeout=2.0,
+        sibling_timeout=float(os.environ.get("SIBLING_TIMEOUT", "2.0")),
         barrier_timeout=30.0,
+        **quorum_kw,
     )
     wrapped = wrapper(train)
     try:

@@ -10,6 +10,9 @@ Env:
   TOY_CKPT        progress file path ("checkpoint")
   TOY_FAIL        "cycle:rank:iter" -> crash with rc 17
   TOY_HANG        "cycle:rank:iter" -> stop heartbeating forever
+  TOY_QUORUM_HANG "cycle:rank:iter" -> stop quorum-beating (stall) with the
+                  on-device quorum tripwire wired to request an in-job
+                  restart (WorkloadControlRequest.RestartWorkload)
   TOY_STEP_TIME   seconds per iteration (default 0.05)
 """
 
@@ -39,6 +42,7 @@ def main():
     ckpt = os.environ.get("TOY_CKPT")
     fail = parse_spec("TOY_FAIL")
     hang = parse_spec("TOY_HANG")
+    quorum_hang = parse_spec("TOY_QUORUM_HANG")
 
     start = 0
     if ckpt and os.path.exists(ckpt):
@@ -47,10 +51,35 @@ def main():
 
     client = RankMonitorClient()
     client.init_workload_monitoring()
+
+    quorum = None
+    if quorum_hang:
+        import jax
+
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            jax.config.update("jax_platforms", "cpu")  # undo axon override
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tpu_resiliency.inprocess import quorum_restart_requester
+        from tpu_resiliency.ops import QuorumMonitor
+
+        quorum = QuorumMonitor(
+            Mesh(np.array(jax.devices()), ("d",)),
+            budget_ms=float(os.environ.get("TOY_QUORUM_BUDGET_MS", "500")),
+            interval=0.02,
+            auto_beat_interval=None,  # manual beats: progress semantics
+            on_stale=quorum_restart_requester(client),
+            identify=True,
+        )
+        quorum.start()
+
     print(f"toy[{rank}/{world}] cycle={cycle} starting at iter {start}", flush=True)
 
     for it in range(start, total):
         client.send_heartbeat()
+        if quorum is not None:
+            quorum.beat()
         time.sleep(step_time)
         if fail and (cycle, rank, it) == fail:
             print(f"toy[{rank}] injecting crash at iter {it}", flush=True)
@@ -58,8 +87,18 @@ def main():
         if hang and (cycle, rank, it) == hang:
             print(f"toy[{rank}] injecting hang at iter {it}", flush=True)
             time.sleep(3600)
+        if quorum_hang and (cycle, rank, it) == quorum_hang:
+            # keep heartbeating the HOST monitor (its timeout is huge in the
+            # test) but stall the quorum beats: only the on-device tripwire
+            # can name this hang and request the cycle restart
+            print(f"toy[{rank}] injecting quorum-stall at iter {it}", flush=True)
+            while True:
+                client.send_heartbeat()
+                time.sleep(0.1)
         if rank == 0 and ckpt:
             write_progress_iteration(ckpt, it + 1)
+    if quorum is not None:
+        quorum.stop()
     print(f"toy[{rank}] done ({total} iters)", flush=True)
 
 

@@ -84,6 +84,9 @@ class WorkloadAction(str, enum.Enum):
     Continue = "continue"
     ExcludeThisNode = "exclude_this_node"
     ShutdownWorkload = "shutdown_workload"
+    # restart the cycle NOW (quorum tripwire / in-workload hang detection)
+    # without waiting for the rank-heartbeat timeout to kill the hung rank
+    RestartWorkload = "restart_workload"
 
 
 @dataclasses.dataclass

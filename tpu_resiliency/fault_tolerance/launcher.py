@@ -167,6 +167,7 @@ class ElasticAgent:
         self.ipc = IpcConnector(os.path.join(run_dir, "launcher.sock"))
         self._pending_exclude = False
         self._pending_shutdown: Optional[str] = None
+        self._pending_restart: Optional[str] = None
         self._result: Optional[RendezvousResult] = None
         self._last_store_ok = 0.0
 
@@ -224,6 +225,8 @@ class ElasticAgent:
             self._pending_exclude = True
         elif action == WorkloadAction.ShutdownWorkload:
             self._pending_shutdown = msg.get("reason", "workload requested shutdown")
+        elif action == WorkloadAction.RestartWorkload:
+            self._pending_restart = msg.get("reason", "workload requested restart")
 
     # -- worker lifecycle --------------------------------------------------
 
@@ -521,6 +524,27 @@ class ElasticAgent:
             if self._pending_exclude:
                 self._pending_exclude = False
                 return "excluded"
+            if self._pending_restart:
+                # Quorum tripwire (or other in-workload detector) named a
+                # hang: restart the cycle NOW instead of waiting for the
+                # rank-heartbeat timeout ring to kill the hung worker.
+                reason = self._pending_restart
+                self._pending_restart = None
+                log.error("in-workload restart request: %s", reason)
+                record_event(
+                    ProfilingEvent.FAILURE_DETECTED,
+                    cycle=result.cycle, reason=reason, source="workload_control",
+                )
+                if self.cycle_info is not None:
+                    self.cycle_info.end_cycle("workload_restart_request", [])
+                self._stop_workers()
+                if not self.log_router.join_readers(timeout=2.0):
+                    log.warning("per-cycle log readers still draining at deadline")
+                if not self._restart_allowed():
+                    self.store.set(K_SHUTDOWN, "restart budget exhausted")
+                    return "shutdown"
+                request_restart(self.store, reason)
+                return "restart"
             shutdown = self.store.try_get(K_SHUTDOWN)
             self._last_store_ok = time.monotonic()
             if shutdown == b"success":

@@ -37,6 +37,7 @@ from .rank_assignment import (
     Tree,
     tpu_pod_layers,
 )
+from .quorum_tripwire import QuorumTripwire, quorum_restart_requester
 from .sibling_monitor import SiblingMonitor
 from .state import FrozenState, Mode, State
 from .wrap import CallWrapper, Wrapper
@@ -56,6 +57,8 @@ __all__ = [
     "MonitorThread",
     "MonitorProcess",
     "ProgressWatchdog",
+    "QuorumTripwire",
+    "quorum_restart_requester",
     "SiblingMonitor",
     "DeviceProbeHealthCheck",
     "FaultCounter",

@@ -14,6 +14,7 @@ class Interruption(str, enum.Enum):
     TERMINATED = "terminated"              # process died
     SIBLING_TIMEOUT = "sibling_timeout"    # detected by the neighbor rank
     MONITOR_LOST = "monitor_lost"          # monitor process itself vanished
+    QUORUM_STALE = "quorum_stale"          # on-device ICI quorum tripwire
 
 
 @dataclasses.dataclass

@@ -89,11 +89,15 @@ class MonitorThread:
                 self.abort_fn()
             except Exception:  # noqa: BLE001
                 log.exception("abort plugin failed")
-        # raise into the main thread until the wrapper acknowledges
-        while not self._caught.wait(timeout=0.5):
-            if self._stop.is_set():
-                return
+        # raise into the main thread until the wrapper acknowledges — first
+        # raise immediately (a 0.5s pre-wait would put a flat half-second on
+        # every detect->restart latency), then re-raise on a backoff in case
+        # the raise landed somewhere it couldn't propagate.  A rank already
+        # in its own fault handler has mark_caught()-ed: never raise into it.
+        while not self._caught.is_set() and not self._stop.is_set():
             async_raise(self.main_tid, RankShouldRestart)
+            if self._caught.wait(timeout=0.5):
+                return
 
     def mark_caught(self) -> None:
         """Called by the wrapper once RankShouldRestart reached its handler."""

@@ -4,10 +4,17 @@ Capability parity with ``inprocess/progress_watchdog.py:49-196``: a hybrid of
 manual ``ping()`` calls from the training loop and **automatic** timestamps
 proving the interpreter's main thread still executes bytecode even when user
 code doesn't ping.  The reference injects a C callback with
-``Py_AddPendingCall``; we do the same through ctypes — the pending call runs
-on the main thread at a bytecode boundary, so a GIL-holding C extension or a
-wedged device wait stops the auto-timestamps (exactly the hangs we must
-catch), while a merely-slow loop keeps them flowing.
+``Py_AddPendingCall``; the pending call runs on the main thread at a
+bytecode boundary, so a GIL-holding C extension or a wedged device wait
+stops the auto-timestamps (exactly the hangs we must catch), while a
+merely-slow loop keeps them flowing.
+
+The callback itself is PURE C (``native/pending_stamp.c``) when the native
+build is available: the monitor thread's async restart raise is delivered by
+the same eval-breaker event that runs pending calls, so a Python-level
+callback frame reliably eats the raise and corrupts the trampoline's error
+state.  A ctypes Python callback remains as the no-toolchain fallback, with
+the raise swallowed defensively (the monitor re-raises on a backoff).
 
 Timestamps are written to a multiprocessing shared value read by the
 MonitorProcess (no queue: a wedged consumer must not block the producer).
@@ -17,6 +24,8 @@ from __future__ import annotations
 
 import ctypes
 import multiprocessing as mp
+import os
+import subprocess
 import threading
 import time
 
@@ -25,6 +34,47 @@ from ..utils.logging import get_logger
 log = get_logger("progress_watchdog")
 
 _PENDING_CALLBACK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+_native_lib = None
+_native_tried = False
+
+
+class _StampRefs(ctypes.Structure):
+    _fields_ = [("timestamp", ctypes.c_void_p), ("consumed", ctypes.c_void_p)]
+
+
+_PINNED: list = []  # shared slots a queued C pending call may still touch
+
+
+def _load_native_stamper():
+    """Build (once) + load the pure-C pending-call stamper; None if the
+    toolchain or loader can't deliver it (fallback: ctypes callback)."""
+    global _native_lib, _native_tried
+    if _native_tried:
+        return _native_lib
+    _native_tried = True
+    path = os.path.join(_NATIVE_DIR, "libtpurx-pending.so")
+    try:
+        src = os.path.join(_NATIVE_DIR, "pending_stamp.c")
+        if not os.path.exists(path) or (
+            os.path.exists(src)
+            and os.path.getmtime(path) < os.path.getmtime(src)
+        ):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "libtpurx-pending.so"],
+                check=True, capture_output=True, text=True, timeout=60,
+            )
+        lib = ctypes.CDLL(path)  # Py_AddPendingCall resolves in-process
+        lib.tpurx_schedule_stamp.argtypes = [ctypes.c_void_p]
+        lib.tpurx_schedule_stamp.restype = ctypes.c_int
+        _native_lib = lib
+    except (OSError, subprocess.SubprocessError) as exc:
+        log.info("native pending stamper unavailable (%s); using ctypes", exc)
+        _native_lib = None
+    return _native_lib
 
 
 class ProgressWatchdog:
@@ -37,16 +87,47 @@ class ProgressWatchdog:
         # keep the callback object alive (ctypes would GC it)
         self._cb = _PENDING_CALLBACK(self._pending_call)
         self._pending_scheduled = threading.Event()
+        # pure-C path: shared consumption counter + pinned refs struct
+        self._native = _load_native_stamper()
+        if self._native is not None:
+            self._consumed = mp.Value("l", 0, lock=False)
+            self._refs = _StampRefs(
+                ctypes.cast(ctypes.addressof(self.timestamp), ctypes.c_void_p),
+                ctypes.cast(ctypes.addressof(self._consumed), ctypes.c_void_p),
+            )
+            self._last_consumed = 0
+            self._native_inflight = False
+            # a queued pending call outlives this object's GC: the pointed-to
+            # memory must never be freed (bounded: one pin per watchdog)
+            _PINNED.append((self.timestamp, self._consumed, self._refs))
 
     # -- main-thread proof-of-life ----------------------------------------
 
     def _pending_call(self, _arg) -> int:
-        # Runs on the MAIN thread at a bytecode boundary.
-        self.timestamp.value = time.time()
-        self._pending_scheduled.clear()
+        # Runs on the MAIN thread at a bytecode boundary.  The monitor
+        # thread's async RankShouldRestart can land HERE (it targets the
+        # main thread, and this callback runs on it): swallow anything —
+        # an exception escaping a ctypes pending-call callback corrupts the
+        # eval loop's error state (SystemError leaks into user code).  The
+        # monitor re-raises on a backoff until the raise lands in user code.
+        try:
+            self.timestamp.value = time.time()
+            self._pending_scheduled.clear()
+        except BaseException:  # noqa: BLE001
+            pass
         return 0
 
     def _schedule_pending(self) -> None:
+        if self._native is not None:
+            cur = self._consumed.value
+            if self._native_inflight and cur == self._last_consumed:
+                return  # previous one not consumed — main thread busy/stuck
+            self._last_consumed = cur
+            self._native_inflight = True
+            res = self._native.tpurx_schedule_stamp(ctypes.addressof(self._refs))
+            if res != 0:  # queue full — fine, we try again next tick
+                self._native_inflight = False
+            return
         if self._pending_scheduled.is_set():
             return  # previous one not consumed yet — main thread busy/stuck
         self._pending_scheduled.set()

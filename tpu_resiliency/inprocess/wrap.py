@@ -69,6 +69,11 @@ class Wrapper:
         barrier_timeout: float = 120.0,
         enable_monitor_process: bool = True,
         enable_sibling_monitor: bool = True,
+        quorum_mesh=None,
+        quorum_budget_ms: float = 50.0,
+        quorum_interval: float = 0.01,
+        quorum_auto_beat_interval: Optional[float] = 0.002,
+        quorum_calibrate: bool = True,
     ):
         self.store_factory = store_factory or store_from_env
         self.group = group
@@ -90,6 +95,14 @@ class Wrapper:
         self.barrier_timeout = barrier_timeout
         self.enable_monitor_process = enable_monitor_process
         self.enable_sibling_monitor = enable_sibling_monitor
+        # on-device ICI quorum tripwire (ms-scale hang detection feeding the
+        # SAME interruption log the monitor thread watches); pass the
+        # training mesh to enable
+        self.quorum_mesh = quorum_mesh
+        self.quorum_budget_ms = quorum_budget_ms
+        self.quorum_interval = quorum_interval
+        self.quorum_auto_beat_interval = quorum_auto_beat_interval
+        self.quorum_calibrate = quorum_calibrate
 
     def __call__(self, fn: Callable) -> Callable:
         def wrapped(*args, **kwargs):
@@ -120,6 +133,7 @@ class CallWrapper:
         self.ops: Optional[InprocStore] = None
         self.watchdog: Optional[ProgressWatchdog] = None
         self.monitor_process: Optional[MonitorProcess] = None
+        self.quorum = None  # QuorumTripwire when wrapper.quorum_mesh is set
         self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
 
     # -- public API for the wrapped fn ------------------------------------
@@ -127,6 +141,8 @@ class CallWrapper:
     def ping(self) -> None:
         if self.watchdog:
             self.watchdog.ping()
+        if self.quorum:
+            self.quorum.beat()
 
     @contextlib.contextmanager
     def atomic(self):
@@ -139,11 +155,18 @@ class CallWrapper:
         """For known-long phases (huge compiles, first checkpoint load)."""
         if self.monitor_process:
             self.monitor_process.set_enabled(False)
+        saved_budget = None
+        if self.quorum:
+            saved_budget = self.quorum.monitor.budget_ms
+            self.quorum.monitor.budget_ms = float("inf")
         try:
             yield
         finally:
             if self.monitor_process:
                 self.monitor_process.set_enabled(True)
+            if self.quorum and saved_budget is not None:
+                self.quorum.beat()  # don't trip on the age accrued meanwhile
+                self.quorum.monitor.budget_ms = saved_budget
 
     @property
     def iteration(self) -> int:
@@ -176,6 +199,8 @@ class CallWrapper:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        if self.quorum:
+            self.quorum.stop()
         if self.watchdog:
             self.watchdog.stop()
         if self.monitor_process:
@@ -191,9 +216,24 @@ class CallWrapper:
         main_tid = threading.get_ident()
         # initial assignment
         self._assign()
+        if w.quorum_mesh is not None and self.quorum is None:
+            from .quorum_tripwire import QuorumTripwire
+
+            self.quorum = QuorumTripwire(
+                w.quorum_mesh,
+                self.ops,
+                rank=state.initial_rank,
+                budget_ms=w.quorum_budget_ms,
+                interval=w.quorum_interval,
+                auto_beat_interval=w.quorum_auto_beat_interval,
+                calibrate=w.quorum_calibrate,
+            ).start(state.iteration)
 
         while True:
             iteration = state.iteration
+            if self.quorum:
+                self.quorum.set_iteration(iteration)
+                self.quorum.beat()
             if w.max_iterations is not None and iteration >= w.max_iterations:
                 raise RestartAbort(f"max_iterations {w.max_iterations} reached")
             if self.monitor_process:
@@ -317,6 +357,8 @@ class CallWrapper:
                     ),
                 )
                 raise RestartAbort(str(exc)) from exc
+            if self.quorum:
+                self.quorum.beat()  # restart path is progress, not a hang
             self._iteration_barrier(iteration)
             state.rank = state.initial_rank
             state.world_size = state.initial_world_size
@@ -343,6 +385,10 @@ class CallWrapper:
             if self.ops.any_completed(iteration):
                 return "completed"
             self.watchdog.ping()
+            if self.quorum:
+                # a parked spare isn't training; its quiet stamps must not
+                # read as a pod hang
+                self.quorum.beat()
             time.sleep(0.2)
 
     def _drain_pending_restart(self) -> None:
@@ -376,6 +422,8 @@ class CallWrapper:
         die mid-barrier (their monitor marks them terminated)."""
         deadline = time.monotonic() + self.w.barrier_timeout
         while True:
+            if self.quorum:
+                self.quorum.beat()  # waiting at the barrier is not a hang
             terminated_now = set(self.ops.terminated_ranks())
             survivors = [
                 r

@@ -94,11 +94,32 @@ def make_local_max(use_pallas: bool) -> Callable:
     return local_max
 
 
+# identify-mode packing: i32 = clamp(age_ms, 0, 2^15-1) << 16 | device_idx.
+# A pmax over packed values sorts lexicographically by (age, device), so ONE
+# collective — the same single int32 all-reduce as the age-only hot path —
+# yields both the pod-wide max age AND which device holds it.  16 bits of
+# device index covers 65k chips; 15 bits of age saturates at ~32.7s, far past
+# any detection budget (saturated ages still compare correctly).
+_AGE_CAP = (1 << 15) - 1
+
+
+def pack_age_device(ages: "np.ndarray", device_idx: "np.ndarray") -> "np.ndarray":
+    return (
+        (np.minimum(ages, _AGE_CAP).astype(np.int32) << 16)
+        | device_idx.astype(np.int32)
+    )
+
+
+def unpack_age_device(packed: int) -> tuple:
+    return packed >> 16, packed & 0xFFFF
+
+
 def make_quorum_fn(
     mesh,
     axis_name: Optional[str] = None,
     use_pallas: Optional[bool] = None,
     blocking: bool = True,
+    identify: bool = False,
 ) -> Callable:
     """Build the jitted quorum collective over ``mesh``.
 
@@ -107,6 +128,12 @@ def make_quorum_fn(
     runs over wrap-safe *ages* (now - stamp, mod 2^31), not raw stamps — a
     pmin over raw wrapped stamps would let a fresh post-wrap stamp mask a
     pre-wrap hung rank for ~24.8 days.
+
+    With ``identify=True`` the ages are packed with each device's global
+    index before the reduce (see :func:`pack_age_device` — the device path
+    is the identical single int32 pmax) and the fn returns
+    ``(max_age_ms, stale_device_idx)``: which chip's heartbeat is oldest,
+    for free, so a trip can name the culprit without a second collective.
 
     Each process passes stamps for its OWN devices; the input global array is
     assembled with ``make_array_from_process_local_data`` so the call works on
@@ -134,11 +161,23 @@ def make_quorum_fn(
     n_total = int(np.prod(mesh.devices.shape))
     n_local = len(mesh.local_devices) if hasattr(mesh, "local_devices") else n_total
     single_process = n_local == n_total
+    if identify:
+        # global flat position of each local device in mesh order
+        flat = list(mesh.devices.flatten())
+        local_devs = mesh.local_devices if hasattr(mesh, "local_devices") else flat
+        local_idx = np.asarray([flat.index(d) for d in local_devs], dtype=np.int32)
+
+    def _finish(packed: int):
+        if not identify:
+            return packed
+        return unpack_age_device(packed)
 
     def run(local_stamps_ms):
         now = now_stamp_ms()
         local = np.asarray(local_stamps_ms, dtype=np.int64).reshape(n_local)
         ages = ((now - local) % _WRAP).astype(np.int32)
+        if identify:
+            ages = pack_age_device(ages, local_idx)
         if single_process:
             # jit owns the tiny host->device transfer (one dispatch)
             global_ages = ages
@@ -149,8 +188,11 @@ def make_quorum_fn(
         out = jitted(global_ages)
         # blocking: materialize now; non-blocking: hand back the device value
         # (int() on it later completes the dispatch) for pipelined ticks
-        return int(out) if blocking else out
+        if blocking:
+            return _finish(int(out))
+        return out
 
+    run.finish = _finish  # for pipelined callers materializing later
     return run
 
 
@@ -169,10 +211,11 @@ class QuorumMonitor:
         mesh,
         budget_ms: float = 1000.0,
         interval: float = 0.1,
-        on_stale: Optional[Callable[[float], None]] = None,
+        on_stale: Optional[Callable] = None,
         use_pallas: Optional[bool] = None,
         auto_beat_interval: Optional[float] = None,
         fetch_workers: int = 0,
+        identify: bool = False,
     ):
         self.mesh = mesh
         self.budget_ms = budget_ms
@@ -184,6 +227,7 @@ class QuorumMonitor:
         # the result readback RTT dwarfs the interval (tunneled transports;
         # readbacks multiplex across threads, measured on the axon relay)
         self.fetch_workers = fetch_workers
+        self.identify = identify
         self._last_seq = 0
         def _default_on_stale(age):
             from ..utils.profiling import ProfilingEvent, record_event
@@ -192,10 +236,26 @@ class QuorumMonitor:
             record_event(ProfilingEvent.HANG_DETECTED, source="quorum", age_ms=age)
 
         self.on_stale = on_stale or _default_on_stale
+        # tripwire callbacks may accept (age_ms, stale_device_idx); plain
+        # age-only callbacks keep working
+        try:
+            import inspect
+
+            n_params = len([
+                p for p in inspect.signature(self.on_stale).parameters.values()
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                or p.kind == p.VAR_POSITIONAL
+            ])
+        except (TypeError, ValueError):
+            n_params = 1
+        self._on_stale_wants_device = identify and n_params >= 2
         self.use_pallas = use_pallas
-        self._fn = make_quorum_fn(mesh, use_pallas=use_pallas)
+        self._fn = make_quorum_fn(mesh, use_pallas=use_pallas, identify=identify)
         self._fn_async = None
-        self._pending = None
+        self._pending = None  # (dispatch_t, device_value) in-flight slot
+        # results DISPATCHED at or before this fence never fire on_stale —
+        # they observed a hang era that a restart has since resolved
+        self._fence_t = float("-inf")
         self._last_beat_ms = now_stamp_ms()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -204,6 +264,7 @@ class QuorumMonitor:
         self._beater_stop = threading.Event()
         self._beater: Optional[threading.Thread] = None
         self.last_max_age: Optional[int] = None
+        self.last_stale_device: Optional[int] = None
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
@@ -237,6 +298,15 @@ class QuorumMonitor:
         if self._beater is not None:
             self._beater.join(timeout=2)
 
+    def resume_auto_beat(self) -> None:
+        """Re-arm the liveness beater (a rank recovered by the restart ring
+        is alive again; its silence must stop reading as a pod hang).
+        In-flight collectives dispatched during the hang era are fenced:
+        their (stale-by-construction) results must not re-trip the ring."""
+        self.beat()
+        self._fence_t = time.monotonic()
+        self._start_beater()
+
     def calibrate(self, n_ticks: int = 20, safety: float = 3.0,
                   margin_ms: float = 2.0, min_budget_ms: float = 5.0) -> float:
         """Derive the detection budget from OBSERVED healthy tick ages
@@ -258,6 +328,17 @@ class QuorumMonitor:
         self.budget_ms = max(min_budget_ms, safety * p99 + margin_ms)
         return self.budget_ms
 
+    def _split(self, result):
+        if self.identify:
+            return result
+        return result, None
+
+    def _fire(self, age: int, dev: Optional[int]) -> None:
+        if self._on_stale_wants_device:
+            self.on_stale(age, dev)
+        else:
+            self.on_stale(age)
+
     def tick(self) -> int:
         """One collective; returns the pod-wide max heartbeat age (ms)."""
         n_local = (
@@ -266,10 +347,11 @@ class QuorumMonitor:
             else int(np.prod(self.mesh.devices.shape))
         )
         stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
-        age = self._fn(stamps)
+        age, dev = self._split(self._fn(stamps))
         self.last_max_age = age
+        self.last_stale_device = dev
         if age > self.budget_ms:
-            self.on_stale(age)
+            self._fire(age, dev)
         return age
 
     def tick_pipelined(self) -> Optional[int]:
@@ -281,7 +363,8 @@ class QuorumMonitor:
         None on the first call."""
         if self._fn_async is None:
             self._fn_async = make_quorum_fn(
-                self.mesh, use_pallas=self.use_pallas, blocking=False
+                self.mesh, use_pallas=self.use_pallas, blocking=False,
+                identify=self.identify,
             )
         n_local = (
             len(self.mesh.local_devices)
@@ -290,13 +373,16 @@ class QuorumMonitor:
         )
         stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
         pending = self._fn_async(stamps)
-        previous, self._pending = self._pending, pending
+        previous, self._pending = self._pending, (time.monotonic(), pending)
         if previous is None:
             return None
-        age = int(previous)  # materializes the already-dispatched result
+        t_disp, value = previous
+        # int() materializes the already-dispatched result
+        age, dev = self._split(self._fn_async.finish(int(value)))
         self.last_max_age = age
-        if age > self.budget_ms:
-            self.on_stale(age)
+        self.last_stale_device = dev
+        if age > self.budget_ms and t_disp > self._fence_t:
+            self._fire(age, dev)
         return age
 
     def warmup(self) -> None:
@@ -313,7 +399,7 @@ class QuorumMonitor:
             # compile time above and would trip a spurious on_stale as the
             # loop's first evaluated result
             if self._pending is not None:
-                int(self._pending)
+                int(self._pending[1])
                 self._pending = None
         finally:
             self.budget_ms = saved
@@ -347,7 +433,8 @@ class QuorumMonitor:
 
         if self._fn_async is None:
             self._fn_async = make_quorum_fn(
-                self.mesh, use_pallas=self.use_pallas, blocking=False
+                self.mesh, use_pallas=self.use_pallas, blocking=False,
+                identify=self.identify,
             )
         n_local = (
             len(self.mesh.local_devices)
@@ -357,9 +444,9 @@ class QuorumMonitor:
         lock = threading.Lock()
         inflight = [0]
 
-        def evaluate(seq, pending):
+        def evaluate(seq, t_disp, pending):
             try:
-                age = int(pending)
+                age, dev = self._split(self._fn_async.finish(int(pending)))
             except Exception as exc:  # noqa: BLE001
                 log.warning("quorum fetch failed: %s", exc)
                 return
@@ -374,9 +461,10 @@ class QuorumMonitor:
                 if seq > self._last_seq:
                     self._last_seq = seq
                     self.last_max_age = age
-                    fire = age > self.budget_ms
+                    self.last_stale_device = dev
+                    fire = age > self.budget_ms and t_disp > self._fence_t
                 if fire:
-                    self.on_stale(age)
+                    self._fire(age, dev)
 
         seq = 0
         with ThreadPoolExecutor(
@@ -395,7 +483,7 @@ class QuorumMonitor:
                     seq += 1
                     with lock:
                         inflight[0] += 1
-                    pool.submit(evaluate, seq, pending)
+                    pool.submit(evaluate, seq, time.monotonic(), pending)
                 self._stop.wait(self.interval)
 
     def stop(self) -> None:
