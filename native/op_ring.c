@@ -1,0 +1,189 @@
+/* Always-on per-op duration rings for the straggler collector.
+ *
+ * TPU-native analog of the reference's CUPTI circular per-kernel buffers
+ * (cupti_src/CuptiProfiler.h:39-78 + BufferPool.cpp): constant-memory
+ * circular buffers, continuously filled at dispatch rate, readable AT ANY
+ * TIME without pausing collection.  Two properties the Python deque path
+ * cannot give:
+ *
+ *   - push is a couple of stores (no allocator, no GIL-held bookkeeping
+ *     beyond the ctypes call) — the hot path stays <1% of a step;
+ *   - the arena lives in a SHARED MEMORY mapping, so the rank-monitor
+ *     process can read a hung trainer's op stats post-mortem, exactly like
+ *     CUPTI buffers outliving a wedged launch.
+ *
+ * Layout (all little-endian, 8-byte aligned):
+ *   ArenaHeader { u64 magic; u32 max_ops; u32 capacity; u64 n_ops; }
+ *   per op slot:
+ *     OpHeader { u64 write_seq; u64 drops; char name[64]; }
+ *     f32 durations[capacity]   (ring, index = seq % capacity)
+ *
+ * Concurrency: single WRITER per arena (the completion-watcher thread);
+ * any number of readers.  write_seq is bumped AFTER the sample store with a
+ * release barrier, so a reader taking min(seq, capacity) samples may miss
+ * the newest sample but never reads a torn one (f32 stores are atomic on
+ * every target we run on).
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define TPURX_RING_MAGIC 0x54505552494e4731ull /* "TPURING1" */
+#define TPURX_NAME_LEN 64
+
+typedef struct {
+    uint64_t magic;
+    uint32_t max_ops;
+    uint32_t capacity;
+    uint64_t n_ops;
+} arena_header;
+
+typedef struct {
+    uint64_t write_seq;
+    uint64_t drops;
+    char name[TPURX_NAME_LEN];
+} op_header;
+
+typedef struct {
+    uint64_t count;     /* total samples ever pushed */
+    uint64_t drops;
+    uint64_t window;    /* samples currently in the ring (<= capacity) */
+    double total;       /* over the window */
+    double mean;
+    double median;
+    double min;
+    double max;
+    double stddev;
+} op_stats;
+
+static size_t slot_size(uint32_t capacity) {
+    return sizeof(op_header) + (size_t)capacity * sizeof(float);
+}
+
+static op_header *slot(void *base, uint32_t idx) {
+    arena_header *h = (arena_header *)base;
+    return (op_header *)((char *)base + sizeof(arena_header)
+                         + (size_t)idx * slot_size(h->capacity));
+}
+
+static float *ring_of(op_header *s) {
+    return (float *)((char *)s + sizeof(op_header));
+}
+
+size_t tpurx_ring_arena_size(uint32_t max_ops, uint32_t capacity) {
+    return sizeof(arena_header) + (size_t)max_ops * slot_size(capacity);
+}
+
+int tpurx_ring_init(void *base, uint32_t max_ops, uint32_t capacity) {
+    arena_header *h = (arena_header *)base;
+    memset(base, 0, tpurx_ring_arena_size(max_ops, capacity));
+    h->max_ops = max_ops;
+    h->capacity = capacity;
+    h->n_ops = 0;
+    __atomic_store_n(&h->magic, TPURX_RING_MAGIC, __ATOMIC_RELEASE);
+    return 0;
+}
+
+/* Register (or find) an op slot by name; returns index or -1 when full. */
+int tpurx_ring_intern(void *base, const char *name) {
+    arena_header *h = (arena_header *)base;
+    if (h->magic != TPURX_RING_MAGIC) return -1;
+    uint64_t n = h->n_ops;
+    for (uint64_t i = 0; i < n; i++) {
+        if (strncmp(slot(base, (uint32_t)i)->name, name, TPURX_NAME_LEN - 1) == 0)
+            return (int)i;
+    }
+    if (n >= h->max_ops) return -1;
+    op_header *s = slot(base, (uint32_t)n);
+    strncpy(s->name, name, TPURX_NAME_LEN - 1);
+    s->name[TPURX_NAME_LEN - 1] = '\0';
+    /* publish the slot after the name is fully written */
+    __atomic_store_n(&h->n_ops, n + 1, __ATOMIC_RELEASE);
+    return (int)n;
+}
+
+void tpurx_ring_push(void *base, int op_idx, float duration_s) {
+    arena_header *h = (arena_header *)base;
+    if (h->magic != TPURX_RING_MAGIC || op_idx < 0
+        || (uint32_t)op_idx >= h->n_ops)
+        return;
+    op_header *s = slot(base, (uint32_t)op_idx);
+    uint64_t seq = s->write_seq;
+    ring_of(s)[seq % h->capacity] = duration_s;
+    __atomic_store_n(&s->write_seq, seq + 1, __ATOMIC_RELEASE);
+}
+
+void tpurx_ring_add_drop(void *base, int op_idx) {
+    arena_header *h = (arena_header *)base;
+    if (h->magic != TPURX_RING_MAGIC || op_idx < 0
+        || (uint32_t)op_idx >= h->n_ops)
+        return;
+    op_header *s = slot(base, (uint32_t)op_idx);
+    __atomic_fetch_add(&s->drops, 1, __ATOMIC_RELAXED);
+}
+
+uint64_t tpurx_ring_n_ops(void *base) {
+    arena_header *h = (arena_header *)base;
+    if (__atomic_load_n(&h->magic, __ATOMIC_ACQUIRE) != TPURX_RING_MAGIC)
+        return 0;
+    return __atomic_load_n(&h->n_ops, __ATOMIC_ACQUIRE);
+}
+
+int tpurx_ring_name(void *base, int op_idx, char *out, size_t out_len) {
+    arena_header *h = (arena_header *)base;
+    if (h->magic != TPURX_RING_MAGIC || op_idx < 0
+        || (uint32_t)op_idx >= h->n_ops)
+        return -1;
+    strncpy(out, slot(base, (uint32_t)op_idx)->name, out_len - 1);
+    out[out_len - 1] = '\0';
+    return 0;
+}
+
+static int cmp_float(const void *a, const void *b) {
+    float fa = *(const float *)a, fb = *(const float *)b;
+    return (fa > fb) - (fa < fb);
+}
+
+/* Copy-and-reduce the ring into stats — readable while the writer keeps
+ * pushing (the copy races only with overwrites of the OLDEST samples). */
+int tpurx_ring_stats(void *base, int op_idx, op_stats *out) {
+    arena_header *h = (arena_header *)base;
+    if (h->magic != TPURX_RING_MAGIC || op_idx < 0
+        || (uint32_t)op_idx >= h->n_ops)
+        return -1;
+    op_header *s = slot(base, (uint32_t)op_idx);
+    uint64_t seq = __atomic_load_n(&s->write_seq, __ATOMIC_ACQUIRE);
+    uint64_t n = seq < h->capacity ? seq : h->capacity;
+    memset(out, 0, sizeof(*out));
+    out->count = seq;
+    out->drops = __atomic_load_n(&s->drops, __ATOMIC_RELAXED);
+    out->window = n;
+    if (n == 0) return 0;
+    float *copy = (float *)malloc(n * sizeof(float));
+    if (!copy) return -1;
+    memcpy(copy, ring_of(s), n * sizeof(float));
+    double total = 0.0, mn = copy[0], mx = copy[0];
+    for (uint64_t i = 0; i < n; i++) {
+        double v = copy[i];
+        total += v;
+        if (v < mn) mn = v;
+        if (v > mx) mx = v;
+    }
+    double mean = total / (double)n, var = 0.0;
+    for (uint64_t i = 0; i < n; i++) {
+        double d = copy[i] - mean;
+        var += d * d;
+    }
+    qsort(copy, n, sizeof(float), cmp_float);
+    out->total = total;
+    out->mean = mean;
+    out->min = mn;
+    out->max = mx;
+    out->stddev = sqrt(var / (double)n);
+    out->median = (n % 2) ? copy[n / 2]
+                          : 0.5 * ((double)copy[n / 2 - 1] + (double)copy[n / 2]);
+    free(copy);
+    return 0;
+}

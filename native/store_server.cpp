@@ -29,9 +29,11 @@
 #include <stdlib.h>
 #include <string.h>
 #include <sys/epoll.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstdint>
 #include <deque>
@@ -92,6 +94,201 @@ struct Store {
 Store g_store;
 int g_epfd = -1;
 
+// ---- journal ---------------------------------------------------------------
+// Same on-disk format as the Python server (store/server.py: final-state
+// records, replay order reconstructs the map), so a control plane can switch
+// between the asyncio and native servers over one journal file:
+//   'S' u32(klen) key u32(vlen) value     -- key set to value
+//   'D' u32(klen) key                     -- key deleted
+// Appends are fwrite+fflush per mutation; fsync runs on a 1s cadence driven
+// by the epoll loop (matching the Python server's fsync interval).
+// Compaction rewrites the journal as a snapshot of live data when appends
+// exceed the cap, re-arming at max(cap, 2x snapshot) so a snapshot larger
+// than the cap doesn't trigger an O(state) rewrite per mutation.  The
+// snapshot write is inline (single-threaded loop): unlike the Python
+// server's executor offload this briefly parks traffic, but the native
+// write path makes the pause milliseconds at control-plane state sizes.
+
+struct Journal {
+  FILE* f = nullptr;
+  std::string path;
+  int lock_fd = -1;
+  size_t bytes = 0;
+  size_t max_bytes = 64ull << 20;
+  size_t compact_at = 64ull << 20;
+  bool dirty = false;
+  Clock::time_point last_sync = Clock::now();
+  size_t replayed = 0;
+};
+Journal g_journal;
+
+void append_u32_j(std::string* s, uint32_t v) {
+  char b[4];
+  memcpy(b, &v, 4);
+  s->append(b, 4);
+}
+
+std::string journal_record(const std::string& key, const std::string* value) {
+  std::string rec;
+  rec.push_back(value ? 'S' : 'D');
+  append_u32_j(&rec, static_cast<uint32_t>(key.size()));
+  rec.append(key);
+  if (value) {
+    append_u32_j(&rec, static_cast<uint32_t>(value->size()));
+    rec.append(*value);
+  }
+  return rec;
+}
+
+void journal_disable() {
+  if (g_journal.f) {
+    fclose(g_journal.f);
+    g_journal.f = nullptr;
+    fprintf(stderr, "journal write failed; journal disabled\n");
+  }
+}
+
+size_t journal_replay(const std::string& buf) {
+  size_t i = 0, n = buf.size(), good = 0;
+  while (i < n) {
+    char tag = buf[i];
+    if (tag == 'S') {
+      if (i + 5 > n) break;
+      uint32_t kl;
+      memcpy(&kl, buf.data() + i + 1, 4);
+      if (i + 5 + kl + 4 > n) break;
+      std::string key = buf.substr(i + 5, kl);
+      uint32_t vl;
+      memcpy(&vl, buf.data() + i + 5 + kl, 4);
+      size_t end = i + 9 + kl + vl;
+      if (end > n) break;
+      g_store.data[key] = buf.substr(i + 9 + kl, vl);
+      i = end;
+    } else if (tag == 'D') {
+      if (i + 5 > n) break;
+      uint32_t kl;
+      memcpy(&kl, buf.data() + i + 1, 4);
+      size_t end = i + 5 + kl;
+      if (end > n) break;
+      g_store.data.erase(buf.substr(i + 5, kl));
+      i = end;
+    } else {
+      break;
+    }
+    good = i;
+  }
+  return good;
+}
+
+void journal_append(const std::string& key, const std::string* value);
+
+bool journal_open(const std::string& path,
+                  const std::vector<std::string>& strip_prefixes) {
+  // exclusive sidecar lockfile: two servers interleaving appends on one
+  // journal would corrupt exactly the state it exists to preserve; the
+  // sidecar (not the journal fd) stays valid across compaction's rename
+  std::string lock_path = path + ".lock";
+  g_journal.lock_fd = open(lock_path.c_str(), O_CREAT | O_RDWR, 0644);
+  if (g_journal.lock_fd < 0 || flock(g_journal.lock_fd, LOCK_EX | LOCK_NB) != 0) {
+    fprintf(stderr, "journal %s is locked by another store instance\n",
+            path.c_str());
+    return false;
+  }
+  std::string buf;
+  FILE* rf = fopen(path.c_str(), "rb");
+  if (rf) {
+    char chunk[1 << 16];
+    size_t got;
+    while ((got = fread(chunk, 1, sizeof(chunk), rf)) > 0) buf.append(chunk, got);
+    fclose(rf);
+  }
+  size_t good = journal_replay(buf);
+  if (good < buf.size())
+    fprintf(stderr,
+            "journal %s: truncated tail at byte %zu of %zu; discarding\n",
+            path.c_str(), good, buf.size());
+  g_journal.replayed = g_store.data.size();
+  g_journal.path = path;
+  g_journal.f = fopen(path.c_str(), good < buf.size() ? "rb+" : "ab");
+  if (!g_journal.f) {
+    fprintf(stderr, "journal %s: cannot open for append\n", path.c_str());
+    return false;
+  }
+  if (good < buf.size()) {
+    if (ftruncate(fileno(g_journal.f), static_cast<off_t>(good)) != 0)
+      fprintf(stderr, "journal %s: truncate failed\n", path.c_str());
+    fseek(g_journal.f, 0, SEEK_END);
+  }
+  g_journal.bytes = good;
+  g_journal.compact_at = g_journal.max_bytes;
+  // job-terminal keys must not replay into the next job
+  for (const auto& prefix : strip_prefixes) {
+    std::vector<std::string> doomed;
+    for (const auto& [k, _] : g_store.data)
+      if (k.rfind(prefix, 0) == 0) doomed.push_back(k);
+    for (const auto& k : doomed) {
+      g_store.data.erase(k);
+      journal_append(k, nullptr);
+      if (g_journal.replayed) g_journal.replayed--;
+    }
+  }
+  if (g_journal.replayed)
+    fprintf(stderr, "journal restored %zu key(s)\n", g_journal.replayed);
+  return true;
+}
+
+void journal_compact() {
+  std::string tmp = g_journal.path + ".tmp";
+  FILE* tf = fopen(tmp.c_str(), "wb");
+  if (!tf) return journal_disable();
+  size_t snapshot_bytes = 0;
+  for (const auto& [k, v] : g_store.data) {
+    std::string rec = journal_record(k, &v);
+    if (fwrite(rec.data(), 1, rec.size(), tf) != rec.size()) {
+      fclose(tf);
+      unlink(tmp.c_str());
+      return journal_disable();
+    }
+    snapshot_bytes += rec.size();
+  }
+  fflush(tf);
+  fsync(fileno(tf));
+  fclose(tf);
+  fclose(g_journal.f);
+  g_journal.f = nullptr;
+  if (rename(tmp.c_str(), g_journal.path.c_str()) != 0) {
+    unlink(tmp.c_str());
+    return journal_disable();
+  }
+  g_journal.f = fopen(g_journal.path.c_str(), "ab");
+  if (!g_journal.f) return journal_disable();
+  g_journal.bytes = snapshot_bytes;
+  g_journal.compact_at = std::max(g_journal.max_bytes, 2 * snapshot_bytes);
+  g_journal.dirty = false;
+  fprintf(stderr, "journal compacted to %zu bytes (%zu keys)\n",
+          snapshot_bytes, g_store.data.size());
+}
+
+void journal_append(const std::string& key, const std::string* value) {
+  if (!g_journal.f) return;
+  std::string rec = journal_record(key, value);
+  if (fwrite(rec.data(), 1, rec.size(), g_journal.f) != rec.size() ||
+      fflush(g_journal.f) != 0)
+    return journal_disable();
+  g_journal.bytes += rec.size();
+  g_journal.dirty = true;
+  if (g_journal.bytes > g_journal.compact_at) journal_compact();
+}
+
+void journal_maybe_fsync() {
+  if (!g_journal.f || !g_journal.dirty) return;
+  auto now = Clock::now();
+  if (now - g_journal.last_sync < Ms(1000)) return;
+  if (fsync(fileno(g_journal.f)) != 0) return journal_disable();
+  g_journal.dirty = false;
+  g_journal.last_sync = now;
+}
+
 void append_u32(std::string* s, uint32_t v) {
   char b[4];
   memcpy(b, &v, 4);  // little-endian hosts only (x86/arm64 LE)
@@ -122,8 +319,11 @@ void reply(Conn* c, uint8_t status, const std::vector<std::string>& args) {
 
 void notify_key(const std::string& key);
 
+void journal_append(const std::string& key, const std::string* value);
+
 void do_set(const std::string& key, const std::string& value) {
   g_store.data[key] = value;
+  journal_append(key, &value);
   notify_key(key);
 }
 
@@ -265,6 +465,7 @@ void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
       if (args.size() != 2) return reply(c, ST_ERROR, {"APPEND wants 2 args"});
       std::string& v = data[args[0]];
       v.append(args[1]);
+      journal_append(args[0], &v);  // final-state record
       std::string nlen = std::to_string(v.size());
       notify_key(args[0]);
       return reply(c, ST_OK, {nlen});
@@ -298,6 +499,7 @@ void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
     case OP_DELETE: {
       if (args.size() != 1) return reply(c, ST_ERROR, {"DELETE wants 1 arg"});
       bool existed = data.erase(args[0]) > 0;
+      if (existed) journal_append(args[0], nullptr);
       return reply(c, ST_OK, {existed ? "1" : "0"});
     }
     case OP_NUM_KEYS:
@@ -383,11 +585,19 @@ void close_conn(Conn* c) {
 int main(int argc, char** argv) {
   const char* host = "0.0.0.0";
   int port = 29500;
+  const char* journal_path = nullptr;
+  std::vector<std::string> strip_prefixes;
   for (int i = 1; i < argc - 1; ++i) {
     if (!strcmp(argv[i], "--host")) host = argv[++i];
     else if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+    else if (!strcmp(argv[i], "--journal")) journal_path = argv[++i];
+    else if (!strcmp(argv[i], "--journal-max-bytes"))
+      g_journal.max_bytes = strtoull(argv[++i], nullptr, 10);
+    else if (!strcmp(argv[i], "--strip-prefix"))
+      strip_prefixes.push_back(argv[++i]);
   }
   signal(SIGPIPE, SIG_IGN);
+  if (journal_path && !journal_open(journal_path, strip_prefixes)) return 1;
 
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   int one = 1;
@@ -418,9 +628,12 @@ int main(int argc, char** argv) {
 
   std::vector<epoll_event> events(256);
   while (true) {
+    int tmo = next_timeout_ms();
+    if (g_journal.dirty) tmo = std::min(tmo, 250);
     int n = epoll_wait(g_epfd, events.data(), static_cast<int>(events.size()),
-                       next_timeout_ms());
+                       tmo);
     expire_waiters();
+    journal_maybe_fsync();
     for (int i = 0; i < n; ++i) {
       if (events[i].data.ptr == nullptr) {
         while (true) {

@@ -1,0 +1,103 @@
+"""Attribution service lifecycle (reference attribution_manager.py:47-140):
+the launcher spawns/monitors attrsvc, resolves endpoints via the store, and
+health-checks before the restart gate consults it."""
+
+import time
+
+import pytest
+
+from tpu_resiliency.fault_tolerance.attribution_manager import (
+    ENDPOINT_KEY,
+    AttributionManager,
+)
+from tpu_resiliency.store import StoreClient
+
+
+@pytest.fixture
+def store(store_server):
+    c = StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+    yield c
+    c.close()
+
+
+def test_spawn_publishes_endpoint_and_serves(store, tmp_path):
+    mgr = AttributionManager(mode="spawn", store=store)
+    mgr.start()
+    try:
+        url = store.try_get(ENDPOINT_KEY)
+        assert url, "endpoint not published"
+        assert mgr.healthy()
+        # the gate path: POST a cycle log tail, get a verdict dict
+        log_path = tmp_path / "cycle_0.log"
+        log_path.write_text(
+            "[r0] step 12 loss=2.1\n"
+            "[r1] RuntimeError: Resource exhausted: Out of memory while "
+            "trying to allocate 9663676416 bytes\n"
+        )
+        verdict = mgr.analyze_log(str(log_path))
+        assert verdict is not None
+        assert "category" in verdict and "should_resume" in verdict
+    finally:
+        mgr.stop()
+
+
+def test_service_restarted_after_death(store):
+    mgr = AttributionManager(mode="spawn", store=store)
+    mgr.start()
+    try:
+        assert mgr.healthy()
+        mgr._proc.kill()
+        mgr._proc.wait(timeout=10)
+        mgr.tick()  # monitor loop notices and respawns
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not mgr.healthy():
+            time.sleep(0.2)
+        assert mgr.healthy(), "service not restarted"
+        assert mgr._restarts == 1
+    finally:
+        mgr.stop()
+
+
+def test_external_mode_publishes_configured_url(store):
+    mgr = AttributionManager(
+        mode="external", store=store, url="http://10.0.0.9:8950"
+    )
+    mgr.start()
+    assert store.get(ENDPOINT_KEY) == b"http://10.0.0.9:8950"
+    # unreachable -> unhealthy -> gate falls back inline
+    assert not mgr.healthy()
+    assert mgr.analyze_log("/nonexistent") is None
+
+
+def test_resolve_from_store_without_local_url(store):
+    store.set(ENDPOINT_KEY, "http://10.1.2.3:1234")
+    mgr = AttributionManager(mode="inline", store=store)
+    assert mgr.resolve() == "http://10.1.2.3:1234"
+
+
+def test_launcher_gate_via_service_stops_unsurvivable_failure(tmp_path):
+    """E2E: enable_attribution_gate + attribution_service_mode=spawn — the
+    launcher spawns attrsvc, the gate consults it over HTTP, and an OOM
+    (non-survivable) failure STOPS the job instead of burning restarts."""
+    from tests.test_launcher import run_launcher
+
+    proc, ckpt = run_launcher(
+        tmp_path,
+        extra_env={
+            "TOY_FAIL": "0:1:5",
+            "TOY_FAIL_MSG": (
+                "RuntimeError: Out of memory while trying to allocate "
+                "96636764160 bytes"
+            ),
+            "TPURX_FT_ENABLE_ATTRIBUTION_GATE": "1",
+            "TPURX_FT_ATTRIBUTION_SERVICE_MODE": "spawn",
+        },
+        iters=12,
+        expect_rc=1,
+        timeout=120,
+    )
+    err = proc.stderr
+    assert "attribution (service)" in err, err[-3000:]
+    assert "not survivable by restart" in err, err[-3000:]
+    # no second cycle started
+    assert "cycle=1 starting" not in proc.stdout

@@ -217,3 +217,79 @@ def test_ft_param_cli_overrides(tmp_path):
             "--nnodes", "1", "--rdzv-endpoint", "127.0.0.1:1",
             "--ft-param", "not_a_field=1", "x.py",
         ]))
+
+
+def test_operator_flags_map_into_config():
+    from tpu_resiliency.fault_tolerance.launcher import build_agent, parse_args
+
+    args = parse_args([
+        "--nnodes", "1", "--rdzv-endpoint", "127.0.0.1:1",
+        "--worker-stop-signal", "SIGINT",
+        "--term-signal", "SIGTERM",
+        "--workers-stop-timeout", "3.5",
+        "--restart-policy", "min-healthy",
+        "--min-healthy-workers", "2",
+        "--allow-heterogeneous",
+        "--", "echo", "hi",
+    ])
+    agent = build_agent(args)
+    assert agent.cfg.worker_stop_signal == "SIGINT"
+    assert agent.cfg.term_signal == "SIGTERM"
+    assert agent.cfg.workers_stop_timeout == 3.5
+    assert agent.cfg.restart_policy == "min-healthy"
+    assert agent.cfg.min_healthy_workers == 2
+    assert agent.cfg.require_equal_slots is False
+
+
+def test_unknown_stop_signal_rejected():
+    from tpu_resiliency.fault_tolerance.launcher import build_agent, parse_args
+
+    args = parse_args([
+        "--nnodes", "1", "--rdzv-endpoint", "127.0.0.1:1",
+        "--worker-stop-signal", "SIGNOPE", "--", "echo", "hi",
+    ])
+    with pytest.raises(SystemExit):
+        build_agent(args)
+
+
+class _FakeProc:
+    def __init__(self, code):
+        self._code = code
+
+    def poll(self):
+        return self._code
+
+
+def _agent_with(policy, min_healthy, codes):
+    from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+    from tpu_resiliency.fault_tolerance.launcher import (
+        ElasticAgent, WorkerSpec, _Worker,
+    )
+
+    cfg = FaultToleranceConfig(
+        restart_policy=policy, min_healthy_workers=min_healthy,
+    )
+    agent = ElasticAgent(
+        cfg, WorkerSpec(cmd=["true"], nproc_per_node=len(codes)),
+        store_addr="127.0.0.1", store_port=1,
+    )
+    agent.workers = [
+        _Worker(local_rank=i, global_rank=i, proc=_FakeProc(c))
+        for i, c in enumerate(codes)
+    ]
+    return agent
+
+
+def test_workers_status_any_failed_policy():
+    assert _agent_with("any-failed", -1, [0, None, 1])._workers_status() == "failed"
+    assert _agent_with("any-failed", -1, [0, None])._workers_status() == "running"
+    assert _agent_with("any-failed", -1, [0, 0])._workers_status() == "succeeded"
+
+
+def test_workers_status_min_healthy_policy():
+    # 3 workers, tolerate one loss (need 2 healthy)
+    mk = lambda codes: _agent_with("min-healthy", 2, codes)._workers_status()
+    assert mk([0, None, 1]) == "running"      # sidecar died, 2 healthy
+    assert mk([None, 1, 1]) == "failed"       # below min healthy
+    assert mk([0, 0, 1]) == "succeeded"       # done, enough zero-exits
+    assert mk([None, None, None]) == "running"

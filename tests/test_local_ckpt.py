@@ -307,3 +307,59 @@ def test_cleanup_reclaims_crash_debris(store_server, tmp_path):
     assert os.path.exists(mgr._iter_dir(9))       # in-progress spared
     assert mgr.find_latest() == 5
     store.close()
+
+
+def test_ici_save_tcp_recovery_cross_transport(store_server, tmp_path):
+    """The scenario that justifies the hybrid design: save over ICI
+    (ppermute replication), LOSE one node's directory, and restore it from
+    the clique buddy over the DCN TCP lane (IciReplication.execute_plan
+    delegating to a lazily-built PeerExchange)."""
+    import shutil
+
+    from tpu_resiliency.checkpointing.local.ici_replication import IciReplication
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    world = 2
+    lost_rank = 1
+    mesh = make_mesh(("data",), (2,), devices=jax.devices()[:2])
+    trees = {r: make_tree(r, seed=7) for r in range(world)}
+
+    def save_rank(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+        repl = IciReplication(mesh, store, rank, world, replication_factor=2)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"n{rank}"), rank, world, store=store,
+            replication=repl,
+        )
+        mgr.save(trees[rank], iteration=4, is_async=False)
+        repl.close()
+        store.close()
+        return True
+
+    assert all(_run_ranks(world, save_rank).values())
+
+    # node of lost_rank dies; its local checkpoints are gone
+    shutil.rmtree(tmp_path / f"n{lost_rank}")
+
+    def recover_rank(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+        repl = IciReplication(mesh, store, rank, world, replication_factor=2)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"n{rank}"), rank, world, store=store,
+            replication=repl,
+        )
+        latest = mgr.find_latest()
+        assert latest == 4, latest
+        tree, iteration = mgr.load(template=trees[rank], iteration=latest)
+        repl.close()
+        store.close()
+        return tree, iteration
+
+    results = _run_ranks(world, recover_rank)
+    for rank in range(world):
+        tree, iteration = results[rank]
+        assert iteration == 4
+        np.testing.assert_array_equal(
+            np.asarray(tree["w"]), np.asarray(trees[rank]["w"])
+        )
+        assert tree["rank_marker"][0] == rank

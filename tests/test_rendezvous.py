@@ -304,3 +304,69 @@ def test_round_gc_reclaims_old_rounds(rdzv_store):
     assert not any(b"rdzv/result/0" in k or b"rdzv/result/1" in k
                    for k in store.list_keys("rdzv/result/"))
     assert store.check(["rdzv/result/4"])
+
+
+def test_heterogeneous_slots_allowed_when_configured():
+    out = assign_group_ranks(
+        [_node(0, slots=2), _node(1, slots=4)], 1, None,
+        require_equal_slots=False,
+    )
+    ranks = {nid: a["group_rank"] for nid, a in out.items()}
+    assert sorted(ranks.values()) == [0, 1]
+
+
+def test_full_round_mixed_slots(rdzv_store):
+    """A v5e-4 host joins a v5e-8 fleet: global ranks offset by each node's
+    ACTUAL slot count (reference heterogeneous agent groups)."""
+    host = RendezvousHost(
+        rdzv_store(), min_nodes=2, max_nodes=2, settle_time=0.2,
+        require_equal_slots=False,
+    )
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    slots = {"small": 4, "big": 8}
+    threads = [
+        threading.Thread(
+            target=_run_join,
+            args=(rdzv_store, NodeDesc.create(name, slots=n), results),
+        )
+        for name, n in slots.items()
+    ]
+    for t in threads:
+        t.start()
+    host.close_round_when_ready(timeout=20.0)
+    for t in threads:
+        t.join(timeout=20.0)
+    assert len(results) == 2
+    for r in results.values():
+        assert not isinstance(r, Exception), r
+        assert r.global_world_size == 12
+        assert r.group_world_size == 2
+    by_rank = sorted(results.values(), key=lambda r: r.group_rank)
+    # first node's workers are ranks [0, its_slots); second starts after it
+    first_slots = slots[
+        [k for k, v in results.items() if v is by_rank[0]][0]
+    ]
+    assert by_rank[0].rank_offset == 0
+    assert by_rank[1].rank_offset == first_slots
+
+
+def test_mixed_slots_rejected_by_default(rdzv_store):
+    host = RendezvousHost(rdzv_store(), min_nodes=2, max_nodes=2, settle_time=0.2)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    threads = [
+        threading.Thread(
+            target=_run_join,
+            args=(rdzv_store, NodeDesc.create(name, slots=n), results),
+        )
+        for name, n in {"a": 2, "b": 4}.items()
+    ]
+    for t in threads:
+        t.start()
+    with pytest.raises(Exception):
+        host.close_round_when_ready(timeout=10.0)
+    for t in threads:
+        t.join(timeout=5.0)

@@ -2,6 +2,10 @@
 
 The full 64/128/256 sweep lives in ``benchmarks/bench_control_plane.py``
 (results in ``docs/SCALE.md``); this keeps the 64-agent path green in CI.
+
+Bounds are ~5-10x the measured numbers in docs/SCALE.md (round close
+0.23s, barrier fan-in 0.013s, consensus 0.011s/call) — loose enough for a
+loaded CI host, tight enough that an order-of-magnitude regression fails.
 """
 
 from benchmarks.bench_control_plane import (
@@ -13,11 +17,11 @@ from benchmarks.bench_control_plane import (
 
 def test_rendezvous_64_agents(store_server):
     out = bench_rendezvous(store_server.port, 64)
-    assert out["round_close_s"] < 30.0
-    assert out["result_fanout_s"] < 30.0
+    assert out["round_close_s"] < 2.0    # measured 0.23s
+    assert out["result_fanout_s"] < 2.0  # measured 0.24s
 
 
 def test_barrier_and_consensus_64_agents(store_server):
-    assert bench_barrier(store_server.port, 64)["barrier_fanin_s"] < 30.0
+    assert bench_barrier(store_server.port, 64)["barrier_fanin_s"] < 0.5  # 0.013s
     out = bench_consensus(store_server.port, 64, calls=2)
-    assert out["consensus_per_call_s"] < 15.0
+    assert out["consensus_per_call_s"] < 0.5  # measured 0.011s/call

@@ -82,6 +82,9 @@ def main():
             quorum.beat()
         time.sleep(step_time)
         if fail and (cycle, rank, it) == fail:
+            fail_msg = os.environ.get("TOY_FAIL_MSG")
+            if fail_msg:
+                print(fail_msg, flush=True)  # e.g. an OOM signature for the gate
             print(f"toy[{rank}] injecting crash at iter {it}", flush=True)
             os._exit(17)
         if hang and (cycle, rank, it) == hang:

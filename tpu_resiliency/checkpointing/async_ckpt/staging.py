@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import dataclasses
 from multiprocessing import shared_memory
+
+from ...utils.shm import create_shm, unlink_shm
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -61,7 +63,7 @@ class StagedTree:
             try:
                 shm.close()
                 if unlink:
-                    shm.unlink()
+                    unlink_shm(shm)
             except FileNotFoundError:
                 pass
         self._shms.clear()
@@ -246,7 +248,7 @@ def _stage_ndarray(
     nbytes = arr.nbytes  # true size; 0 for empty leaves (shm pads to 1)
     shm_name = ""
     if owner:
-        shm = shared_memory.SharedMemory(create=True, size=max(1, nbytes))
+        shm = create_shm(max(1, nbytes))
         dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
         np.copyto(dst, arr, casting="no")
         staged._shms.append(shm)

@@ -23,7 +23,9 @@ from __future__ import annotations
 import concurrent.futures
 import json
 import os
-from multiprocessing import shared_memory
+from multiprocessing import shared_memory  # noqa: F401 (typing refs)
+
+from ...utils.shm import attach_shm
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -51,7 +53,7 @@ def write_process_shards(
     owned.sort(key=lambda p: -p["nbytes"])
 
     def _write(payload: Dict[str, Any]) -> None:
-        shm = shared_memory.SharedMemory(name=payload["shm_name"])
+        shm = attach_shm(payload["shm_name"])
         try:
             # raw bytes, not np.save: non-native dtypes (bfloat16/fp8) would
             # be written as unloadable void records; shape/dtype live in the

@@ -62,6 +62,7 @@ class IciReplication:
         self.axis = axis_name or mesh.axis_names[0]
         self._sync_gen = 0
         self._fns: Dict[int, object] = {}
+        self._tcp = None  # lazy recovery-path CliqueReplication (DCN TCP)
 
     # -- helpers -----------------------------------------------------------
 
@@ -187,8 +188,29 @@ class IciReplication:
 
     def execute_plan(self, sends, recvs, timeout: float = 120.0):
         """Recovery-time retrieval stays on the DCN path — a broken mesh is
-        exactly when retrieval happens.  Delegate to a TCP exchange."""
-        raise NotImplementedError(
-            "ICI replication covers save-time; use CliqueReplication (TCP) "
-            "for recovery-time retrieval"
-        )
+        exactly when retrieval happens (reference
+        ``local/replication/strategies.py:142-188`` retrieves over the same
+        process group; here save rides ICI, recovery rides TCP).
+
+        The TCP lane is built lazily: a ``PeerExchange`` publishes this
+        rank's address in the store and senders block on the receiver's
+        address key, so no pre-coordination is needed beyond the barrier the
+        manager already runs before planning the exchange."""
+        return self._tcp_lane().execute_plan(sends, recvs, timeout=timeout)
+
+    def _tcp_lane(self):
+        if self._tcp is None:
+            from .replication import CliqueReplication, PeerExchange
+
+            exchange = PeerExchange(
+                self.store, self.rank, namespace="ici_recovery"
+            )
+            self._tcp = CliqueReplication(
+                exchange, self.world_size, self.factor, self.jump
+            )
+        return self._tcp
+
+    def close(self) -> None:
+        if self._tcp is not None:
+            self._tcp.exchange.close()
+            self._tcp = None

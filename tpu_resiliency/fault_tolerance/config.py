@@ -38,6 +38,15 @@ class FaultToleranceConfig:
     max_no_progress_cycles: int = 3
     term_signal: str = "SIGKILL"
     workers_stop_timeout: float = 15.0
+    # graceful signal sent to worker process groups before the KILL sweep
+    # (reference --term-timeout/--kill-signal operator surface)
+    worker_stop_signal: str = "SIGTERM"
+    # "any-failed": one non-zero worker exit fails the cycle (default).
+    # "min-healthy": the cycle fails only when fewer than
+    # min_healthy_workers local workers are still healthy (running or
+    # exited 0) — tolerates loss of non-collective sidecar workers.
+    restart_policy: str = "any-failed"
+    min_healthy_workers: int = -1  # min-healthy policy: -1 = all workers
     # bind worker i to NUMA node (i * nodes // nproc) via numactl when available
     numa_binding: bool = False
     # --- rendezvous ---
@@ -49,6 +58,10 @@ class FaultToleranceConfig:
     min_nodes: int = 1
     max_nodes: Optional[int] = None
     node_group_key: Optional[str] = None  # TPU slice/ICI-domain segment constraint
+    # False: allow heterogeneous worker counts per node (e.g. a v5e-4 host
+    # joining a fleet of v5e-8s) — global ranks are offset by each node's
+    # actual slot count
+    require_equal_slots: bool = True
     # --- health checks ---
     enable_device_health_check: bool = True
     enable_storage_health_check: bool = False
@@ -67,6 +80,12 @@ class FaultToleranceConfig:
     progress_iteration_file: Optional[str] = None
     # --- attribution gate (restart decisions consult the log analyzer) ---
     enable_attribution_gate: bool = False
+    # "inline": gate runs the in-process analyzer; "spawn": the store-hosting
+    # launcher spawns attrsvc, publishes its endpoint in the store, monitors
+    # and restarts it; "external": operator-run service at
+    # attribution_service_url (gate falls back inline when unhealthy)
+    attribution_service_mode: str = "inline"
+    attribution_service_url: Optional[str] = None
     # --- logging / observability ---
     log_level: str = "INFO"
     per_cycle_log_dir: Optional[str] = None

@@ -36,13 +36,21 @@ def run(
     settle_time: float,
     native: bool = False,
     journal: str | None = None,
+    require_equal_slots: bool = True,
 ) -> int:
     if native:
-        if journal:
-            log.warning("--journal is not supported by the native store yet; ignored")
         from ..store.native import NativeStoreServer
 
-        server = NativeStoreServer(host=host, port=port).start()
+        server = NativeStoreServer(
+            host=host, port=port, journal=journal,
+            journal_strip_prefixes=[K_SHUTDOWN],
+        ).start()
+        if journal and server.replayed_keys:
+            log.info(
+                "control-plane state restored from %s (%d keys) by the "
+                "native store: cycle numbering and rendezvous rounds "
+                "continue", journal, server.replayed_keys,
+            )
     else:
         # rounds/cycle numbering must survive a control-plane restart, but
         # job-terminal state must not: a replayed shutdown flag (+ acks)
@@ -61,7 +69,8 @@ def run(
             )
     client = StoreClient("127.0.0.1", server.port, timeout=round_timeout)
     rdzv = RendezvousHost(
-        client, min_nodes=min_nodes, max_nodes=max_nodes, settle_time=settle_time
+        client, min_nodes=min_nodes, max_nodes=max_nodes,
+        settle_time=settle_time, require_equal_slots=require_equal_slots,
     )
     loop = HostRoundLoop(rdzv, round_timeout)
     loop.start()
@@ -108,12 +117,17 @@ def main(argv=None) -> None:
         "--journal", default=None,
         help="journal file: control-plane restarts keep cycle numbering",
     )
+    p.add_argument(
+        "--allow-heterogeneous", action="store_true",
+        help="accept nodes with differing worker counts (mixed slot fleets)",
+    )
     args = p.parse_args(argv)
     sys.exit(
         run(
             args.host, args.port, args.min_nodes, args.max_nodes,
             args.round_timeout, args.settle_time, native=args.native_store,
             journal=args.journal,
+            require_equal_slots=not args.allow_heterogeneous,
         )
     )
 

@@ -157,6 +157,7 @@ class ElasticAgent:
             cfg.max_no_progress_cycles,
         )
         self.cycle_info = None
+        self.attr_manager = None  # built in _setup_store (needs the store)
         if host_store and cfg.cycle_info_dir:
             from .cycle_info import CycleInfoReporter
 
@@ -168,6 +169,10 @@ class ElasticAgent:
         self._pending_exclude = False
         self._pending_shutdown: Optional[str] = None
         self._pending_restart: Optional[str] = None
+        # restart interrupted by a store outage after workers were stopped:
+        # reason + cached gate verdict (see _complete_restart)
+        self._restart_in_flight: Optional[str] = None
+        self._restart_in_flight_allowed: Optional[bool] = None
         self._result: Optional[RendezvousResult] = None
         self._last_store_ok = 0.0
 
@@ -212,9 +217,24 @@ class ElasticAgent:
                 self.store.clone(),
                 min_nodes=self.cfg.min_nodes,
                 max_nodes=self.cfg.max_nodes,
+                require_equal_slots=self.cfg.require_equal_slots,
             )
             self._host_loop = HostRoundLoop(host, self.cfg.rdzv_round_timeout)
             self._host_loop.start()
+        # attribution service lifecycle (reference attribution_manager.py):
+        # the store-hosting launcher spawns ONE attrsvc per job and
+        # publishes its endpoint; every node resolves it from the store
+        from .attribution_manager import AttributionManager
+
+        mode = self.cfg.attribution_service_mode
+        if mode == "spawn" and not self.host_store:
+            mode = "inline"  # only the host node spawns; others resolve
+        self.attr_manager = AttributionManager(
+            mode=mode,
+            store=self.store,
+            url=self.cfg.attribution_service_url,
+        )
+        self.attr_manager.start()
 
     def _on_ipc(self, msg: Dict) -> None:
         if msg.get("kind") != "workload_control":
@@ -318,10 +338,13 @@ class ElasticAgent:
         if not self.workers:
             return
         record_event(ProfilingEvent.WORKER_STOP_REQUESTED)
+        stop_sig = getattr(
+            signal, self.cfg.worker_stop_signal, signal.SIGTERM
+        )
         for w in self.workers:
             if w.proc.poll() is None:
                 try:
-                    os.killpg(w.proc.pid, signal.SIGTERM)
+                    os.killpg(w.proc.pid, stop_sig)
                 except (ProcessLookupError, PermissionError):
                     pass
         deadline = time.monotonic() + self.cfg.workers_stop_timeout
@@ -344,9 +367,22 @@ class ElasticAgent:
         self.workers = []
 
     def _workers_status(self) -> str:
-        """'running' | 'succeeded' | 'failed'"""
+        """'running' | 'succeeded' | 'failed'
+
+        ``restart_policy="min-healthy"`` tolerates worker exits as long as
+        at least ``min_healthy_workers`` local workers remain healthy
+        (running or exited 0) — for jobs with non-collective sidecar
+        workers whose loss should not burn a restart cycle."""
         codes = [w.proc.poll() for w in self.workers]
-        if any(c is not None and c != 0 for c in codes):
+        failed = sum(1 for c in codes if c is not None and c != 0)
+        if self.cfg.restart_policy == "min-healthy" and self.cfg.min_healthy_workers >= 0:
+            healthy = len(codes) - failed
+            if healthy < self.cfg.min_healthy_workers:
+                return "failed"
+            if all(c is not None for c in codes):
+                return "succeeded"  # enough zero-exits; losses tolerated
+            return "running"
+        if failed:
             return "failed"
         if all(c == 0 for c in codes):
             return "succeeded"
@@ -462,6 +498,11 @@ class ElasticAgent:
                 # ICI and don't need the store until the next event, so keep
                 # them alive for the rejoin window before giving up.
                 status = self._workers_status()
+                if status == "succeeded" and self._restart_in_flight is not None:
+                    # workers were already STOPPED for an in-flight restart —
+                    # the empty worker list must not read as job success;
+                    # retry the tick so _complete_restart resumes
+                    status = "restart-in-flight"
                 if status == "succeeded":
                     return "succeeded"
                 now = time.monotonic()
@@ -516,6 +557,8 @@ class ElasticAgent:
         while True:
             time.sleep(self.spec.monitor_interval)
             self._poll_monitor_events()
+            if self.attr_manager is not None:
+                self.attr_manager.tick()  # respawn a dead attrsvc (bounded)
             if self._pending_shutdown:
                 log.warning("shutting down workload: %s", self._pending_shutdown)
                 self.store.set(K_SHUTDOWN, self._pending_shutdown)
@@ -524,6 +567,13 @@ class ElasticAgent:
             if self._pending_exclude:
                 self._pending_exclude = False
                 return "excluded"
+            if self._restart_in_flight is not None:
+                # A store outage interrupted a restart AFTER the workers were
+                # already stopped and the cycle accounted: resume it instead
+                # of letting the dead workers reclassify as a fresh failure
+                # (which would charge end_cycle and the restart budget a
+                # second time for the same fault).
+                return self._complete_restart()
             if self._pending_restart:
                 # Quorum tripwire (or other in-workload detector) named a
                 # hang: restart the cycle NOW instead of waiting for the
@@ -540,11 +590,8 @@ class ElasticAgent:
                 self._stop_workers()
                 if not self.log_router.join_readers(timeout=2.0):
                     log.warning("per-cycle log readers still draining at deadline")
-                if not self._restart_allowed():
-                    self.store.set(K_SHUTDOWN, "restart budget exhausted")
-                    return "shutdown"
-                request_restart(self.store, reason)
-                return "restart"
+                self._restart_in_flight = reason
+                return self._complete_restart()
             shutdown = self.store.try_get(K_SHUTDOWN)
             self._last_store_ok = time.monotonic()
             if shutdown == b"success":
@@ -587,14 +634,28 @@ class ElasticAgent:
                 self._stop_workers()
                 if not self.log_router.join_readers(timeout=2.0):
                     log.warning("per-cycle log readers still draining at deadline")
-                if not self._restart_allowed():
-                    self.store.set(K_SHUTDOWN, "restart budget exhausted")
-                    return "shutdown"
-                request_restart(self.store, f"worker failure on {self.node_id}")
-                return "restart"
+                self._restart_in_flight = f"worker failure on {self.node_id}"
+                return self._complete_restart()
             if is_next_round_open(self.store, result.round_num):
                 log.info("peer-initiated restart: new round open")
                 return "restart"
+
+    def _complete_restart(self) -> str:
+        """Finish an in-flight restart (workers already stopped, cycle
+        already accounted).  Idempotent across StoreError retries: the gate
+        verdict is computed once and cached so a store outage between the
+        gate and ``request_restart`` can't charge the restart budget twice."""
+        if self._restart_in_flight_allowed is None:
+            self._restart_in_flight_allowed = self._restart_allowed()
+        if not self._restart_in_flight_allowed:
+            self.store.set(K_SHUTDOWN, "restart budget exhausted")
+            self._restart_in_flight = None
+            self._restart_in_flight_allowed = None
+            return "shutdown"
+        request_restart(self.store, self._restart_in_flight)
+        self._restart_in_flight = None
+        self._restart_in_flight_allowed = None
+        return "restart"
 
     def _restart_allowed(self) -> bool:
         self.progress.analyze_previous_cycle()
@@ -623,22 +684,42 @@ class ElasticAgent:
         path = os.path.join(self.cfg.per_cycle_log_dir, f"cycle_{cycle}.log")
         if not os.path.exists(path):
             return True
-        try:
-            from ..attribution import LogAnalyzer
+        category, should_resume, confidence, summary = None, True, 0.0, ""
+        # managed service first (shared cache + coalescing + LLM backend);
+        # unhealthy/unreachable falls back to the inline analyzer — the
+        # gate must never block recovery on the service
+        svc = None
+        if self.attr_manager is not None:
+            svc = self.attr_manager.analyze_log(path)
+        if svc is not None:
+            category = svc.get("category")
+            should_resume = bool(svc.get("should_resume", True))
+            confidence = float(svc.get("confidence", 0.0))
+            summary = svc.get("summary", "")
+        else:
+            try:
+                from ..attribution import LogAnalyzer
 
-            verdict = LogAnalyzer().analyze_file(path)
-        except Exception:  # noqa: BLE001 - the gate must never block recovery
-            log.exception("attribution gate failed; allowing restart")
-            return True
+                verdict = LogAnalyzer().analyze_file(path)
+            except Exception:  # noqa: BLE001 - never block recovery
+                log.exception("attribution gate failed; allowing restart")
+                return True
+            category = (
+                verdict.category.value
+                if hasattr(verdict.category, "value") else verdict.category
+            )
+            should_resume = verdict.should_resume
+            confidence = verdict.confidence
+            summary = verdict.summary
         log.info(
-            "attribution: category=%s resume=%s confidence=%.2f (%s)",
-            verdict.category.value, verdict.should_resume,
-            verdict.confidence, verdict.summary,
+            "attribution%s: category=%s resume=%s confidence=%.2f (%s)",
+            " (service)" if svc is not None else "", category,
+            should_resume, confidence, summary,
         )
-        if not verdict.should_resume and verdict.confidence >= 0.8:
+        if not should_resume and confidence >= 0.8:
             log.error(
                 "attribution gate: %s is not survivable by restart — stopping",
-                verdict.category.value,
+                category,
             )
             return False
         return True
@@ -721,6 +802,8 @@ class ElasticAgent:
 
     def _teardown(self) -> None:
         self.ipc.stop_receiving()
+        if self.attr_manager is not None:
+            self.attr_manager.stop()
         for proc, ctrl, _ in self.monitors:
             try:
                 ctrl.send({"cmd": "shutdown"})
@@ -766,6 +849,32 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     )
     p.add_argument("--monitor-interval", type=float, default=0.1)
     p.add_argument("--log-dir", default=None)
+    # operator surface (each also reachable via --ft-param; these are the
+    # high-traffic knobs the reference exposes as dedicated flags)
+    p.add_argument(
+        "--worker-stop-signal", default=None, metavar="SIG",
+        help="graceful signal before the KILL sweep (default SIGTERM)",
+    )
+    p.add_argument(
+        "--term-signal", default=None, metavar="SIG",
+        help="signal the rank monitor uses to kill a hung rank (default SIGKILL)",
+    )
+    p.add_argument(
+        "--workers-stop-timeout", type=float, default=None,
+        help="seconds to wait after the stop signal before SIGKILL",
+    )
+    p.add_argument(
+        "--restart-policy", choices=["any-failed", "min-healthy"], default=None,
+        help="when a worker exit fails the cycle (default any-failed)",
+    )
+    p.add_argument(
+        "--min-healthy-workers", type=int, default=None,
+        help="min-healthy policy: local workers that must stay healthy",
+    )
+    p.add_argument(
+        "--allow-heterogeneous", action="store_true",
+        help="accept nodes with differing worker counts (mixed slot fleets)",
+    )
     p.add_argument("cmd", nargs=argparse.REMAINDER, help="worker command")
     args = p.parse_args(argv)
     if not args.cmd:
@@ -802,6 +911,25 @@ def build_agent(args: argparse.Namespace) -> ElasticAgent:
         cfg = cfg.merged_with({"min_nodes": n, "max_nodes": n})
     if args.log_dir:
         cfg = cfg.merged_with({"per_cycle_log_dir": args.log_dir})
+    flag_overrides = {}
+    if args.worker_stop_signal:
+        if not hasattr(signal, args.worker_stop_signal):
+            raise SystemExit(f"unknown signal {args.worker_stop_signal!r}")
+        flag_overrides["worker_stop_signal"] = args.worker_stop_signal
+    if args.term_signal:
+        if not hasattr(signal, args.term_signal):
+            raise SystemExit(f"unknown signal {args.term_signal!r}")
+        flag_overrides["term_signal"] = args.term_signal
+    if args.workers_stop_timeout is not None:
+        flag_overrides["workers_stop_timeout"] = args.workers_stop_timeout
+    if args.restart_policy is not None:
+        flag_overrides["restart_policy"] = args.restart_policy
+    if args.min_healthy_workers is not None:
+        flag_overrides["min_healthy_workers"] = args.min_healthy_workers
+    if args.allow_heterogeneous:
+        flag_overrides["require_equal_slots"] = False
+    if flag_overrides:
+        cfg = cfg.merged_with(flag_overrides)
     host, port = args.rdzv_endpoint.rsplit(":", 1)
     cmd = args.cmd
     if cmd[0].endswith(".py"):

@@ -385,12 +385,15 @@ class RankMonitorServer:
         cls, cfg: FaultToleranceConfig, socket_path: str, mp_ctx=None,
         host_health_loop: bool = True,
     ) -> tuple[mp.Process, Any]:
-        """Fork the monitor process; returns (process, control_conn).
+        """Start the monitor process; returns (process, control_conn).
 
-        Must be called before the caller spawns threads (same constraint the
-        reference documents at ``launcher.py:703-759``).
+        Uses **spawn** by default: the axon sitecustomize imports jax into
+        every interpreter, so any parent is multithreaded by the time this
+        runs and a fork risks the documented fork-under-JAX deadlock on real
+        TPU hosts.  All arguments are picklable by construction (dataclass
+        cfg, path string, context-matched pipe/event).
         """
-        ctx = mp_ctx or mp.get_context("fork")
+        ctx = mp_ctx or mp.get_context("spawn")
         parent_conn, child_conn = ctx.Pipe()
         started_evt = ctx.Event()
         proc = ctx.Process(

@@ -250,12 +250,14 @@ class RendezvousHost:
         max_nodes: Optional[int] = None,
         settle_time: float = 2.0,
         close_poll_interval: float = 0.1,
+        require_equal_slots: bool = True,
     ):
         self.store = store
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes
         self.settle_time = settle_time
         self.close_poll_interval = close_poll_interval
+        self.require_equal_slots = require_equal_slots
 
     def bootstrap(self) -> None:
         """Initialize round/cycle counters if this is a fresh store."""
@@ -334,7 +336,10 @@ class RendezvousHost:
         nodes = []
         for key in self.store.list_keys(f"rdzv/node/{n}/"):
             nodes.append(NodeDesc.from_json(self.store.get(key)))
-        assignment = assign_group_ranks(nodes, self.min_nodes, self.max_nodes)
+        assignment = assign_group_ranks(
+            nodes, self.min_nodes, self.max_nodes,
+            require_equal_slots=self.require_equal_slots,
+        )
         participants = sorted(
             (nid for nid, a in assignment.items() if a["group_rank"] is not None),
             key=lambda nid: assignment[nid]["group_rank"],

@@ -1,0 +1,143 @@
+"""Exec entry for the rank monitor process (``python -m
+tpu_resiliency.inprocess.monitor_main``).
+
+Started by :class:`~tpu_resiliency.inprocess.monitor_process.MonitorProcess`
+via exec (never fork — the training parent is JAX-threaded; see that
+module's docstring).  Attaches the parent's named-shm
+:class:`MonitorSharedState`, connects its own store client, marks ready,
+and runs the watch loop: soft-timeout records, hard-timeout kill, parent
+death cleanup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+from ..store.client import StoreClient, store_from_env
+from ..utils.logging import get_logger, setup_logger
+from .attribution import Interruption, InterruptionRecord
+from .monitor_process import (
+    MonitorSharedState,
+    _pid_alive,
+    _terminate_process,
+)
+from .store_ops import InprocStore
+
+log = get_logger("monitor_process")
+
+
+def _record(ops: InprocStore, rank: int, iteration: int,
+            kind: Interruption, msg: str) -> None:
+    try:
+        ops.record_interruption(
+            iteration,
+            InterruptionRecord(rank=rank, interruption=kind, message=msg),
+        )
+    except Exception as exc:  # noqa: BLE001
+        log.error("monitor: failed to record interruption: %s", exc)
+
+
+def run_monitor(
+    shared: MonitorSharedState,
+    store,
+    group: str,
+    rank: int,
+    parent_pid: int,
+    soft_timeout: float,
+    hard_timeout: float,
+    interval: float,
+    termination_grace: float,
+) -> None:
+    ops = InprocStore(store, group)
+    shared.mark_ready()
+    soft_reported_at: Optional[float] = None
+    while True:
+        time.sleep(interval)
+        iteration = shared.iteration
+        if not _pid_alive(parent_pid):
+            log.error("monitor: rank %s (pid %s) died", rank, parent_pid)
+            _record(ops, rank, iteration, Interruption.TERMINATED,
+                    "process died")
+            ops.mark_terminated(rank)
+            return
+        if not shared.enabled:
+            soft_reported_at = None
+            continue
+        stamp = shared.timestamp_slot.value
+        age = time.time() - stamp
+        if age > hard_timeout:
+            log.error(
+                "monitor: rank %s wedged for %.1fs (> hard %.1fs) — killing",
+                rank, age, hard_timeout,
+            )
+            _record(ops, rank, iteration, Interruption.HARD_TIMEOUT,
+                    f"no progress {age:.1f}s")
+            ops.mark_terminated(rank)
+            _terminate_process(parent_pid, termination_grace)
+            return
+        if age > soft_timeout:
+            if soft_reported_at is None or soft_reported_at < stamp:
+                log.warning(
+                    "monitor: rank %s stalled %.1fs (> soft %.1fs)",
+                    rank, age, soft_timeout,
+                )
+                _record(ops, rank, iteration, Interruption.SOFT_TIMEOUT,
+                        f"no progress {age:.1f}s")
+                soft_reported_at = time.time()
+        else:
+            soft_reported_at = None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpurx-monitor")
+    p.add_argument("--shm", required=True)
+    p.add_argument("--group", required=True)
+    p.add_argument("--rank", type=int, required=True)
+    p.add_argument("--parent-pid", type=int, required=True)
+    p.add_argument("--soft-timeout", type=float, default=60.0)
+    p.add_argument("--hard-timeout", type=float, default=90.0)
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--termination-grace", type=float, default=5.0)
+    p.add_argument("--store-host", default=None)
+    p.add_argument("--store-port", type=int, default=None)
+    args = p.parse_args(argv)
+
+    # own session: a killpg of the rank's process group must not take the
+    # monitor with it (the reference's double-fork detach)
+    try:
+        os.setsid()
+    except OSError:
+        pass
+    setup_logger()
+    try:
+        shared = MonitorSharedState.attach(args.shm)
+    except (OSError, ValueError) as exc:
+        log.error("monitor: cannot attach shared state %s: %s", args.shm, exc)
+        return 1
+    try:
+        if args.store_host and args.store_port:
+            store = StoreClient(args.store_host, args.store_port)
+        else:
+            store = store_from_env()
+    except Exception as exc:  # noqa: BLE001
+        log.error("monitor %s: cannot reach store: %s", args.rank, exc)
+        shared.close()
+        return 1
+    try:
+        run_monitor(
+            shared, store, args.group, args.rank, args.parent_pid,
+            args.soft_timeout, args.hard_timeout, args.interval,
+            args.termination_grace,
+        )
+    finally:
+        shared.close()
+        store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,8 @@
 """Monitor process: an external watchdog for one training rank.
 
-Capability parity with ``inprocess/monitor_process.py:55-437``: a daemonized
-process (double-fork, so it survives the parent's crash and is reparented to
-init) that watches the training PID and the progress-watchdog timestamp:
+Capability parity with ``inprocess/monitor_process.py:55-437``: a detached
+process (own session, so it survives the parent's crash and a killpg of the
+rank) that watches the training PID and the progress-watchdog timestamp:
 
 - soft timeout (no progress): record a SOFT_TIMEOUT interruption in the store
   so every rank's MonitorThread trips and restarts — the process lives;
@@ -11,23 +11,37 @@ init) that watches the training PID and the progress-watchdog timestamp:
   itself) and record HARD_TIMEOUT + terminated;
 - process death: record TERMINATED + mark the rank terminated.
 
-The monitor connects to the store with its own client (it must not share the
-parent's socket).
+Process model: **exec, not fork**.  The training process is JAX-threaded by
+the time the wrapper starts (the axon sitecustomize imports jax into every
+interpreter), and forking a threaded parent is a documented deadlock class
+on TPU hosts; multiprocessing's spawn is no better here because it re-imports
+``__main__`` in the child, re-running the training script's module-level
+side effects.  Instead the parent execs a dedicated entry
+(``inprocess.monitor_main``) and shares the watchdog timestamp / iteration /
+enabled flags through a small NAMED shared-memory block
+(:class:`MonitorSharedState`) — no pickling, no inherited interpreter state.
+The monitor connects to the store with its own client (endpoint from the
+store factory when introspectable, else the launcher-provided env).
 """
 
 from __future__ import annotations
 
-import multiprocessing as mp
+import ctypes
 import os
 import signal
+import subprocess
+import sys
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
-from ..utils.logging import get_logger, setup_logger
-from .attribution import Interruption, InterruptionRecord
-from .store_ops import InprocStore
+from ..utils.logging import get_logger
+from ..utils.shm import attach_shm, create_shm, unlink_shm
 
 log = get_logger("monitor_process")
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")
+)
 
 
 def _pid_alive(pid: int) -> bool:
@@ -64,116 +78,190 @@ def _terminate_process(pid: int, grace: float) -> None:
         pass
 
 
+class MonitorSharedState:
+    """Named-shm state shared between the rank and its monitor process.
+
+    Layout (32 bytes): f64 timestamp | i64 iteration | i64 enabled |
+    i64 ready.  Single-writer per field (rank writes the first three, the
+    monitor writes ready); plain aligned loads/stores are atomic on the
+    targets we run on.  ``timestamp_slot`` exposes a ctypes double with a
+    stable address — both the ProgressWatchdog and the native pending-call
+    stamper write through it.
+    """
+
+    SIZE = 32
+
+    def __init__(self, shm, owner: bool):
+        self._shm = shm
+        self._owner = owner
+        self.name = shm.name
+        self.timestamp_slot = ctypes.c_double.from_buffer(shm.buf, 0)
+        self._iteration = ctypes.c_int64.from_buffer(shm.buf, 8)
+        self._enabled = ctypes.c_int64.from_buffer(shm.buf, 16)
+        self._ready = ctypes.c_int64.from_buffer(shm.buf, 24)
+
+    @classmethod
+    def create(cls) -> "MonitorSharedState":
+        state = cls(create_shm(cls.SIZE), owner=True)
+        state.timestamp_slot.value = time.time()
+        state._enabled.value = 1
+        return state
+
+    @classmethod
+    def attach(cls, name: str) -> "MonitorSharedState":
+        return cls(attach_shm(name), owner=False)
+
+    @property
+    def iteration(self) -> int:
+        return int(self._iteration.value)
+
+    @iteration.setter
+    def iteration(self, v: int) -> None:
+        self._iteration.value = v
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._enabled.value)
+
+    @enabled.setter
+    def enabled(self, v: bool) -> None:
+        self._enabled.value = 1 if v else 0
+
+    @property
+    def ready(self) -> bool:
+        return bool(self._ready.value)
+
+    def mark_ready(self) -> None:
+        self._ready.value = 1
+
+    def close(self) -> None:
+        # unlink first (owner): even if a pinned ctypes view keeps the
+        # mapping alive, the NAME must go so nothing attaches to a dead slot
+        if self._owner:
+            unlink_shm(self._shm)
+        # ctypes views pin the buffer — drop them before closing the mmap
+        self.timestamp_slot = None
+        self._iteration = None
+        self._enabled = None
+        self._ready = None
+        try:
+            self._shm.close()
+        except BufferError:
+            pass  # a view escaped (watchdog pin); process exit unmaps
+
+
+def _endpoint_from_factory(store_factory) -> Optional[Tuple[str, int]]:
+    """Best-effort (host, port) introspection so the exec'd monitor reaches
+    the SAME store: StoreFactory and bound StoreClient instances expose
+    host/port; opaque callables fall back to the launcher env."""
+    host = getattr(store_factory, "host", None)
+    port = getattr(store_factory, "port", None)
+    if isinstance(host, str) and isinstance(port, int):
+        return host, port
+    self_obj = getattr(store_factory, "__self__", None)
+    if self_obj is not None:
+        return _endpoint_from_factory(self_obj)
+    return None
+
+
 class MonitorProcess:
     def __init__(
         self,
         store_factory,                 # () -> StoreClient (fresh connection)
         group: str,
         rank: int,
-        timestamp,                     # mp.Value('d') from ProgressWatchdog
+        timestamp=None,                # unused with shared state (kept for API)
         soft_timeout: float = 60.0,
         hard_timeout: float = 90.0,
         interval: float = 1.0,
         termination_grace: float = 5.0,
+        shared_state: Optional[MonitorSharedState] = None,
     ):
         self.store_factory = store_factory
         self.group = group
         self.rank = rank
-        self.timestamp = timestamp
         self.soft_timeout = soft_timeout
         self.hard_timeout = hard_timeout
         self.interval = interval
         self.termination_grace = termination_grace
-        self._iter_value = mp.Value("i", 0, lock=False)
-        self._enabled = mp.Value("i", 1, lock=False)
-        self._proc: Optional[mp.Process] = None
+        self.shared = shared_state or MonitorSharedState.create()
+        self._owns_shared = shared_state is None
+        if timestamp is not None:
+            # A legacy mp.Value timestamp the caller keeps writing would be
+            # INVISIBLE to the exec'd monitor (it reads the shm slot), and
+            # the monitor would hard-kill a healthy rank at hard_timeout.
+            # Fail construction instead of arming a guaranteed kill.
+            raise TypeError(
+                "MonitorProcess no longer accepts a 'timestamp' value — "
+                "create a MonitorSharedState, pass it as shared_state, and "
+                "wire ProgressWatchdog(timestamp_slot=shared.timestamp_slot)"
+            )
+        self._proc: Optional[subprocess.Popen] = None
         self.parent_pid = os.getpid()
 
     # -- parent-side control ----------------------------------------------
 
     def start(self) -> "MonitorProcess":
-        ctx = mp.get_context("fork")
-        self._proc = ctx.Process(
-            target=self._daemon_main,
-            name=f"tpurx-inproc-monitor-{self.rank}",
-            daemon=True,
+        endpoint = _endpoint_from_factory(self.store_factory)
+        cmd = [
+            sys.executable, "-m", "tpu_resiliency.inprocess.monitor_main",
+            "--shm", self.shared.name,
+            "--group", self.group,
+            "--rank", str(self.rank),
+            "--parent-pid", str(self.parent_pid),
+            "--soft-timeout", str(self.soft_timeout),
+            "--hard-timeout", str(self.hard_timeout),
+            "--interval", str(self.interval),
+            "--termination-grace", str(self.termination_grace),
+        ]
+        if endpoint is not None:
+            cmd += ["--store-host", endpoint[0], "--store-port", str(endpoint[1])]
+        else:
+            log.info(
+                "monitor store endpoint not introspectable from the factory; "
+                "the monitor will use TPURX_STORE_* env"
+            )
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (
+            _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        ).rstrip(os.pathsep)
+        self._proc = subprocess.Popen(cmd, env=env)
+        # Readiness handshake: the child boots a fresh interpreter (seconds —
+        # the sitecustomize imports jax) and then connects to the store;
+        # without this wait the soft/hard clocks would silently include boot
+        # time and a hang in the first seconds would be detected late.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if self.shared.ready:
+                return self
+            if self._proc.poll() is not None:
+                log.error(
+                    "monitor process for rank %s exited rc=%s at startup",
+                    self.rank, self._proc.returncode,
+                )
+                return self
+            time.sleep(0.02)
+        log.warning(
+            "monitor process for rank %s not ready after 60s — hang "
+            "protection may lag", self.rank,
         )
-        self._proc.start()
         return self
 
     def set_iteration(self, iteration: int) -> None:
-        self._iter_value.value = iteration
+        self.shared.iteration = iteration
 
     def set_enabled(self, enabled: bool) -> None:
         """Disable hang protection during known-long phases (reference
         ``disable_hang_protection``)."""
-        self._enabled.value = 1 if enabled else 0
+        self.shared.enabled = enabled
 
     def stop(self) -> None:
-        if self._proc is not None and self._proc.is_alive():
+        if self._proc is not None and self._proc.poll() is None:
             self._proc.terminate()
-            self._proc.join(timeout=5)
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
         self._proc = None
-
-    # -- monitor-side loop -------------------------------------------------
-
-    def _daemon_main(self) -> None:
-        # "double fork" effect: detach from the parent's process group so a
-        # killpg of the rank does not take the monitor with it
-        try:
-            os.setsid()
-        except OSError:
-            pass
-        setup_logger()
-        try:
-            store = self.store_factory()
-        except Exception as exc:  # noqa: BLE001
-            log.error("monitor %s: cannot reach store: %s", self.rank, exc)
-            return
-        ops = InprocStore(store, self.group)
-        soft_reported_at: Optional[float] = None
-        while True:
-            time.sleep(self.interval)
-            pid = self.parent_pid
-            iteration = self._iter_value.value
-            if not _pid_alive(pid):
-                log.error("monitor: rank %s (pid %s) died", self.rank, pid)
-                self._record(ops, iteration, Interruption.TERMINATED, "process died")
-                ops.mark_terminated(self.rank)
-                return
-            if not self._enabled.value:
-                soft_reported_at = None
-                continue
-            age = time.time() - self.timestamp.value
-            if age > self.hard_timeout:
-                log.error(
-                    "monitor: rank %s wedged for %.1fs (> hard %.1fs) — killing",
-                    self.rank, age, self.hard_timeout,
-                )
-                self._record(
-                    ops, iteration, Interruption.HARD_TIMEOUT, f"no progress {age:.1f}s"
-                )
-                ops.mark_terminated(self.rank)
-                _terminate_process(pid, self.termination_grace)
-                return
-            if age > self.soft_timeout:
-                if soft_reported_at is None or soft_reported_at < self.timestamp.value:
-                    log.warning(
-                        "monitor: rank %s stalled %.1fs (> soft %.1fs)",
-                        self.rank, age, self.soft_timeout,
-                    )
-                    self._record(
-                        ops, iteration, Interruption.SOFT_TIMEOUT, f"no progress {age:.1f}s"
-                    )
-                    soft_reported_at = time.time()
-            else:
-                soft_reported_at = None
-
-    def _record(self, ops: InprocStore, iteration: int, kind: Interruption, msg: str) -> None:
-        try:
-            ops.record_interruption(
-                iteration,
-                InterruptionRecord(rank=self.rank, interruption=kind, message=msg),
-            )
-        except Exception as exc:  # noqa: BLE001
-            log.error("monitor: failed to record interruption: %s", exc)
+        if self._owns_shared:
+            self.shared.close()

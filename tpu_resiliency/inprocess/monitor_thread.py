@@ -91,9 +91,10 @@ class MonitorThread:
                 log.exception("abort plugin failed")
         # raise into the main thread until the wrapper acknowledges — first
         # raise immediately (a 0.5s pre-wait would put a flat half-second on
-        # every detect->restart latency), then re-raise on a backoff in case
-        # the raise landed somewhere it couldn't propagate.  A rank already
-        # in its own fault handler has mark_caught()-ed: never raise into it.
+        # every detect->restart latency), then re-raise every 0.5s (fixed
+        # interval) in case the raise landed somewhere it couldn't propagate.
+        # A rank already in its own fault handler has mark_caught()-ed:
+        # never raise into it.
         while not self._caught.is_set() and not self._stop.is_set():
             async_raise(self.main_tid, RankShouldRestart)
             if self._caught.wait(timeout=0.5):

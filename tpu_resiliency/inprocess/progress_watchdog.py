@@ -24,8 +24,6 @@ from __future__ import annotations
 
 import ctypes
 import multiprocessing as mp
-import os
-import subprocess
 import threading
 import time
 
@@ -34,12 +32,6 @@ from ..utils.logging import get_logger
 log = get_logger("progress_watchdog")
 
 _PENDING_CALLBACK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p)
-
-_NATIVE_DIR = os.path.abspath(
-    os.path.join(os.path.dirname(__file__), "..", "..", "native")
-)
-_native_lib = None
-_native_tried = False
 
 
 class _StampRefs(ctypes.Structure):
@@ -50,38 +42,30 @@ _PINNED: list = []  # shared slots a queued C pending call may still touch
 
 
 def _load_native_stamper():
-    """Build (once) + load the pure-C pending-call stamper; None if the
+    """Load the pure-C pending-call stamper via the shared build-on-demand
+    loader (load-first, atomic temp build — utils/native.py); None if the
     toolchain or loader can't deliver it (fallback: ctypes callback)."""
-    global _native_lib, _native_tried
-    if _native_tried:
-        return _native_lib
-    _native_tried = True
-    path = os.path.join(_NATIVE_DIR, "libtpurx-pending.so")
-    try:
-        src = os.path.join(_NATIVE_DIR, "pending_stamp.c")
-        if not os.path.exists(path) or (
-            os.path.exists(src)
-            and os.path.getmtime(path) < os.path.getmtime(src)
-        ):
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "libtpurx-pending.so"],
-                check=True, capture_output=True, text=True, timeout=60,
-            )
-        lib = ctypes.CDLL(path)  # Py_AddPendingCall resolves in-process
+    from ..utils.native import load_native
+
+    lib = load_native("libtpurx-pending.so", "pending_stamp.c")
+    if lib is not None and not hasattr(lib.tpurx_schedule_stamp, "argtypes_set"):
         lib.tpurx_schedule_stamp.argtypes = [ctypes.c_void_p]
         lib.tpurx_schedule_stamp.restype = ctypes.c_int
-        _native_lib = lib
-    except (OSError, subprocess.SubprocessError) as exc:
-        log.info("native pending stamper unavailable (%s); using ctypes", exc)
-        _native_lib = None
-    return _native_lib
+    return lib
 
 
 class ProgressWatchdog:
-    def __init__(self, interval: float = 1.0):
+    def __init__(self, interval: float = 1.0, timestamp_slot=None):
         self.interval = interval
-        # 'd' = double epoch seconds; lock-free single-writer
-        self.timestamp = mp.Value("d", time.time(), lock=False)
+        # 'd' = double epoch seconds; lock-free single-writer.  An external
+        # ``timestamp_slot`` (a ctypes double over named shm, from
+        # MonitorSharedState) lets the exec'd monitor process read the
+        # stamps without fork/pickling; default stays process-local.
+        if timestamp_slot is not None:
+            self.timestamp = timestamp_slot
+            self.timestamp.value = time.time()
+        else:
+            self.timestamp = mp.Value("d", time.time(), lock=False)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # keep the callback object alive (ctypes would GC it)

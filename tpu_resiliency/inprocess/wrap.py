@@ -152,7 +152,18 @@ class CallWrapper:
 
     @contextlib.contextmanager
     def disable_hang_protection(self):
-        """For known-long phases (huge compiles, first checkpoint load)."""
+        """For known-long phases (huge compiles, first checkpoint load).
+
+        The raised quorum budget is LOCAL: the quorum collective is pod-wide,
+        so peers' monitors still apply their own budgets to this rank's
+        stamps.  With an auto-beater the beater keeps the stamps fresh
+        throughout, so peers see a live rank; in manual-beat configs
+        (``quorum_auto_beat_interval=None``) a long protected phase freezes
+        this rank's stamp and PEERS will trip — every rank entering a known
+        long phase must wrap it in its own ``disable_hang_protection()``
+        (which keeps protection pod-consistent), or the config should keep
+        the auto-beater on.
+        """
         if self.monitor_process:
             self.monitor_process.set_enabled(False)
         saved_budget = None
@@ -165,7 +176,11 @@ class CallWrapper:
             if self.monitor_process:
                 self.monitor_process.set_enabled(True)
             if self.quorum and saved_budget is not None:
-                self.quorum.beat()  # don't trip on the age accrued meanwhile
+                # resume_auto_beat = beat + FENCE + re-arm beater: an
+                # in-flight pipelined collective dispatched before this beat
+                # still carries the stale stamp and must not fire once the
+                # budget is restored — the fence drops it.
+                self.quorum.monitor.resume_auto_beat()
                 self.quorum.monitor.budget_ms = saved_budget
 
     @property
@@ -177,7 +192,18 @@ class CallWrapper:
     def __enter__(self) -> "CallWrapper":
         self._store = self.w.store_factory()
         self.ops = InprocStore(self._store, self.w.group)
-        self.watchdog = ProgressWatchdog(interval=self.w.monitor_process_interval)
+        # the monitor process is exec'd (never forked — the parent is
+        # JAX-threaded) and reads the watchdog stamps through a named-shm
+        # slot the watchdog writes into
+        shared = None
+        if self.w.enable_monitor_process:
+            from .monitor_process import MonitorSharedState
+
+            shared = MonitorSharedState.create()
+        self.watchdog = ProgressWatchdog(
+            interval=self.w.monitor_process_interval,
+            timestamp_slot=shared.timestamp_slot if shared else None,
+        )
         # the watchdog must run BEFORE hang protection arms: the initial
         # barrier blocks for peers, and its store-wait loop only keeps the
         # liveness timestamp fresh via the watchdog's pending calls
@@ -187,10 +213,10 @@ class CallWrapper:
                 store_factory=self.w.store_factory,
                 group=self.w.group,
                 rank=self.state.initial_rank,
-                timestamp=self.watchdog.timestamp,
                 soft_timeout=self.w.soft_timeout,
                 hard_timeout=self.w.hard_timeout,
                 interval=self.w.monitor_process_interval,
+                shared_state=shared,
             ).start()
         self.ops.initial_barrier(
             self.state.initial_rank, self.state.initial_world_size,
@@ -205,6 +231,9 @@ class CallWrapper:
             self.watchdog.stop()
         if self.monitor_process:
             self.monitor_process.stop()
+            # the shm slot is pinned by the watchdog's (possibly queued)
+            # pending-call refs; close() tolerates that — janitor reaps
+            self.monitor_process.shared.close()
         if self._store:
             self._store.close()
 

@@ -14,6 +14,7 @@ can be implemented natively; ``server.py`` is the asyncio implementation,
 
 from .client import (
     FailoverStoreClient,
+    StoreFactory,
     PrefixStore,
     StoreClient,
     StoreError,
@@ -24,6 +25,7 @@ from .barrier import barrier, reentrant_barrier, BarrierOverflow, BarrierTimeout
 
 __all__ = [
     "StoreClient",
+    "StoreFactory",
     "FailoverStoreClient",
     "PrefixStore",
     "StoreTimeout",

@@ -54,6 +54,26 @@ class StoreTimeout(StoreError, TimeoutError):
     pass
 
 
+class StoreFactory:
+    """Picklable ``() -> StoreClient`` factory.
+
+    Lambdas work as store factories only under fork; subprocess helpers that
+    default to **spawn** (fork-under-threaded-JAX is a deadlock class — the
+    axon sitecustomize imports jax into every interpreter) need the factory
+    to cross a pickle boundary.  Use this instead of a lambda."""
+
+    def __init__(self, host: str, port: int, timeout: float = _DEFAULT_TIMEOUT,
+                 **kwargs):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.kwargs = kwargs
+
+    def __call__(self) -> "StoreClient":
+        return StoreClient(self.host, self.port, timeout=self.timeout,
+                           **self.kwargs)
+
+
 class StoreClient:
     """Client for :class:`tpu_resiliency.store.server.StoreServer`."""
 

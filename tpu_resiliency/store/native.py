@@ -26,7 +26,12 @@ def native_binary_path() -> str:
 
 
 def build_native_server(force: bool = False) -> str:
-    """Compile the native server if needed; returns the binary path."""
+    """Compile the native server if needed; returns the binary path.
+
+    Builds to a process-unique temp file and atomically ``os.replace``s it:
+    concurrent processes (parallel test runs, multiple agents on one host)
+    may build simultaneously, and a torn half-written binary must never be
+    exec'd."""
     binary = native_binary_path()
     src = os.path.abspath(os.path.join(_NATIVE_DIR, "store_server.cpp"))
     if (
@@ -36,40 +41,79 @@ def build_native_server(force: bool = False) -> str:
     ):
         return binary
     log.info("building native store server...")
-    subprocess.run(
-        ["make", "-C", os.path.abspath(_NATIVE_DIR)],
-        check=True,
-        capture_output=True,
-        text=True,
-    )
+    tmp = f"{binary}.build.{os.getpid()}"
+    cxx = os.environ.get("CXX", "g++")
+    try:
+        subprocess.run(
+            [cxx, "-O2", "-std=c++17", "-Wall", "-o", tmp, src],
+            check=True, capture_output=True, text=True, timeout=120,
+        )
+        os.replace(tmp, binary)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
     return binary
 
 
 class NativeStoreServer:
     """Runs the C++ server as a child process (same surface as StoreServer)."""
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 journal: Optional[str] = None,
+                 journal_strip_prefixes: Optional[list] = None):
         self.host = host
         self.port = port
+        self.journal = journal
+        self.journal_strip_prefixes = journal_strip_prefixes or []
+        self.replayed_keys = 0
         self._proc: Optional[subprocess.Popen] = None
 
     def start(self, timeout: float = 15.0) -> "NativeStoreServer":
         import select
 
         binary = build_native_server()
-        self._proc = subprocess.Popen(
-            [binary, "--host", self.host, "--port", str(self.port)],
-            stderr=subprocess.PIPE,
-            text=True,
-        )
+        cmd = [binary, "--host", self.host, "--port", str(self.port)]
+        if self.journal:
+            cmd += ["--journal", self.journal]
+            for prefix in self.journal_strip_prefixes:
+                p = prefix.decode() if isinstance(prefix, bytes) else prefix
+                cmd += ["--strip-prefix", p]
+        self._proc = subprocess.Popen(cmd, stderr=subprocess.PIPE)
         try:
-            # the server prints "... listening on <host>:<port>" once bound;
-            # bound readline so a wedged child honors the timeout
-            ready, _, _ = select.select([self._proc.stderr], [], [], timeout)
-            line = self._proc.stderr.readline() if ready else ""
-            m = re.search(r"listening on \S+:(\d+)", line or "")
+            # the server prints "... listening on <host>:<port>" once bound
+            # (journal replay lines may precede it).  Read the RAW fd with a
+            # manual line buffer: select() + TextIOWrapper.readline() loses
+            # lines that arrived in the same read (buffered in Python, fd
+            # empty -> select times out even though the line is waiting).
+            deadline_t = time.monotonic() + timeout
+            fd = self._proc.stderr.fileno()
+            buf = b""
+            m = None
+            last_line = b""
+            while time.monotonic() < deadline_t and m is None:
+                ready, _, _ = select.select(
+                    [fd], [], [], max(0.0, deadline_t - time.monotonic()),
+                )
+                if not ready:
+                    break
+                chunk = os.read(fd, 4096)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf and m is None:
+                    line, _, buf = buf.partition(b"\n")
+                    last_line = line
+                    text_line = line.decode(errors="replace")
+                    jm = re.search(r"journal restored (\d+) key", text_line)
+                    if jm:
+                        self.replayed_keys = int(jm.group(1))
+                    m = re.search(r"listening on \S+:(\d+)", text_line)
             if not m:
-                raise RuntimeError(f"native store server failed to start: {line!r}")
+                raise RuntimeError(
+                    f"native store server failed to start: {last_line!r}"
+                )
             self.port = int(m.group(1))
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
@@ -79,6 +123,7 @@ class NativeStoreServer:
                     from .client import StoreClient
 
                     StoreClient("127.0.0.1", self.port, connect_timeout=1.0).close()
+                    self._drain_stderr()
                     return self
                 except Exception:  # noqa: BLE001
                     time.sleep(0.05)
@@ -86,6 +131,33 @@ class NativeStoreServer:
         except BaseException:
             self.stop()  # never leak the child holding the port
             raise
+
+    def _drain_stderr(self) -> None:
+        """The journal logs (compaction, disable) after startup; an undrained
+        64KB pipe would eventually block the server's event loop.  Raw-fd
+        reads, matching start()'s parser (the TextIOWrapper is unused)."""
+        import threading
+
+        fd = self._proc.stderr.fileno()
+
+        def drain():
+            buf = b""
+            try:
+                while True:
+                    chunk = os.read(fd, 4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while b"\n" in buf:
+                        line, _, buf = buf.partition(b"\n")
+                        log.info("native store: %s",
+                                 line.decode(errors="replace"))
+            except (OSError, ValueError):
+                pass
+
+        threading.Thread(
+            target=drain, name="tpurx-native-store-stderr", daemon=True
+        ).start()
 
     # parity with StoreServer
     start_in_thread = start

@@ -5,15 +5,22 @@ callables, synchronize reports across ranks on a fixed cadence, score each
 rank relative to the fastest peer and to its own history, and flag
 stragglers.
 
-TPU re-design: the reference's CUPTI C++ kernel tracer becomes a
-**device-section timer** — wrapped jitted callables are timed to completion
-(``block_until_ready``) so the measurement is device time, not dispatch time
-(XLA's async dispatch makes raw wall timing meaningless).  The scoring and
-reporting semantics match ``reporting.py:219-253``.
+TPU re-design: the reference's CUPTI C++ kernel tracer becomes an
+**always-on op collector** (``collector.py``) — wrapped jitted callables are
+timed dispatch→completion off-thread into native shared-memory ring buffers
+(``native/op_ring.c``, the CUPTI circular-buffer analog: constant memory,
+<1% hot-path cost, readable by the rank monitor while the trainer is
+wedged), with duty-cycled XLA-profiler captures for intra-module per-op
+attribution.  The scoring and reporting semantics match
+``reporting.py:219-253``.
 """
 
+from .collector import CompletionWatcher, OpCollector, OpRingArena
 from .detector import Detector
 from .reporting import Report, StragglerVerdict
 from .timers import SectionStats
 
-__all__ = ["Detector", "Report", "StragglerVerdict", "SectionStats"]
+__all__ = [
+    "CompletionWatcher", "Detector", "OpCollector", "OpRingArena", "Report",
+    "SectionStats", "StragglerVerdict",
+]

@@ -47,6 +47,8 @@ class Detector:
         time_interval_s: Optional[float] = None,
         gather_on_rank0: bool = True,
         history_maxlen: int = 1024,
+        always_on: bool = True,
+        profile_interval_s: float = 0.0,
     ):
         self.store = store
         self.rank = rank
@@ -62,12 +64,25 @@ class Detector:
         self._best_medians: Dict[str, float] = {}
         self._initialized = False
         self._xla_collector = None  # built on first profiled_step()
+        # always-on collector: non-blocking completion timing into native
+        # shm rings (+ optional duty-cycled per-op profiler captures)
+        self.collector = None
+        if always_on:
+            from .collector import OpCollector
+
+            self.collector = OpCollector(
+                profile_interval_s=profile_interval_s,
+                arena=None,
+            )
 
     def initialize(self) -> None:
         self._initialized = True
 
     def shutdown(self) -> None:
         self._initialized = False
+        if self.collector is not None:
+            self.collector.close()
+            self.collector = None
 
     # -- instrumentation ---------------------------------------------------
 
@@ -84,11 +99,18 @@ class Detector:
 
     def wrap_callables(self, callables: Dict[str, Callable]) -> Dict[str, Callable]:
         """Wrap jitted callables so their device time is captured
-        (reference monkey-patch profiling ``straggler.py:368``)."""
+        (reference monkey-patch profiling ``straggler.py:368``).
+
+        With the always-on collector the wrap is NON-blocking (completion is
+        observed off-thread into the native rings); the blocking DeviceTimer
+        remains the fallback."""
         out = {}
         for name, fn in callables.items():
             self.names.intern(name)
-            out[name] = self.device_timer.wrap(fn, name)
+            if self.collector is not None:
+                out[name] = self.collector.wrap(fn, name)
+            else:
+                out[name] = self.device_timer.wrap(fn, name)
         return out
 
     @contextlib.contextmanager
@@ -125,6 +147,11 @@ class Detector:
         self._round += 1
         section_stats = self.sections.stats()
         device_stats = self.device.stats()
+        if self.collector is not None:
+            # in-flight completions land before the snapshot; ring stats are
+            # readable without pausing collection (CUPTI-buffer property)
+            self.collector.flush(timeout=1.0)
+            device_stats = {**device_stats, **self.collector.stats()}
         # update own history
         for name, st in {**section_stats, **device_stats}.items():
             if st.median > 0:
@@ -172,7 +199,10 @@ class Detector:
 
     def individual_score(self) -> Optional[float]:
         """This rank's current-vs-best score (device stats preferred)."""
-        stats = self.device.stats() or self.sections.stats()
+        device = self.device.stats()
+        if self.collector is not None:
+            device = {**device, **self.collector.stats()}
+        stats = device or self.sections.stats()
         return Report.individual_scores(stats, self._best_medians)
 
     def reset(self) -> None:

@@ -1,0 +1,64 @@
+"""Loader for the repo's native helper libraries (build-on-demand).
+
+Load-first, build-on-failure: shipped binaries in git are unreviewable and
+mtime-based rebuild checks are checkout-order-dependent, so the .so files
+are NOT committed — a missing or unloadable library is compiled from its .c
+source to a process-unique temp file and atomically ``os.replace``d into
+place (concurrent ranks on one host may build simultaneously; a torn
+half-written .so must never be dlopen'd).  Callers must tolerate ``None``
+(no toolchain, no prebuilt) with a pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+from .logging import get_logger
+
+log = get_logger("native")
+
+NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native")
+)
+
+_cache: dict = {}
+
+
+def _build(src: str, path: str, extra_args: tuple = ()) -> None:
+    tmp = f"{path}.build.{os.getpid()}"
+    cc = os.environ.get("CC", "cc")
+    try:
+        subprocess.run(
+            [cc, "-O2", "-Wall", "-shared", "-fPIC", "-o", tmp, src,
+             *extra_args],
+            check=True, capture_output=True, text=True, timeout=60,
+        )
+        os.replace(tmp, path)
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_native(lib_name: str, src_name: str, extra_args: tuple = ()):
+    """Load ``native/<lib_name>``, building from ``native/<src_name>`` when
+    absent or unloadable.  Returns a ``ctypes.CDLL`` or None."""
+    if lib_name in _cache:
+        return _cache[lib_name]
+    path = os.path.join(NATIVE_DIR, lib_name)
+    src = os.path.join(NATIVE_DIR, src_name)
+    lib = None
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        try:
+            _build(src, path, extra_args)
+            lib = ctypes.CDLL(path)
+        except (OSError, subprocess.SubprocessError) as exc:
+            log.info("native %s unavailable (%s); callers fall back to "
+                     "pure Python", lib_name, exc)
+    _cache[lib_name] = lib
+    return lib
