@@ -226,3 +226,58 @@ def test_stale_connection_cannot_mutate_state(monitor):
     new.send_heartbeat()
     new.shutdown_workload_monitoring()
     old.shutdown_workload_monitoring()
+
+
+def test_post_mortem_op_rings_on_hang(monitor):
+    """On a hang kill, the monitor attaches the rank's straggler op-ring
+    arena (named shm survives the wedge) and captures top-op stats — the
+    CUPTI buffers-outlive-the-launch property."""
+    from tpu_resiliency.straggler import OpRingArena
+
+    arena = OpRingArena(max_ops=8, capacity=32)
+    if not arena.native:
+        arena.close()
+        pytest.skip("native ring library unavailable")
+    try:
+        idx = arena.intern("train_step")
+        for v in (0.1, 0.2, 0.3):
+            arena.push(idx, v)
+        killed = []
+        cfg = FaultToleranceConfig(
+            initial_rank_heartbeat_timeout=0.4,
+            rank_heartbeat_timeout=0.3,
+            workload_check_interval=0.05,
+            skip_section_response=False,
+        )
+        st, path = monitor(cfg, kill_fn=lambda pid, sig: killed.append(pid))
+        client = RankMonitorClient(cfg)
+        client.init_workload_monitoring(
+            socket_path=path,
+            rank_info=RankInfo(global_rank=0, local_rank=0, pid=4242),
+            op_ring_shm=arena.shm_name,
+        )
+        client.send_heartbeat()
+        # now "hang": no more heartbeats; the monitor should read the rings
+        # BEFORE killing
+        deadline = time.time() + 5
+        while not killed and time.time() < deadline:
+            time.sleep(0.05)
+        assert killed == [4242]
+        # the server read the rings BEFORE the kill: the HANG_DETECTED
+        # profiling event carries the captured top-op summary
+        from tpu_resiliency.utils.profiling import get_recorder
+
+        deadline = time.time() + 2
+        post = []
+        while time.time() < deadline and not post:
+            post = [
+                e for e in get_recorder().events
+                if e.get("event") == "hang_detected" and e.get("post_mortem_ops")
+            ]
+            time.sleep(0.05)
+        assert post, "server did not capture post-mortem op stats"
+        ops = post[-1]["post_mortem_ops"]
+        assert ops[0]["op"] == "train_step" and ops[0]["count"] == 3
+        client.shutdown_workload_monitoring()
+    finally:
+        arena.close()

@@ -1,6 +1,7 @@
 """Services, trace analyzer, memory logger, cycle info tests."""
 
 import json
+import os
 import threading
 import time
 import urllib.request
@@ -137,18 +138,20 @@ def test_smonsvc_watches_cycles(tmp_path, attrsvc):
     (logs / "cycle_0.log").write_text(
         "[r2] XlaRuntimeError: RESOURCE_EXHAUSTED: allocating 1GB in hbm\n"
     )
+    from tpu_resiliency.services.smonsvc import DirectoryScheduler
+
     mon = JobMonitor(
-        str(cycles), log_dir=str(logs), attrsvc_url=attrsvc, poll_interval=0.1
+        DirectoryScheduler(str(cycles), str(logs)),
+        attrsvc_url=attrsvc, poll_interval=0.1,
     )
     rep.start_cycle(0, 0, ["n0"], [], 4)
     rep.end_cycle("worker_failure", failed_ranks=[2])
-    ended = mon.poll_once()
-    assert len(ended) == 1
-    assert mon.stats["cycles_failed"] == 1
-    assert mon.stats["verdicts"].get("oom_hbm") == 1
+    mon.poll_once()
+    assert mon.totals["cycles_failed"] == 1
+    assert mon.verdicts.get("oom_hbm") == 1
     # second poll: no double counting
-    assert mon.poll_once() == []
-    assert mon.stats["cycles_observed"] == 1
+    mon.poll_once()
+    assert mon.totals["cycles_observed"] == 1
 
 
 class TestCombinedAttribution:
@@ -183,3 +186,140 @@ class TestCombinedAttribution:
         assert res.category == "suspected_device_hang"
         assert res.culprit_ranks == [1]
         assert res.should_resume is True
+
+
+# -- smonsvc fleet depth (multi-job, windows, slurm adapter, status) ---------
+
+
+def test_smonsvc_multijob_discovery_and_states(tmp_path):
+    from tpu_resiliency.services.smonsvc import (
+        JobMonitor,
+        JobState,
+        MultiJobDirectoryScheduler,
+    )
+
+    root = tmp_path / "jobs"
+    for name in ("alpha", "beta"):
+        rep = CycleInfoReporter(str(root / name / "cycles"), job_name=name)
+        (root / name / "logs").mkdir(parents=True)
+        rep.start_cycle(0, 0, ["n0"], [], 4)
+        if name == "alpha":
+            rep.end_cycle("success")
+    (root / "not-a-job").mkdir()
+
+    mon = JobMonitor(MultiJobDirectoryScheduler(str(root)), poll_interval=0.1)
+    mon.poll_once()
+    jobs = {j["job_id"]: j for j in mon.jobs_payload()}
+    assert set(jobs) == {"alpha", "beta"}
+    assert jobs["alpha"]["state"] == JobState.FINISHED.value
+    assert jobs["beta"]["state"] == JobState.RUNNING.value
+    st = mon.status()
+    assert st["jobs"]["total"] == 2
+    assert st["totals"]["jobs_seen"] == 2
+
+
+def test_smonsvc_restart_windows_and_crash_loop(tmp_path):
+    import time as _t
+
+    from tpu_resiliency.services.smonsvc import (
+        DirectoryScheduler,
+        JobMonitor,
+    )
+
+    cycles = tmp_path / "cycles"
+    rep = CycleInfoReporter(str(cycles), job_name="j")
+    mon = JobMonitor(
+        DirectoryScheduler(str(cycles)), poll_interval=0.1,
+        crash_loop_threshold_15m=3,
+    )
+    for c in range(4):
+        rep.start_cycle(c, c, ["n0"], [], 4)
+        rep.end_cycle("worker_failure", failed_ranks=[0])
+        mon.poll_once()
+    st = mon.status()
+    assert st["restarts_15m"] == 4
+    assert st["restarts_1h"] == 4
+    assert st["crash_looping"] is True
+    assert st["totals"]["cycles_failed"] == 4
+    # old events age out of the window
+    mon.windows._events.clear()
+    mon.windows.record(_t.time() - 1000)  # outside 15m, inside 1h
+    st = mon.status()
+    assert st["restarts_15m"] == 0 and st["restarts_1h"] == 1
+    assert st["crash_looping"] is False
+
+
+def test_smonsvc_gone_job_marked(tmp_path):
+    import shutil as _sh
+
+    from tpu_resiliency.services.smonsvc import (
+        JobMonitor,
+        JobState,
+        MultiJobDirectoryScheduler,
+    )
+
+    root = tmp_path / "jobs"
+    rep = CycleInfoReporter(str(root / "solo" / "cycles"), job_name="solo")
+    rep.start_cycle(0, 0, ["n0"], [], 2)
+    mon = JobMonitor(MultiJobDirectoryScheduler(str(root)), poll_interval=0.1)
+    mon.poll_once()
+    assert mon.jobs["solo"].state == JobState.RUNNING
+    _sh.rmtree(root / "solo")
+    mon.poll_once()
+    assert mon.jobs["solo"].state == JobState.GONE
+
+
+def test_smonsvc_slurm_adapter_with_fake_binaries(tmp_path, monkeypatch):
+    """SlurmScheduler drives squeue/scontrol; fake binaries on PATH emulate
+    a 2-job cluster (reference slurm.py discovery, compressed)."""
+    from tpu_resiliency.services.smonsvc import SlurmScheduler
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    (bindir / "squeue").write_text("#!/bin/sh\necho 101\necho 202\n")
+    (bindir / "scontrol").write_text(
+        "#!/bin/sh\n"
+        f"echo JobId=$4 StdOut={outdir}/job$4.out Other=x\n"
+    )
+    for b in ("squeue", "scontrol"):
+        (bindir / b).chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    sched = SlurmScheduler(user="me")
+    assert sched.available()
+    jobs = sched.discover()
+    assert [j[0] for j in jobs] == ["101", "202"]
+    # StdOut dir becomes the log dir
+    assert jobs[0][2] == str(outdir)
+    assert sched.squeue_calls == 1 and sched.scontrol_calls == 2
+
+
+def test_smonsvc_status_server_endpoints(tmp_path):
+    import urllib.request as _rq
+
+    from tpu_resiliency.services.smonsvc import (
+        DirectoryScheduler,
+        JobMonitor,
+        make_status_server,
+    )
+
+    cycles = tmp_path / "cycles"
+    rep = CycleInfoReporter(str(cycles), job_name="j")
+    rep.start_cycle(0, 0, ["n0"], [], 2)
+    mon = JobMonitor(DirectoryScheduler(str(cycles)), poll_interval=0.1)
+    mon.poll_once()
+    server = make_status_server(mon, "127.0.0.1", 0)
+    port = server.server_port
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        st = json.loads(_rq.urlopen(f"http://127.0.0.1:{port}/status").read())
+        assert st["jobs"]["total"] == 1
+        jobs = json.loads(_rq.urlopen(f"http://127.0.0.1:{port}/jobs").read())
+        assert jobs[0]["job_id"] == "default"
+        health = json.loads(_rq.urlopen(f"http://127.0.0.1:{port}/health").read())
+        assert health["status"] == "ok"
+    finally:
+        server.shutdown()

@@ -35,14 +35,40 @@ def _read_exact(stream, n: int):
     return buf
 
 
+def _set_io_priority() -> None:
+    """ionice the drain to IDLE class: checkpoint I/O runs only when nothing
+    else needs the disk, so the background write never steals IOPS from the
+    input pipeline (reference ``_set_process_qos`` io_priority analog,
+    ``async_ckpt/core.py:41-110``).  Raw ``ioprio_set`` syscall — no
+    dependency; unsupported arch/kernel is a silent no-op."""
+    klass = os.environ.get("TPURX_CKPT_WORKER_IONICE", "3")
+    if not klass:
+        return
+    import ctypes
+    import platform
+
+    syscall_nr = {"x86_64": 251, "aarch64": 30}.get(platform.machine())
+    if syscall_nr is None:
+        return
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        IOPRIO_WHO_PROCESS = 1
+        libc.syscall(syscall_nr, IOPRIO_WHO_PROCESS, 0, int(klass) << 13)
+    except (OSError, ValueError):
+        pass
+
+
 def main() -> None:
     # The writer only touches numpy+shm, but imports can pull in jax — this
     # process must never claim TPU chips from the trainer.
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # QoS: deprioritize CPU (nice) and I/O (ionice idle) so the drain yields
+    # to the trainer on both resources
     try:
         os.nice(int(os.environ.get("TPURX_CKPT_WORKER_NICE", "10")))
     except OSError:
         pass
+    _set_io_priority()
     stdin = sys.stdin.buffer
     stdout = sys.stdout.buffer
     # anything the written fns print must not corrupt the response stream

@@ -367,13 +367,19 @@ class ElasticAgent:
         self.workers = []
 
     def _workers_status(self) -> str:
-        """'running' | 'succeeded' | 'failed'
+        """'running' | 'succeeded' | 'failed' | 'none'
+
+        'none' = no workers exist (already stopped for an in-flight restart
+        or not yet started) — callers must not read an empty list as
+        success (``all()`` over ``[]`` is True) or as failure.
 
         ``restart_policy="min-healthy"`` tolerates worker exits as long as
         at least ``min_healthy_workers`` local workers remain healthy
         (running or exited 0) — for jobs with non-collective sidecar
         workers whose loss should not burn a restart cycle."""
         codes = [w.proc.poll() for w in self.workers]
+        if not codes:
+            return "none"
         failed = sum(1 for c in codes if c is not None and c != 0)
         if self.cfg.restart_policy == "min-healthy" and self.cfg.min_healthy_workers >= 0:
             healthy = len(codes) - failed
@@ -498,11 +504,6 @@ class ElasticAgent:
                 # ICI and don't need the store until the next event, so keep
                 # them alive for the rejoin window before giving up.
                 status = self._workers_status()
-                if status == "succeeded" and self._restart_in_flight is not None:
-                    # workers were already STOPPED for an in-flight restart —
-                    # the empty worker list must not read as job success;
-                    # retry the tick so _complete_restart resumes
-                    status = "restart-in-flight"
                 if status == "succeeded":
                     return "succeeded"
                 now = time.monotonic()

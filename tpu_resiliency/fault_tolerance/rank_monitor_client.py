@@ -62,7 +62,9 @@ class RankMonitorClient:
     # -- connection / init -------------------------------------------------
 
     def init_workload_monitoring(
-        self, socket_path: Optional[str] = None, rank_info: Optional[RankInfo] = None
+        self, socket_path: Optional[str] = None,
+        rank_info: Optional[RankInfo] = None,
+        op_ring_shm: Optional[str] = None,
     ) -> None:
         path = socket_path or os.environ.get(ENV_MONITOR_SOCKET)
         if not path:
@@ -79,6 +81,12 @@ class RankMonitorClient:
             "local_rank": self.rank_info.local_rank,
             "pid": self.rank_info.pid,
         }
+        # straggler op-ring arena name: lets the monitor read this rank's
+        # per-op stats POST-MORTEM while the trainer is wedged (the
+        # CUPTI-buffers-outlive-the-launch property)
+        ring = op_ring_shm or os.environ.get("TPURX_OPRING_SHM")
+        if ring:
+            init["op_ring_shm"] = ring
         if self._loaded_state:
             init["hb_timeouts"] = self._loaded_state.get("hb_timeouts")
             init["section_timeouts"] = self._loaded_state.get("section_timeouts")

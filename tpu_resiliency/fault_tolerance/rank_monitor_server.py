@@ -60,6 +60,8 @@ class _RankState:
     # id of the connection that INITed this state: a lingering old worker's
     # late EOF must not clobber the state of the new cycle's worker
     owner_conn: Optional[int] = None
+    # straggler op-ring shm name: readable post-mortem while the rank hangs
+    op_ring_shm: Optional[str] = None
 
     def reset(self) -> None:
         self.pid = None
@@ -70,6 +72,7 @@ class _RankState:
         self.last_section_activity = None
         self.seen_section_msgs = False
         self.owner_conn = None
+        self.op_ring_shm = None
 
 
 class RankMonitorServer:
@@ -124,14 +127,45 @@ class RankMonitorServer:
             "hang detected (cycle=%s rank=%s pid=%s): %s — terminating rank",
             self.cycle, self.state.rank, pid, reason,
         )
+        post_mortem_ops = self._read_op_rings_post_mortem()
         record_event(
             ProfilingEvent.HANG_DETECTED,
             rank=self.state.rank, reason=reason, cycle=self.cycle,
+            **({"post_mortem_ops": post_mortem_ops} if post_mortem_ops else {}),
         )
         self._hang_detected = True
         if pid:
             self._kill_fn(pid, self.cfg.term_signal)
         self.state.reset()
+
+    def _read_op_rings_post_mortem(self) -> Optional[list]:
+        """BEFORE killing a hung rank, attach its straggler op-ring arena
+        (shared memory survives the wedge) and capture the top ops by total
+        time — which op the rank was spending time in when it stalled is
+        exactly the CUPTI-buffers post-mortem the reference gets from its
+        persistent kernel buffers."""
+        if not self.state.op_ring_shm:
+            return None
+        try:
+            from ..straggler.collector import OpRingArena
+
+            arena = OpRingArena.attach(self.state.op_ring_shm)
+            try:
+                stats = arena.stats()
+            finally:
+                arena.close()
+            top = sorted(stats.values(), key=lambda s: -s.total)[:5]
+            summary = [
+                {"op": s.name, "total_s": round(s.total, 4),
+                 "median_s": round(s.median, 6), "count": s.count}
+                for s in top
+            ]
+            if summary:
+                log.error("post-mortem op stats (top by total): %s", summary)
+            return summary or None
+        except Exception as exc:  # noqa: BLE001 - never block the kill path
+            log.warning("post-mortem ring read failed: %s", exc)
+            return None
 
     # -- timeout checks (reference `_periodic_rank_check` :545) ------------
 
@@ -248,6 +282,7 @@ class RankMonitorServer:
             st.rank = msg.get("rank")
             st.connected_at = now
             st.owner_conn = conn_id
+            st.op_ring_shm = msg.get("op_ring_shm")
             # restore persisted calculated timeouts if client carries them
             if msg.get("hb_timeouts"):
                 restored = heartbeat_timeouts_from_dict(msg["hb_timeouts"])
@@ -403,7 +438,10 @@ class RankMonitorServer:
             daemon=True,
         )
         proc.start()
-        if not started_evt.wait(timeout=15):
+        # spawn boots a fresh interpreter and the sitecustomize imports jax
+        # into it — budget the handshake like MonitorProcess does (60s), not
+        # the fork-era 15s
+        if not started_evt.wait(timeout=60):
             proc.terminate()
             raise RuntimeError("rank monitor server failed to start")
         return proc, parent_conn

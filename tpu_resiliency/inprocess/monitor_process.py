@@ -151,9 +151,14 @@ class MonitorSharedState:
 
 
 def _endpoint_from_factory(store_factory) -> Optional[Tuple[str, int]]:
-    """Best-effort (host, port) introspection so the exec'd monitor reaches
-    the SAME store: StoreFactory and bound StoreClient instances expose
-    host/port; opaque callables fall back to the launcher env."""
+    """(host, port) resolution so the exec'd monitor reaches the SAME store.
+
+    Attribute introspection first (StoreFactory / bound StoreClient expose
+    host/port); opaque callables (lambdas, closures — which the old
+    fork-based monitor inherited for free) are CALLED once: any factory
+    returning a StoreClient yields a connected client whose host/port we
+    read and close.  Only factories returning host/port-less objects fall
+    through to the launcher env."""
     host = getattr(store_factory, "host", None)
     port = getattr(store_factory, "port", None)
     if isinstance(host, str) and isinstance(port, int):
@@ -161,6 +166,22 @@ def _endpoint_from_factory(store_factory) -> Optional[Tuple[str, int]]:
     self_obj = getattr(store_factory, "__self__", None)
     if self_obj is not None:
         return _endpoint_from_factory(self_obj)
+    try:
+        client = store_factory()
+    except Exception as exc:  # noqa: BLE001
+        log.warning("store factory probe failed (%s); monitor will use "
+                    "TPURX_STORE_* env", exc)
+        return None
+    try:
+        host = getattr(client, "host", None)
+        port = getattr(client, "port", None)
+        if isinstance(host, str) and isinstance(port, int):
+            return host, port
+    finally:
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001
+            pass
     return None
 
 
@@ -235,11 +256,14 @@ class MonitorProcess:
             if self.shared.ready:
                 return self
             if self._proc.poll() is not None:
-                log.error(
-                    "monitor process for rank %s exited rc=%s at startup",
-                    self.rank, self._proc.returncode,
+                # hang protection was REQUESTED; running without it silently
+                # would leave a wedged rank undetected for the whole job
+                raise RuntimeError(
+                    f"monitor process for rank {self.rank} exited "
+                    f"rc={self._proc.returncode} at startup — store "
+                    "endpoint unreachable from the monitor? (pass a "
+                    "StoreFactory or set TPURX_STORE_*)"
                 )
-                return self
             time.sleep(0.02)
         log.warning(
             "monitor process for rank %s not ready after 60s — hang "

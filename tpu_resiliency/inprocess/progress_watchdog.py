@@ -47,8 +47,10 @@ def _load_native_stamper():
     toolchain or loader can't deliver it (fallback: ctypes callback)."""
     from ..utils.native import load_native
 
-    lib = load_native("libtpurx-pending.so", "pending_stamp.c")
-    if lib is not None and not hasattr(lib.tpurx_schedule_stamp, "argtypes_set"):
+    lib = load_native("libtpurx-pending.so", "pending_stamp.c",
+                      required_symbols=("tpurx_schedule_stamp",))
+    if lib is not None:
+        # idempotent re-assignment: load_native caches the CDLL per process
         lib.tpurx_schedule_stamp.argtypes = [ctypes.c_void_p]
         lib.tpurx_schedule_stamp.restype = ctypes.c_int
     return lib

@@ -72,6 +72,7 @@ class QuorumTripwire:
         interval: float = 0.01,
         auto_beat_interval: Optional[float] = 0.002,
         calibrate: bool = True,
+        min_budget_ms: float = 5.0,
         use_pallas: Optional[bool] = None,
         fetch_workers: int = 0,
         on_trip: Optional[Callable[[int, int], None]] = None,
@@ -80,6 +81,7 @@ class QuorumTripwire:
         self.ops = ops
         self.rank = rank
         self.calibrate = calibrate
+        self.min_budget_ms = min_budget_ms
         self.on_trip = on_trip
         self._iteration = 0
         self._fired_iteration: Optional[int] = None
@@ -105,7 +107,7 @@ class QuorumTripwire:
         self._iteration = iteration
         self._fired_iteration = None
         if self.calibrate:
-            self.monitor.calibrate()
+            self.monitor.calibrate(min_budget_ms=self.min_budget_ms)
         self.monitor.start()
         return self
 

@@ -74,6 +74,7 @@ class Wrapper:
         quorum_interval: float = 0.01,
         quorum_auto_beat_interval: Optional[float] = 0.002,
         quorum_calibrate: bool = True,
+        quorum_min_budget_ms: float = 5.0,
     ):
         self.store_factory = store_factory or store_from_env
         self.group = group
@@ -100,6 +101,7 @@ class Wrapper:
         # training mesh to enable
         self.quorum_mesh = quorum_mesh
         self.quorum_budget_ms = quorum_budget_ms
+        self.quorum_min_budget_ms = quorum_min_budget_ms
         self.quorum_interval = quorum_interval
         self.quorum_auto_beat_interval = quorum_auto_beat_interval
         self.quorum_calibrate = quorum_calibrate
@@ -256,6 +258,7 @@ class CallWrapper:
                 interval=w.quorum_interval,
                 auto_beat_interval=w.quorum_auto_beat_interval,
                 calibrate=w.quorum_calibrate,
+                min_budget_ms=w.quorum_min_budget_ms,
             ).start(state.iteration)
 
         while True:

@@ -265,6 +265,7 @@ class QuorumMonitor:
         self._beater: Optional[threading.Thread] = None
         self.last_max_age: Optional[int] = None
         self.last_stale_device: Optional[int] = None
+        self.last_calibration_p99_ms: Optional[float] = None
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
@@ -313,7 +314,19 @@ class QuorumMonitor:
         (beat jitter + scheduling noise) instead of a safety factor over the
         beat period alone — ages already embed every real-world delay, so the
         budget is as tight as the platform allows without false positives.
-        Runs ``n_ticks`` blocking ticks, sets and returns ``budget_ms``."""
+        Runs ``n_ticks`` blocking ticks, sets and returns ``budget_ms``.
+
+        The floor physics (BASELINE north-star accounting): in XLA's
+        execution model a collective observes stamps only at dispatch, so
+        end-to-end detection = budget + dispatch cadence + one readback.
+        The budget itself cannot go below the observed p99 healthy age
+        times ``safety`` without false positives — and that p99 is
+        GIL-scheduling jitter of the Python beater thread, which is
+        load-bearing: a C beater would keep stamping through a GIL-wedged
+        interpreter and mask exactly the hangs this exists to catch.
+        ``min_budget_ms`` is an operator floor, not a physical one; set it
+        to ~1 to let the calibration find the platform's true floor (the
+        measured p99 is kept in ``last_calibration_p99_ms``)."""
         self._start_beater()
         ages = []
         for _ in range(max(3, n_ticks)):
@@ -325,6 +338,7 @@ class QuorumMonitor:
                 self.budget_ms = saved
         ages_arr = np.asarray(sorted(ages), dtype=np.float64)
         p99 = float(ages_arr[min(len(ages_arr) - 1, int(0.99 * len(ages_arr)))])
+        self.last_calibration_p99_ms = p99
         self.budget_ms = max(min_budget_ms, safety * p99 + margin_ms)
         return self.budget_ms
 

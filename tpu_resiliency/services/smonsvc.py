@@ -1,101 +1,364 @@
-"""Job monitor service.
+"""Job monitor service (fleet watcher).
 
-Reference analog: ``services/smonsvc/`` (~1900 LoC): polls the scheduler,
-watches job cycles, submits failed-cycle logs to the attribution service,
-keeps restart statistics, and serves status over HTTP.
+Reference analog: ``services/smonsvc/`` (~2300 LoC: SLURM discovery,
+per-job state models, attrsvc submission, stats, status server).  The
+re-design is scheduler-agnostic at the core — jobs are watched through
+their **cycle-info directories** (written by the launcher's
+``CycleInfoReporter``) plus per-cycle logs, artifacts every deployment has —
+with scheduler adapters layered on top for discovery:
 
-Scheduler-agnostic re-design: the monitor watches a job's **cycle-info
-directory** (written by the launcher's :class:`CycleInfoReporter`) plus its
-per-cycle logs — artifacts every deployment has, whether the job runs under
-SLURM, GKE, or xmanager.  On each ended cycle it (optionally) POSTs the
-cycle log to attrsvc and aggregates verdicts.
+- :class:`DirectoryScheduler` — one configured job (the round-1 behavior).
+- :class:`MultiJobDirectoryScheduler` — every subdirectory of a root that
+  contains cycle-info files is a job; jobs appear/disappear as launchers
+  start/stop (works under SLURM, GKE, xmanager alike — no scheduler API).
+- :class:`SlurmScheduler` — squeue/scontrol discovery (reference
+  ``slurm.py`` compressed): running jobs become tracked jobs, their StdOut
+  paths become log paths.  Degrades to unavailable when slurm isn't
+  installed.
+
+Per-job state rides :class:`JobRecord` (reference ``models.py``); restart
+statistics are **windowed** (15 min / 1 h / 24 h sliding counts + a
+crash-loop flag when the 15-minute rate crosses a threshold — reference
+stats.py keeps cumulative and windowed counters).  The status server serves
+``/status`` (global + windows), ``/jobs`` (per-job list), and ``/health``
+(503 when the poll thread has stalled).
 
     python -m tpu_resiliency.services.smonsvc \
-        --cycle-info-dir /logs/cycles --log-dir /logs/percycle \
-        [--attrsvc http://host:8950] [--port 8960]
+        --jobs-root /logs/jobs [--attrsvc http://host:8950] [--port 8960]
+    python -m tpu_resiliency.services.smonsvc \
+        --cycle-info-dir /logs/cycles --log-dir /logs/percycle
+    python -m tpu_resiliency.services.smonsvc --slurm --slurm-user $USER
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
+import dataclasses
+import enum
 import glob
 import json
 import os
+import shutil
+import subprocess
 import threading
 import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import get_logger, setup_logger
 
 log = get_logger("smonsvc")
 
 
+class JobState(enum.Enum):
+    RUNNING = "RUNNING"
+    IDLE = "IDLE"          # no cycle activity past the idle threshold
+    FINISHED = "FINISHED"  # last cycle ended with success
+    FAILED = "FAILED"      # last cycle ended non-success and nothing since
+    GONE = "GONE"          # scheduler/dir no longer lists it
+
+
+@dataclasses.dataclass
+class JobRecord:
+    job_id: str
+    cycle_info_dir: Optional[str] = None
+    log_dir: Optional[str] = None
+    state: JobState = JobState.RUNNING
+    last_cycle: Optional[int] = None
+    last_end_reason: Optional[str] = None
+    last_seen: float = 0.0
+    cycles_observed: int = 0
+    cycles_failed: int = 0
+    verdicts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    logs_submitted: int = 0
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["state"] = self.state.value
+        return d
+
+
+class RestartWindows:
+    """Sliding restart-rate windows (reference stats.py keeps cumulative and
+    recent counters; here: 15 min / 1 h / 24 h counts + crash-loop flag)."""
+
+    WINDOWS = (("15m", 900.0), ("1h", 3600.0), ("24h", 86400.0))
+
+    def __init__(self, crash_loop_threshold_15m: int = 5):
+        self._events: collections.deque = collections.deque(maxlen=4096)
+        self.crash_loop_threshold_15m = crash_loop_threshold_15m
+
+    def record(self, t: Optional[float] = None) -> None:
+        self._events.append(t if t is not None else time.time())
+
+    def snapshot(self) -> Dict:
+        now = time.time()
+        out = {}
+        for name, span in self.WINDOWS:
+            out[f"restarts_{name}"] = sum(
+                1 for t in self._events if t > now - span
+            )
+        out["crash_looping"] = (
+            out["restarts_15m"] >= self.crash_loop_threshold_15m
+        )
+        return out
+
+
+# -- scheduler adapters ------------------------------------------------------
+
+
+class DirectoryScheduler:
+    """One configured job: the classic single cycle-info dir."""
+
+    def __init__(self, cycle_info_dir: str, log_dir: Optional[str] = None,
+                 job_id: str = "default"):
+        self.cycle_info_dir = cycle_info_dir
+        self.log_dir = log_dir
+        self.job_id = job_id
+
+    def discover(self) -> List[Tuple[str, str, Optional[str]]]:
+        """Returns [(job_id, cycle_info_dir, log_dir)]."""
+        return [(self.job_id, self.cycle_info_dir, self.log_dir)]
+
+
+class MultiJobDirectoryScheduler:
+    """Every subdirectory of ``root`` holding cycle-info files is a job.
+
+    Convention: ``<root>/<job_id>/cycles/cycle_info.*.json`` with per-cycle
+    logs at ``<root>/<job_id>/logs`` (both locations also accepted flat in
+    the job dir).  Scheduler-agnostic multi-job discovery — launchers simply
+    point ``cycle_info_dir`` under a shared root.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def discover(self) -> List[Tuple[str, str, Optional[str]]]:
+        jobs = []
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return jobs
+        for name in names:
+            jdir = os.path.join(self.root, name)
+            if not os.path.isdir(jdir):
+                continue
+            for cdir in (os.path.join(jdir, "cycles"), jdir):
+                if glob.glob(os.path.join(cdir, "cycle_info.*.json")):
+                    ldir = os.path.join(jdir, "logs")
+                    jobs.append(
+                        (name, cdir, ldir if os.path.isdir(ldir) else None)
+                    )
+                    break
+        return jobs
+
+
+class SlurmScheduler:
+    """squeue/scontrol discovery (reference ``slurm.py`` compressed).
+
+    Jobs = the user's RUNNING slurm jobs; each job's StdOut becomes its log
+    path (submitted to attrsvc on failure) and cycle info is looked for
+    next to it (``<stdout dir>/cycles``).  All slurm calls are
+    subprocess-guarded: a host without slurm reports unavailable instead of
+    crashing the monitor.
+    """
+
+    def __init__(self, user: Optional[str] = None, partition: Optional[str] = None):
+        self.user = user
+        self.partition = partition
+        self.squeue_calls = 0
+        self.scontrol_calls = 0
+        self.errors = 0
+        # StdOut is fixed for a job's life: one scontrol per job, ever —
+        # uncached, poll time would scale with fleet size and trip /health
+        self._stdout_cache: Dict[str, Optional[str]] = {}
+
+    def available(self) -> bool:
+        return shutil.which("squeue") is not None
+
+    def _run(self, cmd: List[str]) -> Optional[str]:
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30,
+            )
+            if out.returncode != 0:
+                self.errors += 1
+                return None
+            return out.stdout
+        except (OSError, subprocess.SubprocessError):
+            self.errors += 1
+            return None
+
+    def running_jobs(self) -> List[str]:
+        cmd = ["squeue", "-h", "-t", "RUNNING", "-o", "%i"]
+        if self.user:
+            cmd += ["-u", self.user]
+        if self.partition:
+            cmd += ["-p", self.partition]
+        self.squeue_calls += 1
+        out = self._run(cmd)
+        if out is None:
+            return []
+        return [line.strip() for line in out.splitlines() if line.strip()]
+
+    def stdout_path(self, job_id: str) -> Optional[str]:
+        if job_id in self._stdout_cache:
+            return self._stdout_cache[job_id]
+        self.scontrol_calls += 1
+        out = self._run(["scontrol", "show", "job", job_id])
+        if out is None:
+            return None
+        path = None
+        for token in out.split():
+            if token.startswith("StdOut="):
+                path = token[len("StdOut="):] or None
+                break
+        self._stdout_cache[job_id] = path
+        return path
+
+    def discover(self) -> List[Tuple[str, str, Optional[str]]]:
+        jobs = []
+        for job_id in self.running_jobs():
+            stdout = self.stdout_path(job_id)
+            cdir = None
+            ldir = None
+            if stdout:
+                base = os.path.dirname(stdout)
+                cand = os.path.join(base, "cycles")
+                cdir = cand if os.path.isdir(cand) else base
+                ldir = base
+            jobs.append((job_id, cdir or "", ldir))
+        return jobs
+
+
+# -- the monitor -------------------------------------------------------------
+
+
 class JobMonitor:
     def __init__(
         self,
-        cycle_info_dir: str,
-        log_dir: Optional[str] = None,
+        scheduler,
         attrsvc_url: Optional[str] = None,
         poll_interval: float = 5.0,
+        idle_threshold: float = 600.0,
+        crash_loop_threshold_15m: int = 5,
     ):
-        self.cycle_info_dir = cycle_info_dir
-        self.log_dir = log_dir
+        self.scheduler = scheduler
         self.attrsvc_url = attrsvc_url.rstrip("/") if attrsvc_url else None
         self.poll_interval = poll_interval
+        self.idle_threshold = idle_threshold
+        self.jobs: Dict[str, JobRecord] = {}
+        self.windows = RestartWindows(crash_loop_threshold_15m)
         self._seen_ended: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.stats: Dict = {
-            "cycles_observed": 0,
-            "cycles_failed": 0,
-            "verdicts": {},          # category -> count
-            "last_cycle": None,
-            "restart_timestamps": [],
+        self.last_poll_at: float = 0.0
+        self.polls = 0
+        self.poll_errors = 0
+        # cumulative since process start (reference job_totals)
+        self.totals = {
+            "jobs_seen": 0, "cycles_observed": 0, "cycles_failed": 0,
+            "logs_submitted": 0,
         }
+        self.verdicts: Dict[str, int] = {}
         self.lock = threading.Lock()
 
     # -- polling -----------------------------------------------------------
 
-    def poll_once(self) -> List[Dict]:
-        """Scan cycle info files; process newly-ended cycles."""
+    def poll_once(self) -> None:
+        discovered = self.scheduler.discover()
+        now = time.time()
+        with self.lock:
+            live_ids = set()
+            for job_id, cdir, ldir in discovered:
+                live_ids.add(job_id)
+                rec = self.jobs.get(job_id)
+                if rec is None:
+                    rec = self.jobs[job_id] = JobRecord(
+                        job_id=job_id, cycle_info_dir=cdir, log_dir=ldir,
+                    )
+                    self.totals["jobs_seen"] += 1
+                rec.cycle_info_dir = cdir or rec.cycle_info_dir
+                rec.log_dir = ldir or rec.log_dir
+                rec.last_seen = now
+                if rec.state == JobState.GONE:
+                    rec.state = JobState.RUNNING  # rediscovered (transient
+                    # discovery failure or a requeued job) — revive
+            for job_id, rec in self.jobs.items():
+                if job_id not in live_ids and rec.state != JobState.GONE:
+                    rec.state = JobState.GONE
+        for job_id, cdir, ldir in discovered:
+            if cdir:
+                self._scan_job(job_id, cdir, ldir)
+        self.last_poll_at = time.time()
+        self.polls += 1
+
+    def _scan_job(self, job_id: str, cdir: str, ldir: Optional[str]) -> None:
         ended = []
-        for path in sorted(glob.glob(os.path.join(self.cycle_info_dir, "cycle_info.*.json"))):
+        newest_activity = 0.0
+        has_open_cycle = False  # derived in the same pass: no second read
+        for path in sorted(glob.glob(os.path.join(cdir, "cycle_info.*.json"))):
             try:
+                newest_activity = max(newest_activity, os.path.getmtime(path))
                 with open(path) as f:
                     info = json.load(f)
             except (OSError, json.JSONDecodeError):
                 continue
-            key = (info.get("job"), info.get("cycle"))
+            if not info.get("ended_at"):
+                has_open_cycle = True
+            rec = self.jobs[job_id]
             with self.lock:
-                self.stats["last_cycle"] = info.get("cycle")
+                cyc = info.get("cycle")
+                if cyc is not None and (rec.last_cycle is None or cyc >= rec.last_cycle):
+                    rec.last_cycle = cyc
+            key = (job_id, info.get("job"), info.get("cycle"))
             if info.get("ended_at") and key not in self._seen_ended:
                 self._seen_ended.add(key)
                 ended.append(info)
+        rec = self.jobs[job_id]
         for info in ended:
-            self._process_ended_cycle(info)
-        return ended
-
-    def _process_ended_cycle(self, info: Dict) -> None:
+            self._process_ended_cycle(rec, info, ldir)
         with self.lock:
-            self.stats["cycles_observed"] += 1
-            if info.get("end_reason") != "success":
-                self.stats["cycles_failed"] += 1
-                self.stats["restart_timestamps"].append(info.get("ended_at"))
-                self.stats["restart_timestamps"] = self.stats["restart_timestamps"][-100:]
+            if rec.state != JobState.GONE:
+                if rec.last_end_reason == "success" and not has_open_cycle:
+                    rec.state = JobState.FINISHED
+                elif newest_activity and time.time() - newest_activity > self.idle_threshold:
+                    rec.state = (
+                        JobState.FAILED
+                        if rec.last_end_reason not in (None, "success")
+                        else JobState.IDLE
+                    )
+                else:
+                    rec.state = JobState.RUNNING
+
+    def _process_ended_cycle(self, rec: JobRecord, info: Dict,
+                             ldir: Optional[str]) -> None:
+        reason = info.get("end_reason")
+        with self.lock:
+            rec.cycles_observed += 1
+            rec.last_end_reason = reason
+            self.totals["cycles_observed"] += 1
+            if reason != "success":
+                rec.cycles_failed += 1
+                self.totals["cycles_failed"] += 1
+                self.windows.record(info.get("ended_at") or time.time())
         log.info(
-            "cycle %s ended: %s (failed ranks %s)",
-            info.get("cycle"), info.get("end_reason"), info.get("failed_ranks"),
+            "[%s] cycle %s ended: %s (failed ranks %s)",
+            rec.job_id, info.get("cycle"), reason, info.get("failed_ranks"),
         )
-        if self.attrsvc_url and self.log_dir:
-            log_path = os.path.join(self.log_dir, f"cycle_{info.get('cycle')}.log")
+        if reason != "success" and self.attrsvc_url and ldir:
+            log_path = os.path.join(ldir, f"cycle_{info.get('cycle')}.log")
             if os.path.exists(log_path):
                 verdict = self._submit_to_attrsvc(log_path)
-                if verdict:
-                    with self.lock:
+                with self.lock:
+                    rec.logs_submitted += 1
+                    self.totals["logs_submitted"] += 1
+                    if verdict:
                         cat = verdict.get("category", "unknown")
-                        self.stats["verdicts"][cat] = self.stats["verdicts"].get(cat, 0) + 1
+                        rec.verdicts[cat] = rec.verdicts.get(cat, 0) + 1
+                        self.verdicts[cat] = self.verdicts.get(cat, 0) + 1
 
     def _submit_to_attrsvc(self, log_path: str) -> Optional[Dict]:
         try:
@@ -110,6 +373,45 @@ class JobMonitor:
             log.warning("attrsvc submission failed: %s", exc)
             return None
 
+    # -- status payloads ----------------------------------------------------
+
+    def status(self) -> Dict:
+        with self.lock:
+            states = collections.Counter(
+                r.state.value for r in self.jobs.values()
+            )
+            payload = {
+                "jobs": {"total": len(self.jobs), **states},
+                "totals": dict(self.totals),
+                "verdicts": dict(self.verdicts),
+                **self.windows.snapshot(),
+                "polls": self.polls,
+                "poll_errors": self.poll_errors,
+                "last_poll_age_s": (
+                    round(time.time() - self.last_poll_at, 1)
+                    if self.last_poll_at else None
+                ),
+            }
+        sched = self.scheduler
+        if isinstance(sched, SlurmScheduler):
+            payload["slurm"] = {
+                "available": sched.available(),
+                "squeue_calls": sched.squeue_calls,
+                "scontrol_calls": sched.scontrol_calls,
+                "errors": sched.errors,
+            }
+        return payload
+
+    def jobs_payload(self) -> List[Dict]:
+        with self.lock:
+            return [r.to_dict() for r in self.jobs.values()]
+
+    def healthy(self) -> bool:
+        """The poll thread is the service; a stalled loop is an outage."""
+        if not self.last_poll_at:
+            return self._thread is not None and self._thread.is_alive()
+        return time.time() - self.last_poll_at < max(30.0, 4 * self.poll_interval)
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "JobMonitor":
@@ -118,11 +420,13 @@ class JobMonitor:
         return self
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.poll_interval):
+        while not self._stop.is_set():
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001
+                self.poll_errors += 1
                 log.exception("poll failed")
+            self._stop.wait(self.poll_interval)
 
     def stop(self) -> None:
         self._stop.set()
@@ -135,22 +439,27 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
         def log_message(self, fmt, *args):
             log.debug("http: " + fmt, *args)
 
+        def _send(self, code: int, obj) -> None:
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
         def do_GET(self):
-            if self.path in ("/status", "/health"):
-                with monitor.lock:
-                    stats = dict(monitor.stats)
-                    ts = stats.get("restart_timestamps") or []
-                    recent = [t for t in ts if t and t > time.time() - 3600]
-                    stats["restarts_last_hour"] = len(recent)
-                    payload = json.dumps(stats).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-            else:
-                self.send_response(404)
-                self.end_headers()
+            if self.path == "/status":
+                return self._send(200, monitor.status())
+            if self.path == "/jobs":
+                return self._send(200, monitor.jobs_payload())
+            if self.path == "/health":
+                ok = monitor.healthy()
+                return self._send(
+                    200 if ok else 503,
+                    {"status": "ok" if ok else "stalled"},
+                )
+            self.send_response(404)
+            self.end_headers()
 
     server = ThreadingHTTPServer((host, port), Handler)
     log.info("smonsvc status on %s:%s", host, server.server_port)
@@ -160,15 +469,35 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
 def main(argv=None) -> None:
     setup_logger()
     p = argparse.ArgumentParser(prog="tpurx-smonsvc")
-    p.add_argument("--cycle-info-dir", required=True)
+    p.add_argument("--cycle-info-dir", default=None,
+                   help="single-job mode: the job's cycle-info directory")
     p.add_argument("--log-dir", default=None)
+    p.add_argument("--jobs-root", default=None,
+                   help="multi-job mode: root of <job_id>/{cycles,logs} trees")
+    p.add_argument("--slurm", action="store_true",
+                   help="discover jobs from squeue/scontrol")
+    p.add_argument("--slurm-user", default=None)
+    p.add_argument("--slurm-partition", default=None)
     p.add_argument("--attrsvc", default=None, help="attribution service URL")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8960)
     p.add_argument("--poll-interval", type=float, default=5.0)
+    p.add_argument("--crash-loop-threshold", type=int, default=5,
+                   help="restarts in 15 min that flag crash_looping")
     args = p.parse_args(argv)
+    if args.slurm:
+        scheduler = SlurmScheduler(args.slurm_user, args.slurm_partition)
+        if not scheduler.available():
+            p.error("--slurm requested but squeue is not on PATH")
+    elif args.jobs_root:
+        scheduler = MultiJobDirectoryScheduler(args.jobs_root)
+    elif args.cycle_info_dir:
+        scheduler = DirectoryScheduler(args.cycle_info_dir, args.log_dir)
+    else:
+        p.error("one of --cycle-info-dir, --jobs-root, --slurm is required")
     monitor = JobMonitor(
-        args.cycle_info_dir, args.log_dir, args.attrsvc, args.poll_interval
+        scheduler, args.attrsvc, args.poll_interval,
+        crash_loop_threshold_15m=args.crash_loop_threshold,
     ).start()
     server = make_status_server(monitor, args.host, args.port)
     try:

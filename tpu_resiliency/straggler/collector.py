@@ -90,7 +90,14 @@ class _Stats(ctypes.Structure):
 
 
 def _load_ring_lib():
-    lib = load_native("libtpurx-opring.so", "op_ring.c", extra_args=("-lm",))
+    lib = load_native(
+        "libtpurx-opring.so", "op_ring.c", extra_args=("-lm",),
+        required_symbols=(
+            "tpurx_ring_arena_size", "tpurx_ring_init", "tpurx_ring_intern",
+            "tpurx_ring_push", "tpurx_ring_add_drop", "tpurx_ring_n_ops",
+            "tpurx_ring_name", "tpurx_ring_stats",
+        ),
+    )
     if lib is None:
         return None
     lib.tpurx_ring_arena_size.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
@@ -142,6 +149,9 @@ class OpRingArena:
         self._shm = None
         self._fallback: Optional[Dict[str, collections.deque]] = None
         self._fallback_drops: Dict[str, int] = {}
+        self._fallback_names: Dict[int, str] = {}  # idx -> name (O(1) push)
+        self._closed = False
+        self.overflow_drops = 0  # samples for ops beyond max_ops
         if self._lib is None:
             self._fallback = {}
             self.shm_name = None
@@ -175,48 +185,64 @@ class OpRingArena:
         idx = self._idx.get(name)
         if idx is not None:
             return idx
+        if self._closed:
+            return -1
         with self._intern_lock:
             idx = self._idx.get(name)
             if idx is not None:
                 return idx
             if self._fallback is not None:
-                idx = len(self._idx)
+                if len(self._fallback) >= self.max_ops:
+                    self._idx[name] = -1
+                    return -1  # same bounded-by-design contract as native
+                idx = len(self._fallback)
                 self._fallback[name] = collections.deque(maxlen=self.capacity)
                 self._fallback_drops[name] = 0
+                self._fallback_names[idx] = name
             else:
                 idx = self._lib.tpurx_ring_intern(
                     self._base, name.encode()[: 63]
                 )
-                if idx < 0:
-                    return -1  # arena full: drop silently, bounded by design
+            if idx < 0:
+                # arena full: cache the verdict so later pushes for this
+                # name don't rescan all slots in C per sample
+                self._idx[name] = -1
+                return -1
             self._idx[name] = idx
             return idx
 
     def push(self, idx_or_name, duration_s: float) -> None:
+        if self._closed:
+            return
         if isinstance(idx_or_name, str):
             idx_or_name = self.intern(idx_or_name)
+        if idx_or_name is None or idx_or_name < 0:
+            self.overflow_drops += 1  # arena full: visible, not silent
+            return
         if self._fallback is not None:
-            for name, i in self._idx.items():
-                if i == idx_or_name:
-                    self._fallback[name].append(duration_s)
-                    return
+            name = self._fallback_names.get(idx_or_name)
+            if name is not None:
+                self._fallback[name].append(duration_s)
             return
         self._lib.tpurx_ring_push(
             self._base, idx_or_name, ctypes.c_float(duration_s)
         )
 
     def add_drop(self, idx: int) -> None:
+        if self._closed or idx is None or idx < 0:
+            return
         if self._fallback is not None:
-            for name, i in self._idx.items():
-                if i == idx:
-                    self._fallback_drops[name] += 1
-                    return
+            name = self._fallback_names.get(idx)
+            if name is not None:
+                self._fallback_drops[name] += 1
             return
         self._lib.tpurx_ring_add_drop(self._base, idx)
 
     def stats(self) -> Dict[str, SectionStats]:
         """Per-op stats over each ring's current window — non-quiescing:
         the writer keeps pushing while this reads."""
+        if self._closed:
+            return {}
         if self._fallback is not None:
             return {
                 name: SectionStats.from_samples(name, list(buf))
@@ -239,8 +265,13 @@ class OpRingArena:
         return out
 
     def drops(self) -> Dict[str, int]:
+        if self._closed:
+            return {}
+        out_extra = (
+            {"__overflow__": self.overflow_drops} if self.overflow_drops else {}
+        )
         if self._fallback is not None:
-            return dict(self._fallback_drops)
+            return {**dict(self._fallback_drops), **out_extra}
         out = {}
         n = int(self._lib.tpurx_ring_n_ops(self._base))
         buf = ctypes.create_string_buffer(64)
@@ -253,6 +284,7 @@ class OpRingArena:
         return out
 
     def close(self) -> None:
+        self._closed = True
         if self._shm is not None:
             # ctypes from_buffer pins the mmap — drop our pointer first
             self._base = None
@@ -327,11 +359,17 @@ class CompletionWatcher:
                 with self._inflight_lock:
                     self._inflight -= 1
 
-    def stop(self) -> None:
+    def stop(self) -> bool:
+        """Returns True when the thread actually exited — the caller must
+        NOT unmap the arena under a still-running feeder."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+            if t.is_alive():
+                return False
             self._thread = None
+        return True
 
 
 class OpCollector:
@@ -466,8 +504,20 @@ class OpCollector:
 
     def close(self) -> None:
         self.flush(timeout=0.5)  # drain while the watcher is still alive
-        self.watcher.stop()
-        self.arena.close()
+        stopped = self.watcher.stop()
+        parse_t = self._parse_pool
+        parsing = parse_t is not None and parse_t.is_alive()
+        if stopped and not parsing:
+            self.arena.close()
+        else:
+            # a wedged fetch (the exact hung-device scenario this module
+            # exists for) or an in-flight trace parse may still push:
+            # unmapping now would SIGSEGV the trainer.  Leak the segment —
+            # the shm janitor reaps it; a leak beats a crash.
+            log.warning(
+                "op collector closing with a live feeder thread — leaving "
+                "the ring arena mapped (janitor reclaims the segment)"
+            )
 
 
 def _first_array_leaf(tree):

@@ -68,12 +68,19 @@ class Detector:
         # shm rings (+ optional duty-cycled per-op profiler captures)
         self.collector = None
         if always_on:
+            import os
+
             from .collector import OpCollector
 
             self.collector = OpCollector(
                 profile_interval_s=profile_interval_s,
                 arena=None,
             )
+            # publish the arena name so a RankMonitorClient constructed later
+            # in this process forwards it on INIT — the monitor can then read
+            # this rank's op stats post-mortem while it hangs
+            if self.collector.arena.shm_name:
+                os.environ["TPURX_OPRING_SHM"] = self.collector.arena.shm_name
 
     def initialize(self) -> None:
         self._initialized = True

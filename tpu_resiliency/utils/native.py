@@ -43,20 +43,32 @@ def _build(src: str, path: str, extra_args: tuple = ()) -> None:
             pass
 
 
-def load_native(lib_name: str, src_name: str, extra_args: tuple = ()):
+def load_native(lib_name: str, src_name: str, extra_args: tuple = (),
+                required_symbols: tuple = ()):
     """Load ``native/<lib_name>``, building from ``native/<src_name>`` when
-    absent or unloadable.  Returns a ``ctypes.CDLL`` or None."""
+    absent, unloadable, or missing ``required_symbols`` (a prebuilt .so from
+    an older source revision loads fine but lacks newly added exports — the
+    symbol check forces a rebuild instead of an AttributeError later).
+    Returns a ``ctypes.CDLL`` or None."""
     if lib_name in _cache:
         return _cache[lib_name]
     path = os.path.join(NATIVE_DIR, lib_name)
     src = os.path.join(NATIVE_DIR, src_name)
+
+    def _try_load():
+        loaded = ctypes.CDLL(path)
+        for sym in required_symbols:
+            if not hasattr(loaded, sym):
+                raise OSError(f"{lib_name} is stale: missing symbol {sym}")
+        return loaded
+
     lib = None
     try:
-        lib = ctypes.CDLL(path)
+        lib = _try_load()
     except OSError:
         try:
             _build(src, path, extra_args)
-            lib = ctypes.CDLL(path)
+            lib = _try_load()
         except (OSError, subprocess.SubprocessError) as exc:
             log.info("native %s unavailable (%s); callers fall back to "
                      "pure Python", lib_name, exc)
