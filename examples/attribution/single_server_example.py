@@ -1,0 +1,55 @@
+"""Attribution service (reference ``examples/attribution/single_server_example.py``).
+
+Start attrsvc, submit a failing cycle's log, and read the verdict — the
+same HTTP surface the launcher's restart gate uses
+(``attribution_service_mode=spawn`` runs all of this for you; this example
+drives it by hand).  Verdicts come from the rule engine, optionally
+escalated to an LLM backend (``TPURX_LLM_URL``/``TPURX_LLM_MODEL`` env).
+
+    python examples/attribution/single_server_example.py
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.request
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+from tpu_resiliency.services.attrsvc import serve  # noqa: E402
+
+FAILING_LOG = """\
+[r0] step 1200 loss=2.031
+[r3] step 1200 loss=2.029
+[r3] jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED:
+[r3] Out of memory while trying to allocate 9663676416 bytes in hbm
+[r0] collective timed out waiting for rank 3
+"""
+
+
+def main() -> None:
+    server = serve(host="127.0.0.1", port=0)
+    port = server.server_port
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/analyze",
+        data=json.dumps({"text": FAILING_LOG}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    verdict = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    print(f"category:      {verdict['category']}")
+    print(f"should_resume: {verdict['should_resume']}")
+    print(f"confidence:    {verdict['confidence']}")
+    print(f"culprits:      {verdict['culprit_ranks']}")
+    print(f"summary:       {verdict['summary']}")
+
+    stats = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/stats", timeout=10).read())
+    print(f"server stats:  {stats}")
+    server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Heartbeats API: the minimal fault-tolerance integration.
+
+Reference analog: ``examples/fault_tolerance/basic_ft_example.py`` +
+``train_ddp_heartbeats_api.py`` — a training loop that (1) connects to its
+rank monitor, (2) heartbeats every step, (3) lets the monitor LEARN timeouts
+from observed cadence, and (4) persists them for the next cycle.
+
+Run under the launcher (which starts the monitors and the store):
+
+    python -m tpu_resiliency.fault_tolerance.launcher \
+        --nnodes 1 --nproc-per-node 2 --host-store \
+        --rdzv-endpoint 127.0.0.1:29400 -- \
+        examples/fault_tolerance/basic_ft_example.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+from tpu_resiliency.fault_tolerance import RankMonitorClient  # noqa: E402
+
+
+def main() -> None:
+    rank = int(os.environ.get("TPURX_RANK", "0"))
+    client = RankMonitorClient()
+    client.init_workload_monitoring()
+
+    # "{}" in FT_STATE is replaced with the rank: each rank persists its own
+    # learned timeouts (concurrent writes to one file would tear the JSON)
+    state_path = os.environ.get(
+        "FT_STATE", "/tmp/ft_state_{}.json"
+    ).format(rank)
+    if os.path.exists(state_path):
+        import json
+
+        client.load_state_dict(json.load(open(state_path)))
+
+    for step in range(50):
+        # ... your training step here ...
+        time.sleep(0.05)
+        client.send_heartbeat()
+        if step == 20:
+            # after enough observed heartbeats, derive timeouts from the
+            # real cadence instead of static defaults (safety_factor x max)
+            client.calculate_and_set_hb_timeouts()
+
+    import json
+
+    json.dump(client.state_dict(), open(state_path, "w"))
+    client.shutdown_workload_monitoring()
+    print(f"rank {rank}: done, learned timeouts persisted to {state_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Sections API: phase-scoped hang detection.
+
+Reference analog: ``examples/fault_tolerance/train_ddp_sections_api.py`` —
+instead of one heartbeat cadence, the workload marks its phases
+(``start_section``/``end_section``) and the monitor applies PER-SECTION
+timeouts (a data-loader stall and a checkpoint stall have very different
+budgets) plus an out-of-section timeout between phases.
+
+    python -m tpu_resiliency.fault_tolerance.launcher \
+        --nnodes 1 --nproc-per-node 2 --host-store \
+        --rdzv-endpoint 127.0.0.1:29400 \
+        --ft-cfg examples/fault_tolerance/ft_cfg_sections.yaml -- \
+        examples/fault_tolerance/sections_example.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+from tpu_resiliency.fault_tolerance import RankMonitorClient  # noqa: E402
+
+
+def main() -> None:
+    client = RankMonitorClient()
+    client.init_workload_monitoring()
+
+    for step in range(30):
+        client.start_section("data")
+        time.sleep(0.01)           # input pipeline
+        client.end_section("data")
+
+        client.start_section("step")
+        time.sleep(0.04)           # jitted train step
+        client.end_section("step")
+
+        if step and step % 10 == 0:
+            client.start_section("checkpoint")
+            time.sleep(0.1)        # async save dispatch
+            client.end_section("checkpoint")
+
+    # learn per-section timeouts from the observed durations
+    client.calculate_and_set_section_timeouts()
+    client.shutdown_workload_monitoring()
+    print("sections example: done (per-section timeouts learned)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Advanced in-process restart (reference ``examples/inprocess/advanced_example.py``).
+
+Adds the production pieces to the basic example:
+
+- ``Tree`` rank assignment: whole-host topology constraints with RESERVE
+  spares — lose one chip and the whole host's ranks are replaced from the
+  spare pool, keeping ICI domains intact.
+- ``Compose`` plugins: initialize / abort / finalize hooks around each
+  iteration (mesh rebuild, collective abort, state reload).
+- The on-device **quorum tripwire**: pass the training mesh and a hang
+  anywhere in the pod is detected by one ICI collective in milliseconds —
+  the host soft/hard timeouts become the backstop, not the primary.
+
+Single-process demo over an 8-device CPU mesh:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    TPURX_RANK=0 TPURX_WORLD_SIZE=1 \
+    TPURX_STORE_ADDR=127.0.0.1 TPURX_STORE_PORT=29451 \
+    python examples/inprocess/advanced_example.py   # (store on 29451)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+import jax  # noqa: E402
+
+from tpu_resiliency.inprocess import (  # noqa: E402
+    Compose,
+    Layer,
+    LayerFlag,
+    ShiftRanks,
+    Tree,
+    Wrapper,
+)
+from tpu_resiliency.parallel.mesh import make_mesh  # noqa: E402
+
+
+def log_iteration(frozen_state):
+    print(f"[init] iteration={frozen_state.iteration} "
+          f"rank={frozen_state.active_rank}", flush=True)
+    return frozen_state
+
+
+def rebuild_mesh(frozen_state):
+    # rebuild meshes / reload state for the (possibly re-ranked) iteration
+    return frozen_state
+
+
+# plugins chain left-to-right: Compose(f, g)(state) == g(f(state))
+on_initialize = Compose(log_iteration, rebuild_mesh)
+
+
+def on_abort(frozen_state):
+    # stop aux engines (checkpoint workers, exchanges) before the restart
+    print("[abort] stopping aux engines", flush=True)
+
+
+def assignment():
+    chips_per_host = int(os.environ.get("CHIPS_PER_HOST", "4"))
+    if int(os.environ.get("TPURX_WORLD_SIZE", "1")) >= 2 * chips_per_host:
+        # pod topology: hosts of N chips; spare hosts park as RESERVE
+        return Tree([
+            Layer(min_size=1, flags=LayerFlag.RESERVE),
+            Layer(min_size=chips_per_host, max_size=chips_per_host,
+                  key="TPURX_HOST"),
+        ])
+    return ShiftRanks()
+
+
+mesh = make_mesh(("all",), (len(jax.devices()),))
+
+
+@Wrapper(
+    rank_assignment=assignment(),
+    initialize=on_initialize,
+    abort=on_abort,
+    soft_timeout=60.0,
+    hard_timeout=120.0,
+    quorum_mesh=mesh,            # ms-scale on-device hang detection
+    quorum_interval=0.02,
+    quorum_min_budget_ms=5.0,
+)
+def train(call_wrapper=None):
+    for step in range(20):
+        call_wrapper.ping()      # feeds host watchdog AND quorum stamps
+        time.sleep(0.02)
+        if step == 10:
+            with call_wrapper.disable_hang_protection():
+                time.sleep(0.3)  # known-long phase (compile, first load)
+    return "done"
+
+
+if __name__ == "__main__":
+    print("result:", train())

@@ -1,0 +1,47 @@
+"""Minimal in-process restart (reference ``examples/inprocess/basic_example.py``).
+
+The wrapped function restarts IN THE SAME PROCESS when any rank faults:
+exceptions are recorded to the store, every rank's monitor thread trips,
+async-raises ``RankShouldRestart`` into user code, ranks are reassigned
+(``ShiftRanks``), and the function is called again with a fresh iteration.
+
+Run N ranks against a store:
+
+    python -m tpu_resiliency.store.server --host 127.0.0.1 --port 29450 &
+    for r in 0 1; do
+      TPURX_RANK=$r TPURX_WORLD_SIZE=2 \
+      TPURX_STORE_ADDR=127.0.0.1 TPURX_STORE_PORT=29450 \
+      python examples/inprocess/basic_example.py &
+    done; wait
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+from tpu_resiliency.inprocess import Wrapper  # noqa: E402
+
+
+@Wrapper(
+    soft_timeout=30.0,
+    hard_timeout=60.0,
+    # the monitor process needs a reachable store: TPURX_STORE_* env (set
+    # above) or a StoreFactory
+)
+def train(call_wrapper=None):
+    state = call_wrapper.state
+    print(f"rank {state.active_rank}/{state.active_world_size} "
+          f"iteration {call_wrapper.iteration}", flush=True)
+    for step in range(20):
+        call_wrapper.ping()  # progress signal for the hang monitors
+        time.sleep(0.05)
+        if (call_wrapper.iteration == 0 and state.active_rank == 1
+                and step == 5):
+            raise RuntimeError("injected fault: watch the in-process restart")
+    return f"ok@{call_wrapper.iteration}"
+
+
+if __name__ == "__main__":
+    print("result:", train())

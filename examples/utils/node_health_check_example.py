@@ -1,0 +1,34 @@
+"""Node health checks (reference ``examples/utils/node_health_check_example.py``).
+
+Run the deep TPU node checks by hand — the same checks the rank monitor's
+periodic health loop runs (``monitor_health_check_interval``) and the
+launcher consults before joining a rendezvous round: accelerator sysfs,
+kernel-ring fault signatures (AER/MCE/ECC/link-flap/worker-OOM), NIC error
+windows, node daemon, and storage reachability.
+
+    python examples/utils/node_health_check_example.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ.get("TPURX_REPO", "."))
+
+from tpu_resiliency.health import PASSIVE_CHECKS, build_passive_checks  # noqa: E402
+
+
+def main() -> None:
+    chain = build_passive_checks(",".join(PASSIVE_CHECKS))
+    results = [check.run() for check in chain.checks]
+    for result in results:
+        mark = "OK " if result.healthy else "FAIL"
+        print(f"[{mark}] {result.name}: {result.message}")
+    if all(r.healthy for r in results):
+        print("node is healthy — would pass the pre-rendezvous gate")
+    else:
+        print("node is UNHEALTHY — the launcher would exclude it and a "
+              "hot spare would take its slot")
+
+
+if __name__ == "__main__":
+    main()

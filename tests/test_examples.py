@@ -1,0 +1,130 @@
+"""Every example script stays runnable (the reference ships its examples as
+living documentation; broken examples are worse than none)."""
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tests.test_launcher import free_port
+
+REPO = Path(__file__).resolve().parent.parent
+EXAMPLES = REPO / "examples"
+
+
+def _scrub_env(env):
+    """Force subprocesses onto pure CPU: the axon sitecustomize would
+    otherwise re-select the (possibly absent) TPU platform in the child."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run(script, env_extra=None, timeout=180, args=()):
+    env = _scrub_env(dict(os.environ))
+    env["TPURX_REPO"] = str(REPO)
+    env.update(env_extra or {})
+    out = subprocess.run(
+        [sys.executable, str(script), *args],
+        cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=timeout,
+    )
+    assert out.returncode == 0, (
+        f"{script} rc={out.returncode}\n{out.stdout[-1500:]}\n{out.stderr[-1500:]}"
+    )
+    return out
+
+
+def test_attribution_example():
+    out = _run(EXAMPLES / "attribution" / "single_server_example.py")
+    assert "category:      oom_hbm" in out.stdout
+    assert "should_resume: False" in out.stdout
+
+
+def test_async_ckpt_example():
+    out = _run(EXAMPLES / "checkpointing" / "async_ckpt.py")
+    assert "async checkpoint roundtrip OK" in out.stdout
+
+
+def test_local_ckpt_example():
+    out = _run(EXAMPLES / "checkpointing" / "local_ckpt.py")
+    assert "recovered from clique buddy" in out.stdout
+
+
+def test_straggler_example():
+    out = _run(EXAMPLES / "straggler" / "example.py")
+    assert "always-on collector: 16 samples" in out.stdout
+
+
+def test_health_example():
+    out = _run(EXAMPLES / "utils" / "node_health_check_example.py")
+    assert "node is" in out.stdout  # healthy or not — runs either way
+
+
+def test_inprocess_basic_example(store_server):
+    env = {
+        "TPURX_STORE_ADDR": "127.0.0.1",
+        "TPURX_STORE_PORT": str(store_server.port),
+        "TPURX_WORLD_SIZE": "2",
+    }
+    procs = []
+    try:
+        for r in range(2):
+            e = _scrub_env(
+                dict(os.environ, TPURX_REPO=str(REPO), TPURX_RANK=str(r), **env)
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable,
+                 str(EXAMPLES / "inprocess" / "basic_example.py")],
+                cwd=str(REPO), env=e, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True,
+            ))
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:  # never leak children on timeout/assert failure
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-1500:]
+        assert "result: ok@1" in out, out[-1500:]  # restarted past the fault
+
+
+def test_inprocess_advanced_example(store_server):
+    env = {
+        "TPURX_STORE_ADDR": "127.0.0.1",
+        "TPURX_STORE_PORT": str(store_server.port),
+        "TPURX_RANK": "0",
+        "TPURX_WORLD_SIZE": "1",
+    }
+    out = _run(EXAMPLES / "inprocess" / "advanced_example.py", env_extra=env)
+    assert "result: done" in out.stdout
+
+
+@pytest.mark.parametrize("script,cfg", [
+    ("basic_ft_example.py", None),
+    ("sections_example.py", "ft_cfg_sections.yaml"),
+])
+def test_ft_examples_under_launcher(tmp_path, script, cfg):
+    env = _scrub_env(dict(os.environ))
+    env.update({
+        "TPURX_REPO": str(REPO),
+        "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0",
+        "FT_STATE": str(tmp_path / "state_{}.json"),
+    })
+    cmd = [
+        sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+        "--nnodes", "1", "--nproc-per-node", "2", "--host-store",
+        "--rdzv-endpoint", f"127.0.0.1:{free_port()}",
+    ]
+    if cfg:
+        cmd += ["--ft-cfg", str(EXAMPLES / "fault_tolerance" / cfg)]
+    cmd += ["--", str(EXAMPLES / "fault_tolerance" / script)]
+    out = subprocess.run(
+        cmd, cwd=str(REPO), env=env, capture_output=True, text=True,
+        timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done" in out.stdout

@@ -43,6 +43,9 @@ _REPO_ROOT = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..")
 )
 
+# segments whose mmap stayed pinned at close (see MonitorSharedState.close)
+_LEAKED_SHM: list = []
+
 
 def _pid_alive(pid: int) -> bool:
     try:
@@ -135,6 +138,8 @@ class MonitorSharedState:
         self._ready.value = 1
 
     def close(self) -> None:
+        if self._shm is None:
+            return  # idempotent: stop() and __exit__ may both close
         # unlink first (owner): even if a pinned ctypes view keeps the
         # mapping alive, the NAME must go so nothing attaches to a dead slot
         if self._owner:
@@ -147,7 +152,12 @@ class MonitorSharedState:
         try:
             self._shm.close()
         except BufferError:
-            pass  # a view escaped (watchdog pin); process exit unmaps
+            # a view escaped (the watchdog pins its slot for queued pending
+            # calls): keep the object alive forever so its __del__ doesn't
+            # retry close() and spray "Exception ignored" at interpreter
+            # exit — process teardown unmaps anyway
+            _LEAKED_SHM.append(self._shm)
+        self._shm = None
 
 
 def _endpoint_from_factory(store_factory) -> Optional[Tuple[str, int]]:
