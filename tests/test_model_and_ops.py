@@ -195,3 +195,27 @@ def test_quorum_overlapped_loop_and_calibrate():
     mon.stop()
     assert hits
     assert (time.monotonic() - t0) * 1000 < 2000
+
+
+def test_calibrate_floor_release_and_p99_export():
+    """min_budget_ms releases the operator floor; the measured healthy p99
+    is exported for the bench's floor-accounting (beat_jitter_p99_ms)."""
+    import jax
+
+    from tpu_resiliency.ops.quorum import QuorumMonitor
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("all",), (len(jax.devices()),))
+    mon = QuorumMonitor(mesh, budget_ms=1e9, interval=0.01,
+                        auto_beat_interval=0.001)
+    try:
+        budget = mon.calibrate(n_ticks=8, min_budget_ms=1.0)
+        assert budget >= 1.0
+        assert mon.last_calibration_p99_ms is not None
+        assert mon.last_calibration_p99_ms >= 0.0
+        # the formula: budget = max(floor, safety*p99 + margin)
+        assert budget >= 3.0 * mon.last_calibration_p99_ms
+        # a high operator floor binds
+        assert mon.calibrate(n_ticks=8, min_budget_ms=500.0) >= 500.0
+    finally:
+        mon.stop()

@@ -78,10 +78,13 @@ class IciReplication:
         prefix = f"ici_repl/len/{gen}"
         self.store.set(f"{prefix}/r{self.rank}", str(n))
         barrier(self.store, f"{prefix}/b", self.world_size, timeout=timeout)
-        max_len = 0
-        for r in range(self.world_size):
-            max_len = max(max_len, int(self.store.get(f"{prefix}/r{r}")))
-        return max_len
+        # one RTT for all lengths (the barrier guarantees presence)
+        raws = self.store.multi_get(
+            [f"{prefix}/r{r}" for r in range(self.world_size)]
+        )
+        if raws is None:
+            raise RuntimeError("length key vanished after agreement barrier")
+        return max(int(raw) for raw in raws)
 
     def _shift_fn(self, shift: int):
         """Jitted ppermute by `shift` along the process axis (cached)."""

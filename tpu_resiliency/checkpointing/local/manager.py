@@ -192,10 +192,18 @@ class LocalCheckpointManager:
             self.world_size, timeout=gather_timeout,
         )
         coverage: Dict[int, Set[int]] = {}
-        for r in range(self.world_size):
-            raw = self.store.try_get(f"localckpt/holdings/{r}")
-            if raw is None:
-                continue
+        # every rank published (possibly-empty) holdings before the barrier:
+        # gather them in ONE round trip.  A miss here means the store lost
+        # state mid-protocol (e.g. failover to a fresh store) — surface it,
+        # the same policy as every post-barrier multi_get in this codebase.
+        keys = [f"localckpt/holdings/{r}" for r in range(self.world_size)]
+        raws = self.store.multi_get(keys)
+        if raws is None:
+            raise RuntimeError(
+                "holdings vanished after the find_latest barrier (store "
+                "lost state mid-protocol?)"
+            )
+        for raw in raws:
             for it_s, data_ranks in json.loads(raw).items():
                 coverage.setdefault(int(it_s), set()).update(data_ranks)
         full = [

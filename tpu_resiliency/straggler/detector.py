@@ -182,12 +182,20 @@ class Detector:
         )
         report = None
         if not self.gather_on_rank0 or self.rank == 0:
-            payloads = {}
-            for r in range(self.world_size):
-                raw = self.store.get(
-                    f"straggler/round/{round_idx}/rank/{r}", timeout=timeout
+            # ONE round trip for all ranks' payloads (the barrier above
+            # guarantees presence) — at 256 ranks this is the difference
+            # between 256 RTTs and 1 on the gather path
+            keys = [
+                f"straggler/round/{round_idx}/rank/{r}"
+                for r in range(self.world_size)
+            ]
+            raws = self.store.multi_get(keys)
+            if raws is None:
+                raise RuntimeError(
+                    f"straggler round {round_idx}: payload vanished after "
+                    "the gather barrier"
                 )
-                payloads[r] = raw.decode()
+            payloads = {r: raw.decode() for r, raw in enumerate(raws)}
             report = Report.from_payloads(round_idx, payloads)
         if not self.gather_on_rank0:
             # everyone reads: fence before cleanup so no reader races a delete
