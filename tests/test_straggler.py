@@ -348,3 +348,86 @@ def test_detector_always_on_collector_in_report():
     st = report.device_stats[0].get("train")
     assert st is not None and st.count == 6
     det.shutdown()
+
+
+def test_opring_inspect_cli():
+    """tpurx-opring renders a live arena's per-op table from the shell."""
+    from tpu_resiliency.straggler import OpRingArena
+    from tpu_resiliency.straggler.inspect import render
+
+    arena = OpRingArena(max_ops=8, capacity=32)
+    if not arena.native:
+        arena.close()
+        pytest.skip("native ring library unavailable")
+    try:
+        for name, vals in (("train_step", [0.1, 0.2, 0.3]),
+                           ("xla:fusion.1", [0.05])):
+            idx = arena.intern(name)
+            for v in vals:
+                arena.push(idx, v)
+        out = render(arena.shm_name)
+        assert "train_step" in out and "xla:fusion.1" in out
+        import re
+
+        # count column specifically (not a digit from the shm name/durations)
+        assert re.search(r"train_step\s+3\s", out), out
+        from tpu_resiliency.straggler import OpRingArena as _A
+
+        assert _A.looks_like_arena(arena.shm_name)
+        # cross-process, like the operator would use it
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [_sys.executable, "-m", "tpu_resiliency.straggler.inspect",
+             arena.shm_name],
+            capture_output=True, text=True, timeout=60,
+            cwd=str(__import__("pathlib").Path(__file__).parent.parent),
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "train_step" in proc.stdout
+    finally:
+        arena.close()
+
+
+def test_opring_inspect_from_pid():
+    """--from-pid finds the arena via the trainer's shm MAPPINGS (the env
+    var is runtime-only and invisible in /proc/<pid>/environ)."""
+    import subprocess
+    import sys as _sys
+
+    from tpu_resiliency.straggler import OpRingArena
+
+    probe = OpRingArena(max_ops=2, capacity=4)
+    native = probe.native
+    probe.close()
+    if not native:
+        pytest.skip("native ring library unavailable")
+
+    code = (
+        "import sys, time\n"
+        "sys.path.insert(0, '.')\n"
+        "from tpu_resiliency.straggler import OpRingArena\n"
+        "a = OpRingArena(max_ops=4, capacity=8)\n"
+        "a.push(a.intern('stuck_op'), 1.25)\n"
+        "print(a.shm_name, flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    trainer = subprocess.Popen(
+        [_sys.executable, "-c", code], stdout=subprocess.PIPE, text=True,
+        cwd=str(__import__("pathlib").Path(__file__).parent.parent),
+    )
+    try:
+        shm_name = trainer.stdout.readline().strip()
+        assert shm_name
+        out = subprocess.run(
+            [_sys.executable, "-m", "tpu_resiliency.straggler.inspect",
+             "--from-pid", str(trainer.pid)],
+            capture_output=True, text=True, timeout=60,
+            cwd=str(__import__("pathlib").Path(__file__).parent.parent),
+        )
+        assert out.returncode == 0, out.stderr
+        assert "stuck_op" in out.stdout
+    finally:
+        trainer.kill()
+        trainer.wait()

@@ -153,6 +153,13 @@ class OpRingArena:
         self._closed = False
         self.overflow_drops = 0  # samples for ops beyond max_ops
         if self._lib is None:
+            if _attach_name is not None:
+                # an attach caller NAMED a real arena; silently handing back
+                # an empty fallback would read as "no ops recorded"
+                raise RuntimeError(
+                    f"cannot attach arena {_attach_name}: native ring "
+                    "library unavailable on this host"
+                )
             self._fallback = {}
             self.shm_name = None
             return
@@ -172,10 +179,28 @@ class OpRingArena:
             self._owner = False
         self.shm_name = self._shm.name
 
+    MAGIC = b"1GNIRUPT"  # little-endian u64 0x54505552494e4731 ("TPURING1")
+
     @classmethod
     def attach(cls, shm_name: str) -> "OpRingArena":
         """Attach read-side from another process (rank monitor post-mortem)."""
         return cls(_attach_name=shm_name)
+
+    @classmethod
+    def looks_like_arena(cls, shm_name: str) -> bool:
+        """Cheap magic check without constructing an arena — used to pick
+        the ring segment out of a process's other shm mappings."""
+        try:
+            shm = attach_shm(shm_name)
+        except (OSError, ValueError):
+            return False
+        try:
+            return bytes(shm.buf[:8]) == cls.MAGIC
+        finally:
+            try:
+                shm.close()
+            except BufferError:
+                pass
 
     @property
     def native(self) -> bool:

@@ -1,0 +1,118 @@
+"""Operator tool: read a (possibly wedged) trainer's op rings from the shell.
+
+The always-on collector's arena lives in named shared memory precisely so it
+outlives a hung training process (``collector.py``); this CLI attaches
+read-side and renders per-op stats — the on-call engineer's "what was that
+rank doing" view without touching the trainer.
+
+    tpurx-opring <shm_name>              # e.g. psm_85212c3b
+    tpurx-opring <shm_name> --watch 2    # refresh every 2s
+    tpurx-opring --from-pid <pid>        # resolve via TPURX_OPRING_SHM env
+
+The shm name is logged by the Detector at startup and published in the
+trainer's environment as ``TPURX_OPRING_SHM`` (forwarded to the rank
+monitor on INIT for post-mortem capture).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _resolve_from_pid(pid: int) -> str:
+    """Find the trainer's arena among its mapped shm segments.
+
+    /proc/<pid>/environ only reflects the execve-time environment (the
+    Detector publishes TPURX_OPRING_SHM at runtime, invisible there), but
+    the arena is MAPPED — scan /proc/<pid>/maps for /dev/shm entries and
+    magic-check each."""
+    from .collector import OpRingArena
+
+    candidates = []
+    try:
+        with open(f"/proc/{pid}/maps") as f:
+            for line in f:
+                if "/dev/shm/" in line:
+                    name = line.rsplit("/dev/shm/", 1)[1].split()[0]
+                    name = name.split(" (deleted)")[0]
+                    if name not in candidates:
+                        candidates.append(name)
+    except OSError as exc:
+        raise SystemExit(f"cannot read /proc/{pid}/maps: {exc}")
+    for name in candidates:
+        if OpRingArena.looks_like_arena(name):
+            return name
+    raise SystemExit(
+        f"pid {pid} maps no op-ring arena (shm segments seen: "
+        f"{candidates or 'none'})"
+    )
+
+
+def render(shm_name: str) -> str:
+    from .collector import OpRingArena
+
+    arena = OpRingArena.attach(shm_name)  # raises if the native lib is absent
+    try:
+        stats = arena.stats()
+        drops = arena.drops()
+    finally:
+        arena.close()
+    if not stats:
+        return f"arena {shm_name}: no ops recorded"
+    rows = sorted(stats.values(), key=lambda s: -s.total)
+    total_all = sum(s.total for s in rows) or 1e-12
+    width = 28
+    lines = [
+        f"arena {shm_name}: {len(rows)} op(s)",
+        f"{'op':<40} {'count':>7} {'median':>10} {'p~max':>10} "
+        f"{'total':>9}  share",
+    ]
+    for s in rows:
+        share = s.total / total_all
+        bar = "#" * max(1, int(share * width))
+        name = s.name if len(s.name) <= 40 else s.name[:37] + "..."
+        lines.append(
+            f"{name:<40} {s.count:>7} {s.median * 1e3:>8.2f}ms "
+            f"{s.max * 1e3:>8.2f}ms {s.total:>8.2f}s  {bar} {share:>5.1%}"
+        )
+    dropped = {k: v for k, v in drops.items() if v}
+    if dropped:
+        lines.append(f"drops: {dropped}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tpurx-opring", description=__doc__)
+    p.add_argument("shm_name", nargs="?", help="arena shared-memory name")
+    p.add_argument("--from-pid", type=int, default=None,
+                   help="resolve the arena name from a trainer pid's env")
+    p.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                   help="refresh continuously")
+    args = p.parse_args(argv)
+    name = args.shm_name
+    if args.from_pid is not None:
+        name = _resolve_from_pid(args.from_pid)
+    if not name:
+        p.error("need a shm name or --from-pid")
+    try:
+        while True:
+            print(render(name), flush=True)
+            if not args.watch:
+                return 0
+            time.sleep(args.watch)
+            print()
+    except KeyboardInterrupt:
+        return 0
+    except FileNotFoundError:
+        print(f"no such arena: {name} (trainer exited and unlinked it?)",
+              file=sys.stderr)
+        return 1
+    except RuntimeError as exc:
+        print(str(exc), file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
