@@ -5,6 +5,7 @@ MultiProcessTestCase: real OS processes, real store, injected faults.
 """
 
 import os
+import re
 import subprocess
 import sys
 import time
@@ -163,9 +164,14 @@ def test_crash_shrinks_world(store_server):
 
 
 def test_hang_detected_and_killed(store_server):
+    # STEPS=120 (6s of fn) keeps a wide margin between the hang kill
+    # (~hard_timeout + interval ≈ 3s) and the survivor finishing its own
+    # iteration 0 — on a loaded host a thin margin lets rank 0 complete
+    # BEFORE the kill lands and no restart is observed
     procs, outs = run_scenario(
         store_server, "hang", world=2, timeout=150,
-        extra_env={"SOFT_TIMEOUT": "1.0", "HARD_TIMEOUT": "2.5"},
+        extra_env={"SOFT_TIMEOUT": "1.0", "HARD_TIMEOUT": "2.5",
+                   "STEPS": "120"},
     )
     if procs[0].returncode != 0:
         _dump(outs)
@@ -198,9 +204,13 @@ def test_quorum_tripwire_restarts_without_host_timeouts(store_server):
     if any(p.returncode != 0 for p in procs):
         _dump(outs)
     # BOTH ranks recovered in the same process (no kill; rc 0) and completed
+    # at iteration >= 1 (a loaded host can stall the beater past the budget
+    # and fire extra — legitimate — quorum restarts; the invariant is that
+    # recovery HAPPENED and came from the quorum, not its exact count)
     for rank in (0, 1):
         assert procs[rank].returncode == 0
-        assert "ret=ok@1" in outs[rank]
+        m = re.search(r"ret=ok@(\d+)", outs[rank])
+        assert m and int(m.group(1)) >= 1, outs[rank][-800:]
     # detection was the quorum's: the trip and the record kind are logged
     combined = outs[0] + outs[1]
     assert "quorum tripwire" in combined
@@ -255,8 +265,11 @@ def test_tree_spare_promoted_into_gap(store_server):
     assert procs[2].returncode == 31     # crashed
     assert procs[0].returncode == 0
     assert procs[3].returncode == 0
-    assert "train start rank=1 world=2 iter=1" in outs[3]
-    assert "ret=ok@1" in outs[0]
+    # iteration number may exceed 1 under host load (extra legitimate
+    # restarts); the invariant is the spare took app rank 1 in a world of 2
+    assert re.search(r"train start rank=1 world=2 iter=\d+", outs[3]), outs[3][-800:]
+    m = re.search(r"ret=ok@(\d+)", outs[0])
+    assert m and int(m.group(1)) >= 1, outs[0][-800:]
 
 
 def test_tree_host_loss_promotes_whole_spare_host(store_server):
@@ -274,9 +287,10 @@ def test_tree_host_loss_promotes_whole_spare_host(store_server):
     assert "DISCONTINUED rank=0" in outs[0]
     assert procs[2].returncode == 0
     assert procs[3].returncode == 0
-    assert "train start rank=0 world=2 iter=1" in outs[2]
-    assert "train start rank=1 world=2 iter=1" in outs[3]
-    assert "ret=ok@1" in outs[2]
+    assert re.search(r"train start rank=0 world=2 iter=\d+", outs[2]), outs[2][-800:]
+    assert re.search(r"train start rank=1 world=2 iter=\d+", outs[3]), outs[3][-800:]
+    m = re.search(r"ret=ok@(\d+)", outs[2])
+    assert m and int(m.group(1)) >= 1, outs[2][-800:]
 
 
 class TestActivateWholeGroups:
