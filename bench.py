@@ -172,8 +172,10 @@ def _run_child(mode: str, budget_s: float, partial_path: str):
         # via jax.config.update, which OVERRIDES the env var) and force a
         # pure-CPU jax with 8 virtual devices so the quorum collective is
         # still a real 8-way reduction.
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+        disarm_platform_sitecustomize(env)
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             env["XLA_FLAGS"] = (

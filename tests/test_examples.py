@@ -18,9 +18,9 @@ EXAMPLES = REPO / "examples"
 def _scrub_env(env):
     """Force subprocesses onto pure CPU: the axon sitecustomize would
     otherwise re-select the (possibly absent) TPU platform in the child."""
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    return env
+    from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+    return disarm_platform_sitecustomize(env)
 
 
 def _run(script, env_extra=None, timeout=180, args=()):

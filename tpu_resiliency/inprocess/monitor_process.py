@@ -34,6 +34,7 @@ import sys
 import time
 from typing import Optional, Tuple
 
+from ..utils.env import disarm_platform_sitecustomize
 from ..utils.logging import get_logger
 from ..utils.shm import attach_shm, create_shm, unlink_shm
 
@@ -256,6 +257,11 @@ class MonitorProcess:
         env["PYTHONPATH"] = (
             _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         ).rstrip(os.pathsep)
+        # the monitor is deliberately jax-free (stdlib + store client only):
+        # disarm the platform sitecustomize so the child boots in ~0.3s
+        # instead of paying a full jax import (seconds; a minute on a loaded
+        # host with many ranks exec'ing monitors simultaneously)
+        disarm_platform_sitecustomize(env)
         self._proc = subprocess.Popen(cmd, env=env)
         # Readiness handshake: the child boots a fresh interpreter (seconds —
         # the sitecustomize imports jax) and then connects to the store;

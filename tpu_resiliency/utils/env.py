@@ -1,0 +1,18 @@
+"""Environment helpers shared by subprocess launchers."""
+
+from __future__ import annotations
+
+
+def disarm_platform_sitecustomize(env: dict) -> dict:
+    """Force a child python onto pure CPU.
+
+    The platform sitecustomize registers the TPU plugin at interpreter start
+    whenever its trigger var is present and then force-selects the platform
+    via ``jax.config`` — which OVERRIDES a ``JAX_PLATFORMS`` env var (this
+    interaction ate round 3's bench).  Children that must not touch the TPU
+    (checkpoint writers, monitors, CPU benchmark arms) need the trigger
+    removed, not just the env var set.  Mutates and returns ``env``.
+    """
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
