@@ -26,6 +26,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from tpu_resiliency.utils.env import disarm_platform_sitecustomize  # noqa: E402
+
 WORKLOAD = r"""
 import os, random, sys, time
 sys.path.insert(0, os.environ["TPURX_REPO"])
@@ -79,6 +81,7 @@ def main() -> None:
     s.close()
 
     env = dict(os.environ)
+    disarm_platform_sitecustomize(env)
     env.update(
         {
             "TPURX_REPO": REPO,

@@ -334,6 +334,16 @@ void complete_waiter(uint64_t id, bool timed_out) {
   if (it == g_store.waiters.end()) return;
   Waiter w = std::move(it->second);
   g_store.waiters.erase(it);
+  // drop this waiter's id from any key list it is still parked on: sliced
+  // clients re-park every ~2s, and on never-set keys the stale ids would
+  // otherwise accumulate until the key is finally SET (or forever)
+  for (const auto& k : w.keys) {
+    auto kit = g_store.key_waiters.find(k);
+    if (kit == g_store.key_waiters.end()) continue;
+    auto& vec = kit->second;
+    vec.erase(std::remove(vec.begin(), vec.end(), id), vec.end());
+    if (vec.empty()) g_store.key_waiters.erase(kit);
+  }
   if (!w.conn || w.conn->closed) return;
   w.conn->waiting_ids.erase(id);
   if (timed_out) {

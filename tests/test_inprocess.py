@@ -13,6 +13,8 @@ from pathlib import Path
 
 import pytest
 
+from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
 from tpu_resiliency.inprocess.rank_assignment import (
     ActivateAllRanks,
     ActiveWorldSizeDivisibleBy,
@@ -94,9 +96,9 @@ def run_scenario(store_server, scenario, world=2, extra_env=None, timeout=90):
                 "TPURX_STORE_ADDR": "127.0.0.1",
                 "TPURX_STORE_PORT": str(store_server.port),
                 "SCENARIO": scenario,
-                "JAX_PLATFORMS": "cpu",
             }
         )
+        disarm_platform_sitecustomize(env)
         env.update(extra_env or {})
         procs.append(
             subprocess.Popen(

@@ -15,6 +15,8 @@ from pathlib import Path
 
 import pytest
 
+from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
 REPO = Path(__file__).resolve().parent.parent
 TOY = str(REPO / "tests" / "workloads" / "toy_train.py")
 
@@ -31,6 +33,7 @@ def run_launcher(tmp_path, extra_env=None, nproc=2, max_restarts=3, timeout=90,
                  iters=15, expect_rc=0):
     port = free_port()
     env = dict(os.environ)
+    disarm_platform_sitecustomize(env)
     env.update(
         {
             "TPURX_REPO": str(REPO),
@@ -169,6 +172,7 @@ def test_progress_tracker_stops_crash_loop(tmp_path):
     """No progress across cycles -> early termination before budget is spent."""
     port = free_port()
     env = dict(os.environ)
+    disarm_platform_sitecustomize(env)
     env.update(
         {
             "TPURX_REPO": str(REPO),

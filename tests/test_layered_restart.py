@@ -11,6 +11,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
 REPO = Path(__file__).resolve().parent.parent
 WORKER = str(REPO / "tests" / "workloads" / "layered_worker.py")
 
@@ -25,6 +27,7 @@ def free_port():
 
 def run_layered(tmp_path, scenario, timeout=150):
     env = dict(os.environ)
+    disarm_platform_sitecustomize(env)
     env.update(
         {
             "TPURX_REPO": str(REPO),

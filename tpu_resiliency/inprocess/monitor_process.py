@@ -263,10 +263,11 @@ class MonitorProcess:
         # host with many ranks exec'ing monitors simultaneously)
         disarm_platform_sitecustomize(env)
         self._proc = subprocess.Popen(cmd, env=env)
-        # Readiness handshake: the child boots a fresh interpreter (seconds —
-        # the sitecustomize imports jax) and then connects to the store;
-        # without this wait the soft/hard clocks would silently include boot
-        # time and a hang in the first seconds would be detected late.
+        # Readiness handshake: the child boots a fresh interpreter (~0.3s
+        # with the sitecustomize disarmed; the window stays generous for
+        # loaded hosts) and then connects to the store; without this wait
+        # the soft/hard clocks would silently include boot time and a hang
+        # in the first seconds would be detected late.
         deadline = time.monotonic() + 60.0
         while time.monotonic() < deadline:
             if self.shared.ready:

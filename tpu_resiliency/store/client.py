@@ -210,17 +210,41 @@ class StoreClient:
         if status != Status.OK:
             raise StoreError(f"set({key}) -> {status.name}")
 
+    # Blocking ops are SLICED client-side: a single server-parked request
+    # would block the caller in one C-level recv for the whole wait, during
+    # which the main thread executes no bytecode — the progress watchdog's
+    # pending-call stamps freeze and the monitor reads a legitimately
+    # waiting rank as a hang.  GET/WAIT are idempotent reads, so re-parking
+    # every slice is safe; each loop iteration runs bytecode and keeps the
+    # liveness stamps flowing.
+    BLOCKING_SLICE_S = 2.0
+
     def get(self, key, timeout: Optional[float] = None) -> bytes:
         """Blocking get: waits for the key up to `timeout` (like TCPStore.get)."""
         t = self.timeout if timeout is None else timeout
-        status, out = self._roundtrip(
-            Op.GET, [self._k(key), itob(int(t * 1000))], io_timeout=t + 10.0
-        )
-        if status == Status.TIMEOUT:
-            raise StoreTimeout(f"get({key}) timed out after {t}s")
-        if status != Status.OK:
+        deadline = time.monotonic() + t
+        while True:
+            remaining = deadline - time.monotonic()
+            slice_t = min(max(remaining, 0.05), self.BLOCKING_SLICE_S)
+            try:
+                status, out = self._roundtrip(
+                    Op.GET, [self._k(key), itob(int(slice_t * 1000))],
+                    io_timeout=slice_t + 10.0,
+                )
+            except StoreTimeout:
+                # socket-level stall on ONE slice (server event-loop pause,
+                # fsync storm): GET is idempotent and the CALLER's budget is
+                # what matters — keep slicing until it runs out
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(f"get({key}) timed out after {t}s")
+                continue
+            if status == Status.OK:
+                return out[0]
+            if status == Status.TIMEOUT:
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(f"get({key}) timed out after {t}s")
+                continue
             raise StoreError(f"get({key}) -> {status.name}")
-        return out[0]
 
     def try_get(self, key) -> Optional[bytes]:
         status, out = self._roundtrip(Op.TRY_GET, [self._k(key)], self.timeout)
@@ -257,11 +281,30 @@ class StoreClient:
 
     def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
         t = self.timeout if timeout is None else timeout
-        args = [itob(int(t * 1000))] + [self._k(k) for k in keys]
-        status, _ = self._roundtrip(Op.WAIT, args, io_timeout=t + 10.0)
-        if status == Status.TIMEOUT:
-            raise StoreTimeout(f"wait({list(keys)}) timed out after {t}s")
-        if status != Status.OK:
+        deadline = time.monotonic() + t
+        wire_keys = [self._k(k) for k in keys]
+        while True:
+            remaining = deadline - time.monotonic()
+            slice_t = min(max(remaining, 0.05), self.BLOCKING_SLICE_S)
+            args = [itob(int(slice_t * 1000))] + wire_keys
+            try:
+                status, _ = self._roundtrip(
+                    Op.WAIT, args, io_timeout=slice_t + 10.0
+                )
+            except StoreTimeout:
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(
+                        f"wait({list(keys)}) timed out after {t}s"
+                    )
+                continue
+            if status == Status.OK:
+                return
+            if status == Status.TIMEOUT:
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(
+                        f"wait({list(keys)}) timed out after {t}s"
+                    )
+                continue
             raise StoreError(f"wait -> {status.name}")
 
     def check(self, keys: Sequence) -> bool:
