@@ -226,6 +226,22 @@ def test_quorum_tripwire_restarts_without_host_timeouts(store_server):
     assert elapsed < 120, elapsed
 
 
+def test_late_fault_after_completion_exits_not_restarts(store_server):
+    """Completion wins the completion-vs-fault race: when a peer finished
+    the job in the same iteration, a faulted rank's restart path must exit
+    (any_completed gate) rather than restart into an iteration barrier the
+    completed peer will never attend (review r5 finding)."""
+    procs, outs = run_scenario(store_server, "late_fault", world=2, timeout=60)
+    if any(p.returncode != 0 for p in procs):
+        _dump(outs)
+    assert procs[0].returncode == 0
+    assert "ret=done-early@0" in outs[0]
+    assert procs[1].returncode == 0, outs[1][-800:]
+    # the faulted rank exited via the completion gate, not a restart cycle
+    assert "job completed" in outs[1], outs[1][-800:]
+    assert "ret=None" in outs[1]
+
+
 def test_spare_rank_activated_on_failure(store_server):
     procs, outs = run_scenario(
         store_server, "spare", world=3, timeout=120,

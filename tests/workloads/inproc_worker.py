@@ -48,6 +48,15 @@ def train(call_wrapper=None):
     for step in range(STEPS):
         call_wrapper.ping()
         time.sleep(0.05)
+        if SCENARIO == "late_fault" and it == 0:
+            # completion/fault race: rank 0 finishes the job early; the
+            # failing rank faults well after — its restart path must see
+            # any_completed and EXIT instead of restarting into an
+            # iteration barrier the completed rank will never attend
+            if INITIAL_RANK == 0 and step == 1:
+                return f"done-early@{it}"
+            if INITIAL_RANK == FAIL_RANK and step == 30:
+                raise RuntimeError("late fault after completion")
         if it == 0 and INITIAL_RANK == FAIL_RANK and step == 3:
             if "exception" in SCENARIO:
                 raise RuntimeError("injected exception")
