@@ -10,6 +10,15 @@ Scenario (env LAYERED_SCENARIO):
            it; the LAUNCHER must see zero worker failures (cycle stays 0).
   outer  — rank 1 hard-exits; the in-process ring cannot save a dead process;
            its launcher respawns it and the wrapper group re-forms.
+  wedged — rank 1 blocks forever inside a DEVICE program (a jit'd infinite
+           while_loop: stuck in PJRT C++ with the GIL released — how a
+           collective with a missing participant presents to Python).  The
+           async raise cannot land, pings and the watchdog's pending-call
+           auto-stamps freeze, so the exec'd monitor process records
+           SOFT_TIMEOUT and then hard-kills at the hard timeout; the
+           launcher ring re-rendezvouses.  Reference layered contract:
+           ``inprocess/monitor_process.py:269-288`` (GIL-released hang ->
+           kill) + ``inprocess/nested_restarter.py:36-107``.
 """
 
 import os
@@ -43,8 +52,8 @@ bridge = NestedRestarterCallback(client)
     initialize=bridge.on_initialize,
     abort=bridge.on_abort,
     finalize=bridge.on_finalize,
-    soft_timeout=15.0,
-    hard_timeout=30.0,
+    soft_timeout=float(os.environ.get("WRAP_SOFT_TIMEOUT", "15.0")),
+    hard_timeout=float(os.environ.get("WRAP_HARD_TIMEOUT", "30.0")),
     monitor_process_interval=0.2,
     monitor_thread_interval=0.1,
     heartbeat_interval=0.2,
@@ -65,6 +74,24 @@ def train(call_wrapper=None):
             if SCENARIO == "outer":
                 print("outer fault: dying for real", flush=True)
                 os._exit(29)
+            if SCENARIO == "wedged":
+                print("wedging in a device program", flush=True)
+                import jax
+                import jax.numpy as jnp
+
+                if os.environ.get("JAX_PLATFORMS") == "cpu":
+                    # sitecustomize force-selects the TPU platform through
+                    # jax.config, overriding the env var — override it back
+                    jax.config.update("jax_platforms", "cpu")
+                spin = jax.jit(
+                    lambda x: jax.lax.while_loop(
+                        lambda c: jnp.bool_(True), lambda c: c + 1, x
+                    )
+                )
+                # never returns: the main thread is blocked inside the PJRT
+                # runtime with the GIL released — pings and pending-call
+                # stamps freeze, async raises cannot land
+                spin(jnp.int32(0)).block_until_ready()
         if state.active_rank == 0:
             write_progress_iteration(os.environ["TOY_CKPT"], step)
     return f"done@{it}"
