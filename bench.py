@@ -72,18 +72,120 @@ _BASELINE_MS = 61000.0  # reference GIL-released hang detection (BASELINE.md)
 # supervisor
 # --------------------------------------------------------------------------
 
-def _device_reachable(timeout_s: float) -> bool:
-    """Probe the default backend in a SUBPROCESS — a wedged TPU runtime hangs
-    jax.devices() forever and must never wedge the bench itself."""
-    code = "import jax; jax.devices(); print('ok')"
+_PROBE_CODE = """
+import json, time
+t0 = time.time()
+def st(stage, **kw):
+    print(json.dumps({"stage": stage, "t": round(time.time() - t0, 2), **kw}),
+          flush=True)
+st("interp")
+import jax
+st("import_jax")
+st("backend_init_start")
+devs = jax.devices()
+st("devices", n=len(devs), platform=devs[0].platform)
+import jax.numpy as jnp
+y = (jnp.ones((128, 128)) @ jnp.ones((128, 128))).block_until_ready()
+import numpy as np
+float(np.asarray(y).sum())
+st("compute_ok")
+"""
+
+
+def _staged_probe(timeout_s: float) -> dict:
+    """Probe the device backend in STAGES in a throwaway subprocess.
+
+    Each stage prints a JSON line the moment it completes; on a hang the
+    captured tail tells exactly where init wedged (round-4 diagnosis: the
+    axon PJRT plugin registers fine and then blocks forever inside backend
+    init — the device-grant claim to the tunnel peer never completes, with
+    the TCP leg established and no local process holding the grant).
+    Returns {"ok": bool, "last_stage": str, "stages": [...], "waited_s": N}.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-c", _PROBE_CODE],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        start_new_session=True,
+    )
+    stages, ok = [], False
+    t0 = time.monotonic()
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout_s,
-        )
-        return out.returncode == 0 and "ok" in out.stdout
+        out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return False
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        try:
+            out, _ = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out = ""
+    elapsed = time.monotonic() - t0  # actual, not the cap: a 2s crash must
+    # not read as a 45s hang in the diagnosis artifact
+    for raw in (out or "").splitlines():
+        try:
+            stages.append(json.loads(raw))
+        except json.JSONDecodeError:
+            continue
+    if stages:
+        ok = stages[-1].get("stage") == "compute_ok" and proc.returncode == 0
+    return {
+        "ok": ok,
+        "last_stage": stages[-1].get("stage") if stages else "spawn",
+        "stages": stages,
+        "waited_s": round(elapsed, 1),
+        "returncode": proc.returncode,
+    }
+
+
+def _collect_device_diagnosis(probe: dict, stale_killed: int) -> dict:
+    """Machine-readable root cause for an unreachable device backend.
+
+    Folds in the passive health checks (sysfs chip scan + kernel log scrape
+    from ``tpu_resiliency/health``) and a TCP probe of the relay/pool
+    endpoint so the driver artifact records WHAT is wedged, not just that
+    the bench fell back (VERDICT r4 'do this' #1)."""
+    diag = {
+        "probe_last_stage": probe.get("last_stage"),
+        "probe_stages": probe.get("stages", [])[-4:],
+        "probe_waited_s": probe.get("waited_s"),
+        "stale_holders_killed": stale_killed,
+        "interpretation": (
+            "backend init (device-grant claim through the relay tunnel) "
+            "never completes; no local grant holder exists, so the wedge "
+            "is on the tunnel peer and only it (or lease expiry) can "
+            "release the grant"
+            if probe.get("last_stage") == "backend_init_start"
+            else "see probe_last_stage"
+        ),
+    }
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from tpu_resiliency.health.tpu import TpuSysHealthCheck
+
+        r = TpuSysHealthCheck().check()
+        diag["sysfs_tpu"] = {"healthy": bool(r), "message": r.message[:200]}
+    except Exception as exc:  # noqa: BLE001 - diagnosis must never fail
+        diag["sysfs_tpu"] = {"error": repr(exc)[:200]}
+    try:
+        from tpu_resiliency.health.kmsg import KernelLogHealthCheck
+
+        r = KernelLogHealthCheck().check()
+        diag["kmsg"] = {"healthy": bool(r), "message": r.message[:200]}
+    except Exception as exc:  # noqa: BLE001
+        diag["kmsg"] = {"error": repr(exc)[:200]}
+    try:
+        import socket
+
+        host = os.environ.get("PALLAS_AXON_POOL_IPS", "127.0.0.1").split(",")[0]
+        s = socket.socket()
+        s.settimeout(3.0)
+        s.connect((host, 2024))
+        s.close()
+        diag["relay_tcp_2024"] = "connect_ok"
+    except OSError as exc:
+        diag["relay_tcp_2024"] = f"connect_failed: {exc}"
+    return diag
 
 
 def _ancestor_pids() -> set:
@@ -230,6 +332,10 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "ring_detect_ms", "ring_recover_ms", "async_ckpt_overhead_pct",
         "async_ckpt_vs_target", "d2h_mbps", "ckpt_state_mb",
         "ckpt_save_every", "ckpt_stall_ms", "ckpt_call_ms",
+        "ckpt1g_state_mb", "ckpt1g_d2h_mbps", "ckpt1g_call_ms",
+        "ckpt1g_stall_ms", "ckpt1g_drain_s", "ckpt1g_write_mbps",
+        "ckpt1g_overhead_pct", "ckpt1g_scaled_down",
+        "ckpt1g_extrapolated_overhead_pct", "ckpt1g_drain_truncated",
         "straggler_collector_overhead_pct",
     ):
         if key in partial:
@@ -251,16 +357,24 @@ def supervise() -> None:
     dev_partial = tempfile.mktemp(prefix="tpurx-bench-dev-")
     cpu_partial = tempfile.mktemp(prefix="tpurx-bench-cpu-")
 
-    device_ok = _device_reachable(timeout_s=45.0)
+    probe = _staged_probe(timeout_s=45.0)
+    device_ok, diagnosis, stale_killed = probe["ok"], None, 0
     if not device_ok:
-        print("bench: device backend unreachable — attempting recovery",
+        print(f"bench: device backend unreachable (wedged at stage "
+              f"{probe['last_stage']!r}) — attempting recovery",
               file=sys.stderr, flush=True)
-        if _kill_stale_device_holders():
+        stale_killed = _kill_stale_device_holders()
+        if stale_killed:
             time.sleep(3.0)
-            device_ok = _device_reachable(timeout_s=30.0)
+            probe = _staged_probe(timeout_s=30.0)
+            device_ok = probe["ok"]
             if device_ok:
                 print("bench: runtime recovered after killing stale holders",
                       file=sys.stderr, flush=True)
+    if not device_ok:
+        diagnosis = _collect_device_diagnosis(probe, stale_killed)
+        print(f"bench: device diagnosis: {json.dumps(diagnosis)}",
+              file=sys.stderr, flush=True)
 
     line = None
     if device_ok:
@@ -290,6 +404,8 @@ def supervise() -> None:
         line = _compose_line(partial, "unknown")
         if line["value"] is None:
             line["error"] = "no measurement phase completed"
+    if diagnosis is not None:
+        line["device_diagnosis"] = diagnosis
     for path in (dev_partial, cpu_partial):
         try:
             os.unlink(path)
@@ -567,6 +683,132 @@ def bench_async_ckpt(reps: int, group_steps: int, sync_each_step: bool = False):
     return overhead_pct, d2h_mbps, state_bytes, save_every, stall_s, call_s
 
 
+def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
+    """Async-ckpt overhead at REALISTIC state size (>=1 GB when budget
+    allows) — the reference async writer's reason for existing is multi-GB
+    states (``checkpointing/async_ckpt/filesystem_async.py``), and round 4
+    only ever measured an 11 MB toy (VERDICT r4 'do this' #2).
+
+    Method: one warm save (pool/plan reuse — production steady state), then
+    one measured save.  ``call_ms`` is the trainer-blocking part of
+    ``async_save`` (snapshot dispatch); the drain runs in the background
+    while a fetch-anchored foreground work quantum repeats, and ``stall_ms``
+    is the summed foreground excess over its no-drain baseline across the
+    whole drain — i.e. the TOTAL foreground time one save steals.  Overhead
+    is amortized over a fixed 60 s production cadence.  D2H bandwidth is
+    measured on a fresh 64 MB leaf (the staging path's unit of transfer).
+
+    If the time budget cannot fit 1 GB (e.g. a slow relayed D2H lane), the
+    state is scaled down to what fits and reported as such — the overhead
+    model is linear in state size through ``call``+``stall``, so the
+    extrapolation to 1 GB is ``scale * measured`` and is emitted too.
+    """
+    import shutil
+
+    import numpy as np
+    import jax
+
+    from tpu_resiliency.checkpointing import AsyncCheckpointer
+
+    leaf_mb = 64
+    leaf_elems = leaf_mb * 1024 * 1024 // 4
+    bump = jax.jit(lambda v: v + 1)
+
+    # D2H at scale first — it both is a reported metric and sizes the arm.
+    probe = jax.device_put(np.ones((leaf_elems,), np.float32))
+    probe.block_until_ready()
+    samples = []
+    for _ in range(3):
+        probe = bump(probe)
+        probe.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(probe)
+        samples.append(probe.nbytes / 1e6 / max(1e-9, time.perf_counter() - t0))
+    d2h_mbps = _median(samples)
+    del probe
+
+    # Fit the state to the budget: 2 saves (warm + measured), each staging
+    # state_mb at ~d2h and writing it to disk; leave half the remaining
+    # budget for everything else.
+    budget_s = max(10.0, time_left_fn() * 0.5)
+    est_per_mb = 2 * (1.0 / max(1.0, d2h_mbps))  # stage ~ d2h; write ~ d2h-ish
+    fit_mb = int(budget_s / max(1e-6, est_per_mb))
+    state_mb = max(leaf_mb, min(target_mb, (fit_mb // leaf_mb) * leaf_mb))
+    n_leaves = state_mb // leaf_mb
+    state = {
+        f"w{i}": jax.device_put(np.full((leaf_elems,), float(i), np.float32))
+        for i in range(n_leaves)
+    }
+    jax.block_until_ready(state)
+    state_bytes = sum(l.nbytes for l in state.values())
+
+    mm = jax.jit(lambda a: a @ a)
+    a0 = jax.device_put(np.ones((256, 256), np.float32))
+    np.asarray(mm(a0))[0, 0]
+
+    def work_quantum(n=10):
+        t0 = time.perf_counter()
+        x = None
+        for _ in range(n):
+            x = mm(a0)
+        np.asarray(x[0, :1])  # fetch anchor (relay acks at dispatch)
+        return time.perf_counter() - t0
+
+    work_quantum()
+    base_s = _median([work_quantum() for _ in range(5)])
+
+    tmp = tempfile.mkdtemp(prefix="tpurx-bench-1g-")
+    ckpt = AsyncCheckpointer(write_threads=4 if light else 8)
+    out = {}
+    try:
+        ckpt.async_save(state, os.path.join(tmp, "warm"),
+                        extra_metadata={"iteration": -1})
+        ckpt.finalize_all()
+        shutil.rmtree(os.path.join(tmp, "warm"), ignore_errors=True)
+
+        t0 = time.perf_counter()
+        ckpt.async_save(state, os.path.join(tmp, "big"),
+                        extra_metadata={"iteration": 0})
+        call_s = time.perf_counter() - t0
+        quanta, truncated = [], False
+        t_drain0 = time.perf_counter()
+        cap = time_left_fn() - 10.0
+        while True:
+            if time.perf_counter() - t_drain0 >= cap:
+                truncated = True  # drain outlived the budget: stall under-
+                break             # counted — flagged, never silently valid
+            quanta.append(work_quantum())
+            ckpt.maybe_finalize()
+            if ckpt.num_pending_saves == 0:
+                break
+        ckpt.finalize_all()
+        drain_s = time.perf_counter() - t_drain0
+        stall_s = sum(max(0.0, q - base_s) for q in quanta)
+        interval_s = 60.0
+        overhead_pct = 100.0 * (call_s + stall_s) / interval_s
+        scale = (target_mb * 1024 * 1024) / state_bytes  # MiB, like the leaves
+        out = {
+            "ckpt1g_state_mb": round(state_bytes / 1e6, 1),
+            "ckpt1g_d2h_mbps": round(d2h_mbps, 1),
+            "ckpt1g_call_ms": round(call_s * 1e3, 1),
+            "ckpt1g_stall_ms": round(stall_s * 1e3, 1),
+            "ckpt1g_drain_s": round(drain_s, 2),
+            "ckpt1g_write_mbps": round(state_bytes / 1e6 / max(1e-9, drain_s), 1),
+            "ckpt1g_overhead_pct": round(overhead_pct, 3),
+        }
+        if truncated or not quanta:
+            out["ckpt1g_drain_truncated"] = True
+        if scale > 1.01:  # could not fit the full target: extrapolate
+            out["ckpt1g_scaled_down"] = True
+            out["ckpt1g_extrapolated_overhead_pct"] = round(
+                overhead_pct * scale, 3
+            )
+    finally:
+        ckpt.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def child_main(mode: str) -> None:
     budget_s = float(os.environ.get("TPURX_BENCH_CHILD_BUDGET_S", "300"))
     light = os.environ.get("TPURX_BENCH_LIGHT") == "1"
@@ -662,6 +904,15 @@ def child_main(mode: str) -> None:
             _PARTIAL["ckpt_stall_ms"] = round(ckpt_stall_s * 1e3, 1)
             _PARTIAL["ckpt_call_ms"] = round(ckpt_call_s * 1e3, 1)
             _save_partial()
+
+        if time_left() > 60:
+            try:
+                big = bench_ckpt_large(1024, time_left, light)
+                _PARTIAL.update(big)
+                _save_partial()
+            except Exception as exc:  # optional metric, never fatal
+                print(f"bench: 1GB ckpt arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
 
         if time_left() > 20:
             try:

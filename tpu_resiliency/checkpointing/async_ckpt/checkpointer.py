@@ -206,6 +206,19 @@ class AsyncCheckpointer:
             self._stager.start()
 
     def _stager_loop(self) -> None:
+        # QoS: on Linux, setpriority on the NATIVE thread id deprioritizes
+        # just this thread — staging memcpys then yield the core to the
+        # training thread instead of competing with it (the in-process
+        # analog of the write worker's nice/ionice, worker_main.py:65).
+        # Matters most on core-starved hosts; harmless elsewhere.
+        try:
+            os.setpriority(
+                os.PRIO_PROCESS,
+                threading.get_native_id(),
+                int(os.environ.get("TPURX_CKPT_STAGER_NICE", "10")),
+            )
+        except (OSError, AttributeError, ValueError):
+            pass
         while True:
             job = self._stage_q.get()
             if job is None:
@@ -301,6 +314,12 @@ class AsyncCheckpointer:
     def maybe_finalize(self, blocking: bool = False) -> List[int]:
         self._drain_staged(block=blocking)
         return self.queue.maybe_finalize_async_calls(blocking=blocking)
+
+    @property
+    def num_pending_saves(self) -> int:
+        """Saves not yet fully committed (staging queue + write queue).
+        Zero means every ``async_save`` issued so far is durable."""
+        return len(self._jobs) + self.queue.num_unfinalized_calls
 
     def finalize_all(self, timeout: float = 600.0) -> None:
         self._drain_staged(block=True, timeout=timeout)
