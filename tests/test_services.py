@@ -296,6 +296,161 @@ def test_smonsvc_slurm_adapter_with_fake_binaries(tmp_path, monkeypatch):
     assert sched.squeue_calls == 1 and sched.scontrol_calls == 2
 
 
+def test_smonsvc_gke_jobset_adapter_with_fake_kubectl(tmp_path, monkeypatch):
+    """GkeJobSetScheduler drives ``kubectl get jobsets -o json``; a fake
+    kubectl emulates a fleet with one active, one completed, and one
+    suspended JobSet.  Terminal JobSets are excluded from discovery (parity
+    with SLURM's RUNNING filter) but counted in the stats payload."""
+    from tpu_resiliency.services.smonsvc import GkeJobSetScheduler
+
+    payload = {
+        "items": [
+            {"metadata": {"name": "llama-70b"},
+             "status": {"conditions": [
+                 {"type": "Completed", "status": "False"}]}},
+            {"metadata": {"name": "old-run"},
+             "status": {"conditions": [
+                 {"type": "Completed", "status": "True"}]}},
+            {"metadata": {"name": "paused"},
+             "spec": {"suspend": True}, "status": {}},
+        ]
+    }
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "kubectl").write_text(
+        "#!/bin/sh\ncat <<'EOF'\n" + json.dumps(payload) + "\nEOF\n"
+    )
+    (bindir / "kubectl").chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    root = tmp_path / "artifacts"
+    (root / "llama-70b" / "cycles").mkdir(parents=True)
+    (root / "llama-70b" / "logs").mkdir()
+
+    sched = GkeJobSetScheduler(str(root), namespace="training")
+    assert sched.available()
+    jobs = sched.discover()
+    # active + suspended are tracked; completed is terminal
+    assert sorted(j[0] for j in jobs) == ["llama-70b", "paused"]
+    by_id = {j[0]: j for j in jobs}
+    assert by_id["llama-70b"][1] == str(root / "llama-70b" / "cycles")
+    assert by_id["llama-70b"][2] == str(root / "llama-70b" / "logs")
+    stats = sched.stats_payload()
+    assert stats["jobset_states"] == {
+        "ACTIVE": 1, "COMPLETED": 1, "SUSPENDED": 1,
+    }
+    assert stats["errors"] == 0
+
+
+def test_smonsvc_gke_monitor_integration(tmp_path, monkeypatch):
+    """A JobMonitor over the GKE adapter tracks a jobset through its cycle
+    files and surfaces the adapter stats under /status's ``gke`` key."""
+    from tpu_resiliency.services.smonsvc import GkeJobSetScheduler, JobMonitor
+
+    payload = {"items": [{"metadata": {"name": "j1"}, "status": {}}]}
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "kubectl").write_text(
+        "#!/bin/sh\ncat <<'EOF'\n" + json.dumps(payload) + "\nEOF\n"
+    )
+    (bindir / "kubectl").chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    root = tmp_path / "artifacts"
+    cycles = root / "j1" / "cycles"
+    rep = CycleInfoReporter(str(cycles), job_name="j1")
+    rep.start_cycle(0, 0, ["n0"], [], 2)
+    rep.end_cycle("success")
+
+    mon = JobMonitor(GkeJobSetScheduler(str(root)), poll_interval=0.1)
+    mon.poll_once()
+    st = mon.status()
+    assert st["jobs"]["total"] == 1
+    assert st["gke"]["calls"] == 1
+    assert mon.jobs["j1"].cycles_observed == 1
+
+
+def test_smonsvc_queued_resources_adapter_with_fake_gcloud(
+    tmp_path, monkeypatch
+):
+    """QueuedResourceScheduler drives ``gcloud compute tpus queued-resources
+    list``; only ACTIVE reservations become tracked jobs."""
+    from tpu_resiliency.services.smonsvc import QueuedResourceScheduler
+
+    payload = [
+        {"name": "projects/p/locations/us-central2-b/queuedResources/qr-a",
+         "state": {"state": "ACTIVE"}},
+        {"name": "projects/p/locations/us-central2-b/queuedResources/qr-b",
+         "state": {"state": "WAITING"}},
+        {"name": "projects/p/locations/us-central2-b/queuedResources/qr-c",
+         "state": {"state": "FAILED"}},
+    ]
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "gcloud").write_text(
+        "#!/bin/sh\ncat <<'EOF'\n" + json.dumps(payload) + "\nEOF\n"
+    )
+    (bindir / "gcloud").chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    root = tmp_path / "artifacts"
+    (root / "qr-a").mkdir(parents=True)
+
+    sched = QueuedResourceScheduler(str(root), project="p",
+                                    zone="us-central2-b")
+    assert sched.available()
+    jobs = sched.discover()
+    assert [j[0] for j in jobs] == ["qr-a"]
+    assert jobs[0][1] == str(root / "qr-a")  # no cycles/ subdir: flat
+    stats = sched.stats_payload()
+    assert stats["qr_states"] == {"ACTIVE": 1, "WAITING": 1, "FAILED": 1}
+
+
+def test_smonsvc_gke_all_namespaces_keys_by_namespace(tmp_path, monkeypatch):
+    """--all-namespaces mode must key jobsets as <namespace>/<name>: a
+    terminal duplicate name in another namespace must not shadow a live
+    job."""
+    from tpu_resiliency.services.smonsvc import GkeJobSetScheduler
+
+    payload = {
+        "items": [
+            {"metadata": {"name": "train", "namespace": "team-a"},
+             "status": {}},
+            {"metadata": {"name": "train", "namespace": "team-b"},
+             "status": {"conditions": [
+                 {"type": "Completed", "status": "True"}]}},
+        ]
+    }
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "kubectl").write_text(
+        "#!/bin/sh\ncat <<'EOF'\n" + json.dumps(payload) + "\nEOF\n"
+    )
+    (bindir / "kubectl").chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    sched = GkeJobSetScheduler(str(tmp_path / "artifacts"))
+    states = sched.states()
+    assert states == {"team-a/train": "ACTIVE", "team-b/train": "COMPLETED"}
+    assert [j[0] for j in sched.discover()] == ["team-a/train"]
+
+
+def test_smonsvc_adapters_degrade_without_binaries(tmp_path, monkeypatch):
+    """No kubectl/gcloud on PATH: adapters report unavailable and discovery
+    returns empty instead of crashing the monitor loop."""
+    from tpu_resiliency.services.smonsvc import (
+        GkeJobSetScheduler,
+        QueuedResourceScheduler,
+    )
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # empty dir
+    gke = GkeJobSetScheduler(str(tmp_path))
+    qr = QueuedResourceScheduler(str(tmp_path))
+    assert not gke.available() and not qr.available()
+    assert gke.discover() == [] and qr.discover() == []
+    assert gke.errors == 1 and qr.errors == 1
+
+
 def test_smonsvc_status_server_endpoints(tmp_path):
     import urllib.request as _rq
 

@@ -15,6 +15,11 @@ with scheduler adapters layered on top for discovery:
   ``slurm.py`` compressed): running jobs become tracked jobs, their StdOut
   paths become log paths.  Degrades to unavailable when slurm isn't
   installed.
+- :class:`GkeJobSetScheduler` — GKE JobSet discovery via kubectl, the
+  scheduler real TPU fleets run on; artifacts ride a shared
+  ``<artifacts_root>/<jobset>/{cycles,logs}`` mount.
+- :class:`QueuedResourceScheduler` — Cloud TPU queued-resources discovery
+  via gcloud for fleets provisioning slices directly.
 
 Per-job state rides :class:`JobRecord` (reference ``models.py``); restart
 statistics are **windowed** (15 min / 1 h / 24 h sliding counts + a
@@ -233,6 +238,202 @@ class SlurmScheduler:
         return jobs
 
 
+class GkeJobSetScheduler:
+    """GKE JobSet discovery — the scheduler real TPU fleets run on.
+
+    The reference's fleet watcher adapts to SLURM
+    (``services/smonsvc/monitor.py``); on Google Cloud the idiomatic
+    equivalent is one training job per JobSet (``kubectl get jobsets``),
+    with multi-host TPU slices appearing as replicated Jobs.  Liveness
+    comes from JobSet status conditions; artifacts follow the shared-volume
+    convention ``<artifacts_root>/<jobset>/{cycles,logs}`` — a GCS FUSE or
+    Filestore mount the launchers' ``--cycle-info-dir`` points into — which
+    keeps the watcher independent of pod log streaming.  All kubectl calls
+    are subprocess-guarded exactly like the SLURM path: a host without
+    kubectl reports unavailable instead of crashing the monitor.
+    """
+
+    name = "gke"
+
+    def __init__(self, artifacts_root: str, namespace: Optional[str] = None,
+                 selector: Optional[str] = None, kubectl: str = "kubectl"):
+        self.artifacts_root = artifacts_root
+        self.namespace = namespace
+        self.selector = selector
+        self.kubectl = kubectl
+        self.calls = 0
+        self.errors = 0
+        self.last_states: Dict[str, str] = {}
+
+    def available(self) -> bool:
+        return shutil.which(self.kubectl) is not None
+
+    def _run(self, cmd: List[str]) -> Optional[str]:
+        try:
+            out = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=30,
+            )
+            if out.returncode != 0:
+                self.errors += 1
+                return None
+            return out.stdout
+        except (OSError, subprocess.SubprocessError):
+            self.errors += 1
+            return None
+
+    def _list(self) -> List[Dict]:
+        cmd = [self.kubectl, "get", "jobsets", "-o", "json"]
+        if self.namespace:
+            cmd += ["-n", self.namespace]
+        else:
+            cmd += ["--all-namespaces"]
+        if self.selector:
+            cmd += ["-l", self.selector]
+        self.calls += 1
+        out = self._run(cmd)
+        if out is None:
+            return []
+        try:
+            return json.loads(out).get("items", [])
+        except json.JSONDecodeError:
+            self.errors += 1
+            return []
+
+    @staticmethod
+    def _state_of(item: Dict) -> str:
+        if item.get("spec", {}).get("suspend"):
+            return "SUSPENDED"
+        for cond in item.get("status", {}).get("conditions", []):
+            if str(cond.get("status", "")).lower() == "true":
+                if cond.get("type") == "Completed":
+                    return "COMPLETED"
+                if cond.get("type") == "Failed":
+                    return "FAILED"
+        return "ACTIVE"
+
+    def states(self) -> Dict[str, str]:
+        """jobset id -> lifecycle state (also cached for stats_payload).
+
+        With ``--all-namespaces`` (namespace=None) ids are
+        ``<namespace>/<name>`` — bare names collide across namespaces and a
+        terminal duplicate would shadow a live job."""
+        states = {}
+        for item in self._list():
+            meta = item.get("metadata", {})
+            name = meta.get("name")
+            if not name:
+                continue
+            if self.namespace is None and meta.get("namespace"):
+                name = f"{meta['namespace']}/{name}"
+            states[name] = self._state_of(item)
+        self.last_states = states
+        return states
+
+    def _job_dirs(self, job_id: str) -> Tuple[str, Optional[str]]:
+        jdir = os.path.join(self.artifacts_root, job_id)
+        cand = os.path.join(jdir, "cycles")
+        cdir = cand if os.path.isdir(cand) else jdir
+        ldir = os.path.join(jdir, "logs")
+        return cdir, (ldir if os.path.isdir(ldir) else None)
+
+    def discover(self) -> List[Tuple[str, str, Optional[str]]]:
+        jobs = []
+        for job_id, state in self.states().items():
+            if state in ("COMPLETED", "FAILED"):
+                continue  # terminal: parity with SLURM's RUNNING filter
+            cdir, ldir = self._job_dirs(job_id)
+            jobs.append((job_id, cdir, ldir))
+        return jobs
+
+    def stats_payload(self) -> Dict:
+        return {
+            "available": self.available(),
+            "calls": self.calls,
+            "errors": self.errors,
+            "jobset_states": dict(
+                collections.Counter(self.last_states.values())
+            ),
+        }
+
+
+class QueuedResourceScheduler:
+    """Cloud TPU queued-resources discovery.
+
+    Fleets that provision TPU slices directly (no GKE) go through queued
+    resources: ``gcloud compute tpus queued-resources list`` yields each
+    reservation with a state (WAITING/PROVISIONING/ACTIVE/SUSPENDED/
+    FAILED...).  An ACTIVE QR is a live job slot; its artifacts follow the
+    same shared-root convention keyed by QR name.  Subprocess-guarded like
+    the other adapters.
+    """
+
+    name = "queued_resources"
+
+    def __init__(self, artifacts_root: str, project: Optional[str] = None,
+                 zone: Optional[str] = None, gcloud: str = "gcloud"):
+        self.artifacts_root = artifacts_root
+        self.project = project
+        self.zone = zone
+        self.gcloud = gcloud
+        self.calls = 0
+        self.errors = 0
+        self.last_states: Dict[str, str] = {}
+
+    def available(self) -> bool:
+        return shutil.which(self.gcloud) is not None
+
+    _run = GkeJobSetScheduler._run  # same guarded-subprocess contract
+
+    def _list(self) -> List[Dict]:
+        cmd = [self.gcloud, "compute", "tpus", "queued-resources", "list",
+               "--format=json"]
+        if self.project:
+            cmd += ["--project", self.project]
+        if self.zone:
+            cmd += ["--zone", self.zone]
+        self.calls += 1
+        out = self._run(cmd)
+        if out is None:
+            return []
+        try:
+            items = json.loads(out)
+            return items if isinstance(items, list) else []
+        except json.JSONDecodeError:
+            self.errors += 1
+            return []
+
+    def states(self) -> Dict[str, str]:
+        states = {}
+        for item in self._list():
+            # full name: projects/<p>/locations/<z>/queuedResources/<id>
+            name = (item.get("name") or "").rsplit("/", 1)[-1]
+            state = (item.get("state") or {}).get("state", "UNKNOWN")
+            if name:
+                states[name] = state
+        self.last_states = states
+        return states
+
+    def discover(self) -> List[Tuple[str, str, Optional[str]]]:
+        jobs = []
+        for job_id, state in self.states().items():
+            if state != "ACTIVE":
+                continue
+            jdir = os.path.join(self.artifacts_root, job_id)
+            cand = os.path.join(jdir, "cycles")
+            cdir = cand if os.path.isdir(cand) else jdir
+            ldir = os.path.join(jdir, "logs")
+            jobs.append((job_id, cdir, ldir if os.path.isdir(ldir) else None))
+        return jobs
+
+    def stats_payload(self) -> Dict:
+        return {
+            "available": self.available(),
+            "calls": self.calls,
+            "errors": self.errors,
+            "qr_states": dict(collections.Counter(self.last_states.values())),
+        }
+
+
 # -- the monitor -------------------------------------------------------------
 
 
@@ -400,6 +601,8 @@ class JobMonitor:
                 "scontrol_calls": sched.scontrol_calls,
                 "errors": sched.errors,
             }
+        elif hasattr(sched, "name") and hasattr(sched, "stats_payload"):
+            payload[sched.name] = sched.stats_payload()
         return payload
 
     def jobs_payload(self) -> List[Dict]:
@@ -478,6 +681,19 @@ def main(argv=None) -> None:
                    help="discover jobs from squeue/scontrol")
     p.add_argument("--slurm-user", default=None)
     p.add_argument("--slurm-partition", default=None)
+    p.add_argument("--gke", action="store_true",
+                   help="discover jobs from GKE JobSets (kubectl)")
+    p.add_argument("--gke-namespace", default=None)
+    p.add_argument("--gke-selector", default=None,
+                   help="label selector limiting the watched JobSets")
+    p.add_argument("--queued-resources", action="store_true",
+                   help="discover jobs from Cloud TPU queued-resources "
+                        "(gcloud)")
+    p.add_argument("--qr-project", default=None)
+    p.add_argument("--qr-zone", default=None)
+    p.add_argument("--artifacts-root", default=None,
+                   help="shared mount holding <job>/{cycles,logs} trees "
+                        "(required with --gke / --queued-resources)")
     p.add_argument("--attrsvc", default=None, help="attribution service URL")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8960)
@@ -489,6 +705,22 @@ def main(argv=None) -> None:
         scheduler = SlurmScheduler(args.slurm_user, args.slurm_partition)
         if not scheduler.available():
             p.error("--slurm requested but squeue is not on PATH")
+    elif args.gke:
+        if not args.artifacts_root:
+            p.error("--gke requires --artifacts-root")
+        scheduler = GkeJobSetScheduler(
+            args.artifacts_root, args.gke_namespace, args.gke_selector,
+        )
+        if not scheduler.available():
+            p.error("--gke requested but kubectl is not on PATH")
+    elif args.queued_resources:
+        if not args.artifacts_root:
+            p.error("--queued-resources requires --artifacts-root")
+        scheduler = QueuedResourceScheduler(
+            args.artifacts_root, args.qr_project, args.qr_zone,
+        )
+        if not scheduler.available():
+            p.error("--queued-resources requested but gcloud is not on PATH")
     elif args.jobs_root:
         scheduler = MultiJobDirectoryScheduler(args.jobs_root)
     elif args.cycle_info_dir:
