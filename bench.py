@@ -163,14 +163,14 @@ def _collect_device_diagnosis(probe: dict, stale_killed: int) -> dict:
     try:
         from tpu_resiliency.health.tpu import TpuSysHealthCheck
 
-        r = TpuSysHealthCheck().check()
+        r = TpuSysHealthCheck().run()
         diag["sysfs_tpu"] = {"healthy": bool(r), "message": r.message[:200]}
     except Exception as exc:  # noqa: BLE001 - diagnosis must never fail
         diag["sysfs_tpu"] = {"error": repr(exc)[:200]}
     try:
         from tpu_resiliency.health.kmsg import KernelLogHealthCheck
 
-        r = KernelLogHealthCheck().check()
+        r = KernelLogHealthCheck().run()
         diag["kmsg"] = {"healthy": bool(r), "message": r.message[:200]}
     except Exception as exc:  # noqa: BLE001
         diag["kmsg"] = {"error": repr(exc)[:200]}
@@ -328,7 +328,7 @@ def _compose_line(partial: dict, platform: str) -> dict:
     }
     for key in (
         "detection_budget_ms", "beat_jitter_p99_ms",
-        "transport_readback_ms", "collective_extra_ms",
+        "transport_readback_ms", "collective_extra_ms", "collective_only_ms",
         "ring_detect_ms", "ring_recover_ms", "async_ckpt_overhead_pct",
         "async_ckpt_vs_target", "d2h_mbps", "ckpt_state_mb",
         "ckpt_save_every", "ckpt_stall_ms", "ckpt_call_ms",
@@ -452,8 +452,17 @@ def bench_detection(mesh, step_dispatch, repeats: int):
     """End-to-end hung-rank detection latency with a calibrated budget.
 
     Healthy phase: auto-beat at 1ms + training dispatches in flight.
-    Hang: stamps freeze (stop_auto_beat).  The tick loop (the healthy
-    peers' role in a pod) keeps reducing; latency = freeze -> stale trip."""
+    Hang: stamps freeze (stop_auto_beat).  The DENSE re-dispatched chain
+    (interval=0: the next collective dispatches the moment a slot frees)
+    plays the healthy peers' role; latency = freeze -> stale trip.
+
+    Floor accounting (measured, r5): e2e = budget + dispatch cadence + one
+    readback.  The dense chain collapses the cadence term from a polling
+    interval to the dispatch cost itself; the budget is calibrated UNDER
+    TRAINING LOAD (load_fn=step_dispatch) so safety*p99 + 0.5ms margin is
+    tight without false trips — idle-calibrated budgets undershoot the
+    stamp lateness a busy interpreter produces.  Finer beats than 1ms
+    RAISE p99 on a contended host (GIL thrash), so 1ms stays the beat."""
     from tpu_resiliency.ops.quorum import QuorumMonitor
 
     latencies, budgets, p99s = [], [], []
@@ -465,12 +474,15 @@ def bench_detection(mesh, step_dispatch, repeats: int):
                 _h["t_detect"] = time.monotonic()
 
         mon = QuorumMonitor(
-            mesh, budget_ms=1e9, interval=0.01, on_stale=on_stale,
+            mesh, budget_ms=1e9, interval=0.0, on_stale=on_stale,
             auto_beat_interval=0.001, fetch_workers=8,
         )
         # min_budget_ms=1: let calibration find the PLATFORM floor (beat
         # jitter p99 x safety), not an operator default
-        budgets.append(mon.calibrate(n_ticks=15, min_budget_ms=1.0))
+        budgets.append(mon.calibrate(
+            n_ticks=15, min_budget_ms=1.0, margin_ms=0.5,
+            load_fn=step_dispatch,
+        ))
         p99s.append(mon.last_calibration_p99_ms)
         mon.start()
         t_end = time.monotonic() + 0.25
@@ -577,7 +589,8 @@ def bench_transport_and_collective(mesh):
         qfn(stamps)
         t_q.append((time.perf_counter() - t0) * 1e3)
     readback = _median(t_triv)
-    return readback, max(0.0, _median(t_q) - readback)
+    collective_only = _median(t_q)  # full dispatch->evaluated quorum latency
+    return readback, max(0.0, collective_only - readback), collective_only
 
 
 def bench_async_ckpt(reps: int, group_steps: int, sync_each_step: bool = False):
@@ -856,9 +869,11 @@ def child_main(mode: str) -> None:
                 # every measurement reads queue depth, not the framework
                 float(loss)
 
-        readback_ms, collective_extra_ms = bench_transport_and_collective(mesh)
+        (readback_ms, collective_extra_ms,
+         collective_only_ms) = bench_transport_and_collective(mesh)
         _PARTIAL["transport_readback_ms"] = round(readback_ms, 3)
         _PARTIAL["collective_extra_ms"] = round(collective_extra_ms, 3)
+        _PARTIAL["collective_only_ms"] = round(collective_only_ms, 3)
         _save_partial()
 
         detect_ms, budget_ms, beat_p99_ms = bench_detection(

@@ -197,6 +197,99 @@ def test_quorum_overlapped_loop_and_calibrate():
     assert (time.monotonic() - t0) * 1000 < 2000
 
 
+def test_quorum_dense_chain_and_load_calibration():
+    """interval=0 (dense re-dispatched chain): the next collective
+    dispatches as soon as a slot frees, so the cadence term of the
+    detection floor collapses to the dispatch cost; calibrate(load_fn=...)
+    samples healthy ages UNDER LOAD so a tight margin stays honest."""
+    import jax
+
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("all",), (len(jax.devices()),))
+    hits = []
+    loads = []
+    mon = QuorumMonitor(
+        mesh, budget_ms=1e9, interval=0.0,
+        on_stale=lambda age: hits.append(age), use_pallas=False,
+        auto_beat_interval=0.001, fetch_workers=4,
+    )
+    try:
+        # default margin/floor: the test's subject is the dense loop and the
+        # load_fn plumbing, not budget tightness — a deliberately tight
+        # budget here would flake on loaded CI hosts
+        budget = mon.calibrate(n_ticks=8, load_fn=lambda: loads.append(1))
+        assert len(loads) == 8          # load ran before every sample
+        assert budget >= 5.0
+        mon.start()
+        time.sleep(0.25)
+        assert not hits, f"false trip on healthy pod: {hits}"
+        assert mon.last_max_age is not None
+        mon.stop_auto_beat()
+        t0 = time.monotonic()
+        while not hits and time.monotonic() - t0 < 5.0:
+            time.sleep(0.002)
+        assert hits
+        # dense chain on a loaded host: generous bound, but far under the
+        # pipelined loop's interval-dominated latency
+        assert (time.monotonic() - t0) * 1000 < 2000
+    finally:
+        mon.stop()
+
+
+def test_quorum_online_recalibration_under_load():
+    """After N in-vivo healthy ticks, the budget is recomputed from ages
+    observed UNDER the real workload (idle pre-start calibration undershoots
+    busy-interpreter stamp lateness); tripping ages are excluded so a real
+    hang cannot inflate its own detection budget."""
+    import jax
+
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("all",), (len(jax.devices()),))
+    mon = QuorumMonitor(
+        mesh, budget_ms=1000.0, interval=0.005, use_pallas=False,
+        auto_beat_interval=0.001, online_recalibrate_after=10,
+        online_min_budget_ms=2.0,
+    )
+    try:
+        mon.beat()
+        # feed synthetic healthy ages through the observation hook
+        for age in [1.0, 1.2, 0.8, 1.1, 2.0, 1.4, 0.9, 1.3, 1.1]:
+            mon._observe_healthy_age(age)
+        assert not mon._recal_done
+        mon._observe_healthy_age(1.6)   # 10th sample completes the window
+        assert mon._recal_done
+        # budget = max(floor, 3*p99 + 2) with p99 = 2.0 -> 8.0
+        assert abs(mon.budget_ms - 8.0) < 1e-6
+        # further observations are no-ops
+        mon._observe_healthy_age(500.0)
+        assert abs(mon.budget_ms - 8.0) < 1e-6
+    finally:
+        mon.stop()
+
+
+def test_quorum_online_recalibration_excludes_tripping_ages():
+    import jax
+
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("all",), (len(jax.devices()),))
+    mon = QuorumMonitor(
+        mesh, budget_ms=10.0, interval=0.005, use_pallas=False,
+        online_recalibrate_after=3,
+    )
+    try:
+        mon._observe_healthy_age(5000.0)   # tripping age: excluded
+        assert not mon._recal_ages
+        for age in [1.0, 1.0, 1.0]:
+            mon._observe_healthy_age(age)
+        assert mon._recal_done
+        assert mon.budget_ms == max(2.0, 3.0 * 1.0 + 2.0)
+    finally:
+        mon.stop()
+
+
 def test_calibrate_floor_release_and_p99_export():
     """min_budget_ms releases the operator floor; the measured healthy p99
     is exported for the bench's floor-accounting (beat_jitter_p99_ms)."""

@@ -72,7 +72,7 @@ class QuorumTripwire:
         interval: float = 0.01,
         auto_beat_interval: Optional[float] = 0.002,
         calibrate: bool = True,
-        min_budget_ms: float = 5.0,
+        min_budget_ms: float = 2.0,
         use_pallas: Optional[bool] = None,
         fetch_workers: int = 0,
         on_trip: Optional[Callable[[int, int], None]] = None,
@@ -96,6 +96,11 @@ class QuorumTripwire:
             use_pallas=use_pallas,
             fetch_workers=fetch_workers,
             identify=True,
+            # pre-start calibration can only sample an idle interpreter;
+            # after 256 in-vivo healthy ticks under the real workload the
+            # budget is recomputed from those samples (see QuorumMonitor)
+            online_recalibrate_after=256,
+            online_min_budget_ms=min_budget_ms,
         )
 
     # -- workload API ------------------------------------------------------
@@ -107,7 +112,12 @@ class QuorumTripwire:
         self._iteration = iteration
         self._fired_iteration = None
         if self.calibrate:
+            # the idle-calibrated budget is PROVISIONAL: doubled until the
+            # online recalibration has seen real-workload ages, because an
+            # idle sample undershoots busy-interpreter stamp lateness and
+            # a too-tight early budget would fire a spurious restart
             self.monitor.calibrate(min_budget_ms=self.min_budget_ms)
+            self.monitor.budget_ms *= 2.0
         self.monitor.start()
         return self
 

@@ -74,7 +74,10 @@ class Wrapper:
         quorum_interval: float = 0.01,
         quorum_auto_beat_interval: Optional[float] = 0.002,
         quorum_calibrate: bool = True,
-        quorum_min_budget_ms: float = 5.0,
+        # operator floor only — calibration (safety*p99 + margin, sampled on
+        # this host) finds the real budget; 2ms keeps a guardrail while
+        # letting low-jitter hosts detect in ~3ms instead of flooring at 5
+        quorum_min_budget_ms: float = 2.0,
     ):
         self.store_factory = store_factory or store_from_env
         self.group = group

@@ -216,6 +216,8 @@ class QuorumMonitor:
         auto_beat_interval: Optional[float] = None,
         fetch_workers: int = 0,
         identify: bool = False,
+        online_recalibrate_after: Optional[int] = None,
+        online_min_budget_ms: float = 2.0,
     ):
         self.mesh = mesh
         self.budget_ms = budget_ms
@@ -266,6 +268,18 @@ class QuorumMonitor:
         self.last_max_age: Optional[int] = None
         self.last_stale_device: Optional[int] = None
         self.last_calibration_p99_ms: Optional[float] = None
+        # Online recalibration: a pre-start calibrate() can only sample an
+        # IDLE interpreter, and an idle-calibrated budget undershoots the
+        # stamp lateness real training produces (false trips) — so after
+        # ``online_recalibrate_after`` healthy ages observed by the RUNNING
+        # loop (i.e. under the actual workload), the budget is recomputed
+        # once from those in-vivo samples: safety*p99 + margin, floored at
+        # ``online_min_budget_ms``.  Tripping ages are excluded (a real
+        # hang must not inflate its own detection budget).
+        self._recal_after = online_recalibrate_after
+        self._recal_min_budget = online_min_budget_ms
+        self._recal_ages: list = []
+        self._recal_done = False
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
@@ -309,27 +323,36 @@ class QuorumMonitor:
         self._start_beater()
 
     def calibrate(self, n_ticks: int = 20, safety: float = 3.0,
-                  margin_ms: float = 2.0, min_budget_ms: float = 5.0) -> float:
+                  margin_ms: float = 2.0, min_budget_ms: float = 5.0,
+                  load_fn: Optional[Callable] = None) -> float:
         """Derive the detection budget from OBSERVED healthy tick ages
         (beat jitter + scheduling noise) instead of a safety factor over the
         beat period alone — ages already embed every real-world delay, so the
         budget is as tight as the platform allows without false positives.
         Runs ``n_ticks`` blocking ticks, sets and returns ``budget_ms``.
 
+        ``load_fn`` (e.g. one training-step dispatch) runs before each
+        calibration tick so the sampled ages embed the GIL/scheduler
+        contention of REAL training — required before trusting a tight
+        ``margin_ms``: a budget calibrated on an idle interpreter undershoots
+        the stamp lateness a busy one produces and then false-trips.
+
         The floor physics (BASELINE north-star accounting): in XLA's
         execution model a collective observes stamps only at dispatch, so
         end-to-end detection = budget + dispatch cadence + one readback.
         The budget itself cannot go below the observed p99 healthy age
-        times ``safety`` without false positives — and that p99 is
-        GIL-scheduling jitter of the Python beater thread, which is
-        load-bearing: a C beater would keep stamping through a GIL-wedged
-        interpreter and mask exactly the hangs this exists to catch.
-        ``min_budget_ms`` is an operator floor, not a physical one; set it
-        to ~1 to let the calibration find the platform's true floor (the
-        measured p99 is kept in ``last_calibration_p99_ms``)."""
+        times ``safety`` without false positives — and that p99 is the beat
+        interval plus GIL-scheduling jitter of the Python beater thread,
+        which is load-bearing: a C beater would keep stamping through a
+        GIL-wedged interpreter and mask exactly the hangs this exists to
+        catch.  ``min_budget_ms`` is an operator floor, not a physical one;
+        set it to ~1 to let the calibration find the platform's true floor
+        (the measured p99 is kept in ``last_calibration_p99_ms``)."""
         self._start_beater()
         ages = []
         for _ in range(max(3, n_ticks)):
+            if load_fn is not None:
+                load_fn()
             saved = self.budget_ms
             self.budget_ms = float("inf")  # no trips during calibration
             try:
@@ -341,6 +364,26 @@ class QuorumMonitor:
         self.last_calibration_p99_ms = p99
         self.budget_ms = max(min_budget_ms, safety * p99 + margin_ms)
         return self.budget_ms
+
+    def _observe_healthy_age(self, age: float) -> None:
+        """Feed the online recalibration with an under-load healthy age."""
+        if self._recal_after is None or self._recal_done or age > self.budget_ms:
+            return
+        self._recal_ages.append(float(age))
+        if len(self._recal_ages) < self._recal_after:
+            return
+        ages = sorted(self._recal_ages)
+        p99 = ages[min(len(ages) - 1, int(0.99 * len(ages)))]
+        new_budget = max(self._recal_min_budget, 3.0 * p99 + 2.0)
+        log.info(
+            "quorum online recalibration: budget %.1fms -> %.1fms "
+            "(p99 under load %.2fms over %d ticks)",
+            self.budget_ms, new_budget, p99, len(ages),
+        )
+        self.last_calibration_p99_ms = p99
+        self.budget_ms = new_budget
+        self._recal_done = True
+        self._recal_ages = []
 
     def _split(self, result):
         if self.identify:
@@ -364,6 +407,7 @@ class QuorumMonitor:
         age, dev = self._split(self._fn(stamps))
         self.last_max_age = age
         self.last_stale_device = dev
+        self._observe_healthy_age(age)
         if age > self.budget_ms:
             self._fire(age, dev)
         return age
@@ -395,6 +439,7 @@ class QuorumMonitor:
         age, dev = self._split(self._fn_async.finish(int(value)))
         self.last_max_age = age
         self.last_stale_device = dev
+        self._observe_healthy_age(age)
         if age > self.budget_ms and t_disp > self._fence_t:
             self._fire(age, dev)
         return age
@@ -476,10 +521,17 @@ class QuorumMonitor:
                     self._last_seq = seq
                     self.last_max_age = age
                     self.last_stale_device = dev
+                    self._observe_healthy_age(age)
                     fire = age > self.budget_ms and t_disp > self._fence_t
                 if fire:
                     self._fire(age, dev)
 
+        # interval == 0 is the DENSE RE-DISPATCHED CHAIN: the next collective
+        # dispatches the moment a slot frees, so the cadence term of the
+        # detection floor (budget + cadence + readback) collapses from a
+        # polling interval to the dispatch cost itself (~0.1-0.5 ms).  The
+        # in-flight cap keeps the chain bounded; evaluation stays on the
+        # fetch pool.
         seq = 0
         with ThreadPoolExecutor(
             max_workers=self.fetch_workers, thread_name_prefix="tpurx-quorum-fetch"
@@ -498,7 +550,11 @@ class QuorumMonitor:
                     with lock:
                         inflight[0] += 1
                     pool.submit(evaluate, seq, time.monotonic(), pending)
-                self._stop.wait(self.interval)
+                    if self.interval > 0:
+                        self._stop.wait(self.interval)
+                else:
+                    # all slots busy: yield briefly instead of spinning
+                    self._stop.wait(self.interval or 0.0002)
 
     def stop(self) -> None:
         self._stop.set()
