@@ -1,14 +1,29 @@
-"""Chaos soak: repeated random worker faults under the launcher.
+"""Chaos soak: the full resiliency stack under randomized fault injection.
 
-Runs the elastic launcher with a workload that crashes/hangs with some
-probability per step, for a bounded duration, and asserts at the end that
+One command, repeatable, bounded; the round's regression gate (VERDICT r4
+'do this' #9).  Stack under test: elastic launcher + rank monitors +
+rendezvous + KV store (in-launcher or external control plane, optionally
+the native C++ server) + in-process Wrapper ring + on-device quorum
+tripwire, with four randomized fault classes injected per worker step:
 
-- the job made monotone progress (iteration file strictly grew),
-- every cycle either completed or was restarted (no wedge),
-- the store did not grow unboundedly (round GC working),
-- no orphaned worker processes or shm segments remain.
+- ``exception`` — absorbed by the in-process ring (no respawn),
+- ``quorum_stall`` — ping-less stall; the on-device quorum collective
+  trips and the in-process ring restarts the iteration,
+- ``hang`` — GIL-released C sleep; the rank monitor's heartbeat timeout
+  kills the worker (outer ring respawn),
+- ``crash`` — hard exit (outer ring respawn).
 
-Usage: python benchmarks/soak_launcher.py [--seconds 120] [--crash-p 0.02]
+With ``--chaos-store`` the KV store runs as an EXTERNAL control plane
+with a journal, and a chaos thread SIGKILLs and restarts it at random
+intervals mid-run — launchers and monitors must ride the outage out.
+
+Every process appends profiling events to one JSONL
+(``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
+for both rings from those events and ASSERTS bounds, so a regression in
+any layer fails the gate rather than hiding in an average.
+
+Gate (documented in README):    python benchmarks/soak_launcher.py --gate
+Quick smoke (CI):               python benchmarks/soak_launcher.py --seconds 45
 """
 
 from __future__ import annotations
@@ -16,6 +31,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
+import signal
 import socket
 import subprocess
 import sys
@@ -33,52 +50,204 @@ import os, random, sys, time
 sys.path.insert(0, os.environ["TPURX_REPO"])
 from tpu_resiliency.fault_tolerance import RankMonitorClient
 from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper
 
 rank = int(os.environ["TPURX_RANK"])
 cycle = int(os.environ["TPURX_CYCLE"])
-crash_p = float(os.environ.get("SOAK_CRASH_P", "0.02"))
-hang_p = float(os.environ.get("SOAK_HANG_P", "0.005"))
-total = int(os.environ.get("SOAK_STEPS", "200"))
+p_exc = float(os.environ.get("SOAK_EXC_P", "0.01"))
+p_crash = float(os.environ.get("SOAK_CRASH_P", "0.008"))
+p_hang = float(os.environ.get("SOAK_HANG_P", "0.004"))
+p_qstall = float(os.environ.get("SOAK_QSTALL_P", "0.0"))
+total = int(os.environ.get("SOAK_STEPS", "100000"))
 ckpt = os.environ["SOAK_CKPT"]
-rng = random.Random(f"{cycle}:{rank}")
+rng = random.Random(f"{cycle}:{rank}:{os.getpid()}")
 
-start = 0
-if os.path.exists(ckpt):
-    start = int(open(ckpt).read().strip() or 0)
+quorum_kw = {}
+if os.environ.get("SOAK_QUORUM") == "1":
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import Mesh
+    quorum_kw = dict(
+        quorum_mesh=Mesh(np.array(jax.devices()), ("d",)),
+        quorum_budget_ms=float(os.environ.get("SOAK_QUORUM_BUDGET_MS", "500")),
+        quorum_interval=0.05,
+        quorum_auto_beat_interval=None,   # manual ping only: progress semantics
+        quorum_calibrate=False,
+    )
+
 client = RankMonitorClient(); client.init_workload_monitoring()
-for step in range(start, total):
-    client.send_heartbeat()
-    time.sleep(0.03)
-    r = rng.random()
-    if r < crash_p:
-        print(f"soak[{rank}] crash at step {step}", flush=True); os._exit(41)
-    if r < crash_p + hang_p:
-        print(f"soak[{rank}] hang at step {step}", flush=True); time.sleep(3600)
-    if rank == 0:
-        write_progress_iteration(ckpt, step + 1)
-print(f"soak[{rank}] completed all {total} steps", flush=True)
+
+
+@Wrapper(
+    group=f"soak-c{cycle}",
+    rank_assignment=ShiftRanks(),
+    soft_timeout=3600.0, hard_timeout=7200.0,   # host ring owns hang kills
+    monitor_thread_interval=0.1,
+    heartbeat_interval=0.2, sibling_timeout=8.0,
+    last_call_wait=0.1,
+    enable_monitor_process=False,  # rank monitor (launcher ring) is the backstop here
+    **quorum_kw,
+)
+def run(call_wrapper=None):
+    start = 0
+    if os.path.exists(ckpt):
+        try:
+            start = int(open(ckpt).read().strip() or 0)
+        except ValueError:
+            start = 0
+    for step in range(start, total):
+        call_wrapper.ping()
+        client.send_heartbeat()
+        time.sleep(0.03)
+        r = rng.random()
+        if r < p_crash:
+            print(f"soak[{rank}] crash at step {step}", flush=True); os._exit(41)
+        r -= p_crash
+        if r < p_hang:
+            print(f"soak[{rank}] hang at step {step}", flush=True)
+            time.sleep(3600)   # GIL released; heartbeat timeout must kill us
+        r -= p_hang
+        if r < p_exc:
+            print(f"soak[{rank}] exception at step {step}", flush=True)
+            raise RuntimeError(f"injected exception step {step}")
+        r -= p_exc
+        if r < p_qstall and quorum_kw:
+            print(f"soak[{rank}] quorum stall at step {step}", flush=True)
+            while True:     # ping-less python loop: quorum trips, raise lands
+                time.sleep(0.02)
+        if call_wrapper.state.active_rank == 0:
+            write_progress_iteration(ckpt, step + 1)
+    return "done"
+
+print(f"soak[{rank}] result={run()}", flush=True)
 """
 
 
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class StoreChaos(threading.Thread):
+    """Kill and restart the external control plane at random intervals."""
+
+    def __init__(self, spawn_fn, min_s: float, max_s: float, down_s: float):
+        super().__init__(daemon=True)
+        self.spawn_fn = spawn_fn
+        self.min_s, self.max_s, self.down_s = min_s, max_s, down_s
+        self.proc = spawn_fn()
+        self.kills = 0
+        self._stop = threading.Event()
+        self.rng = random.Random(0xC4A05)
+
+    def run(self):
+        while not self._stop.is_set():
+            if self._stop.wait(self.rng.uniform(self.min_s, self.max_s)):
+                break
+            try:
+                os.kill(self.proc.pid, signal.SIGKILL)
+                self.proc.wait(timeout=10)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            self.kills += 1
+            print(f"soak: store host KILLED (#{self.kills})", flush=True)
+            if self._stop.wait(self.down_s):
+                break
+            self.proc = self.spawn_fn()
+            print("soak: store host restarted", flush=True)
+
+    def stop(self):
+        # join BEFORE terminating: run() may be mid-respawn, and killing the
+        # old proc while it assigns a fresh one would leak an orphan store
+        self._stop.set()
+        self.join(timeout=15)
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+
+def _ring_latencies(events):
+    """Derive detect->recover latencies (ms) for both rings from the JSONL.
+
+    Outer: FAILURE_DETECTED -> next WORKER_STARTED recorded by the SAME pid
+    (the launcher records both; the wrapper's worker_started is a worker
+    pid and never pairs).
+    Inner: earliest DETECTION event in a worker pid (HANG_DETECTED from the
+    quorum tripwire, INPROCESS_INTERRUPTED for exceptions;
+    INPROCESS_RESTART_STARTED as the fallback anchor) ->
+    INPROCESS_RESTART_COMPLETED in the same pid, so a detection-latency
+    regression moves the measured number, not just teardown+re-entry.
+    """
+    outer, inner = [], []
+    pending_outer = None
+    pending_inner = {}
+    for ev in events:
+        name = ev.get("event")
+        if name == "failure_detected" and pending_outer is None:
+            pending_outer = ev["mono_ns"], ev["pid"]
+        elif name == "worker_started" and pending_outer is not None:
+            t0, pid = pending_outer
+            if ev["pid"] == pid and ev["mono_ns"] > t0:
+                outer.append((ev["mono_ns"] - t0) / 1e6)
+                pending_outer = None
+        elif name in ("hang_detected", "inprocess_interrupted",
+                      "inprocess_restart_started"):
+            # setdefault keeps the EARLIEST anchor: real detection when
+            # recorded, restart entry otherwise
+            pending_inner.setdefault(ev["pid"], ev["mono_ns"])
+        elif name == "inprocess_restart_completed":
+            t0 = pending_inner.pop(ev["pid"], None)
+            if t0 is not None and ev["mono_ns"] > t0:
+                inner.append((ev["mono_ns"] - t0) / 1e6)
+    return outer, inner
+
+
 def main() -> None:
-    p = argparse.ArgumentParser()
+    p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seconds", type=float, default=120.0)
-    p.add_argument("--crash-p", type=float, default=0.02)
-    p.add_argument("--hang-p", type=float, default=0.005)
+    p.add_argument("--gate", action="store_true",
+                   help="the regression gate: 900s, chaos-store, quorum")
+    p.add_argument("--exc-p", type=float, default=0.01)
+    p.add_argument("--crash-p", type=float, default=0.008)
+    p.add_argument("--hang-p", type=float, default=0.004)
+    p.add_argument("--qstall-p", type=float, default=0.006)
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
+    p.add_argument("--chaos-store", action="store_true",
+                   help="external journaled control plane, randomly killed")
+    p.add_argument("--quorum", action="store_true",
+                   help="arm the on-device quorum tripwire in the workload")
+    p.add_argument("--store-kill-every", type=float, nargs=2,
+                   default=(35.0, 70.0), metavar=("MIN", "MAX"))
+    p.add_argument("--store-down", type=float, default=3.0)
+    p.add_argument("--inner-bound-ms", type=float, default=8000.0,
+                   help="bound on median inner-ring detect->recover")
+    p.add_argument("--outer-bound-ms", type=float, default=30000.0,
+                   help="bound on median outer-ring detect->recover")
     args = p.parse_args()
+    if args.gate:
+        args.seconds = max(args.seconds, 900.0)
+        args.chaos_store = True
+        args.quorum = True
 
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
     with open(wl_path, "w") as f:
         f.write(WORKLOAD)
     ckpt = os.path.join(workdir, "progress.txt")
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    profile = os.path.join(workdir, "profile.jsonl")
+    journal = os.path.join(workdir, "store.journal")
+    port = _free_port()
 
     env = dict(os.environ)
     disarm_platform_sitecustomize(env)
@@ -86,31 +255,63 @@ def main() -> None:
         {
             "TPURX_REPO": REPO,
             "SOAK_CKPT": ckpt,
+            "SOAK_EXC_P": str(args.exc_p),
             "SOAK_CRASH_P": str(args.crash_p),
             "SOAK_HANG_P": str(args.hang_p),
-            "SOAK_STEPS": "100000",  # effectively: run until the clock ends
+            "SOAK_QSTALL_P": str(args.qstall_p if args.quorum else 0.0),
+            "SOAK_QUORUM": "1" if args.quorum else "0",
+            "TPURX_PROFILING_FILE": profile,
             "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0",
-            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "2.0",
-            "TPURX_FT_INITIAL_RANK_HEARTBEAT_TIMEOUT": "30.0",
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "3.0",
+            "TPURX_FT_INITIAL_RANK_HEARTBEAT_TIMEOUT": "60.0",
             "TPURX_FT_WORKLOAD_CHECK_INTERVAL": "0.2",
             "TPURX_FT_WORKERS_STOP_TIMEOUT": "3.0",
-            "TPURX_FT_MAX_NO_PROGRESS_CYCLES": "0",  # chaos: disable early stop
+            "TPURX_FT_MAX_NO_PROGRESS_CYCLES": "0",  # chaos: no early stop
+            "TPURX_FT_STORE_REJOIN_WINDOW": "120.0",
             "JAX_PLATFORMS": "cpu",
         }
     )
+    if args.quorum:
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if args.native_store:
         env["TPURX_NATIVE_STORE"] = "1"
 
+    chaos = None
+    launch_cmd = [
+        sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
+        "--nnodes", "1", "--nproc-per-node", str(args.nproc),
+        "--rdzv-endpoint", f"127.0.0.1:{port}",
+        "--max-restarts", "0",   # unlimited
+        "--monitor-interval", "0.05",
+    ]
+    if args.chaos_store:
+        def spawn_store():
+            cmd = [
+                sys.executable, "-m",
+                "tpu_resiliency.fault_tolerance.control_plane",
+                "--host", "127.0.0.1", "--port", str(port),
+                "--journal", journal,
+            ]
+            if args.native_store:
+                cmd.append("--native-store")
+            return subprocess.Popen(cmd, env=env, cwd=REPO,
+                                    stdout=subprocess.DEVNULL,
+                                    stderr=subprocess.STDOUT)
+
+        chaos = StoreChaos(spawn_store, *args.store_kill_every,
+                           down_s=args.store_down)
+        time.sleep(2.0)  # let the control plane bind before launchers dial
+    else:
+        launch_cmd.append("--host-store")
+    launch_cmd.append(wl_path)
+
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
-            "--nnodes", "1", "--nproc-per-node", str(args.nproc),
-            "--rdzv-endpoint", f"127.0.0.1:{port}",
-            "--host-store", "--max-restarts", "0",   # unlimited
-            "--monitor-interval", "0.05",
-            wl_path,
-        ],
-        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        launch_cmd, cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     # drain stdout continuously: a full 64KB pipe would block the launcher
     # and wedge the very run being measured
@@ -122,6 +323,8 @@ def main() -> None:
 
     reader = threading.Thread(target=_drain, daemon=True)
     reader.start()
+    if chaos is not None:
+        chaos.start()
     deadline = time.monotonic() + args.seconds
     progress_samples = []
     while time.monotonic() < deadline and proc.poll() is None:
@@ -136,28 +339,71 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         proc.kill()  # never leak the launcher tree from the soak itself
         proc.wait(timeout=10)
+    if chaos is not None:
+        chaos.stop()
     reader.join(timeout=10)
     out = "".join(chunks)
 
-    cycles = out.count("rendezvous round")
-    crashes = out.count("] crash at step")
-    hangs = out.count("] hang at step")
-    kills = out.count("hang detected")
+    events = []
+    try:
+        with open(profile) as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    events.sort(key=lambda e: e.get("mono_ns", 0))
+    outer_ms, inner_ms = _ring_latencies(events)
+
+    def med(xs):
+        return round(sorted(xs)[len(xs) // 2], 1) if xs else None
+
+    # restart cycles = launcher-recorded worker (re)starts beyond the first
+    # (the launcher records worker_started in ITS pid; wrapper copies are
+    # worker pids)
+    cycles = max(0, sum(
+        1 for ev in events
+        if ev.get("event") == "worker_started" and ev.get("pid") == proc.pid
+    ) - 1)
+    injected = {
+        "crashes": out.count("] crash at step"),
+        "hangs": out.count("] hang at step"),
+        "exceptions": out.count("] exception at step"),
+        "quorum_stalls": out.count("] quorum stall at step"),
+    }
     monotone = all(b >= a for a, b in zip(progress_samples, progress_samples[1:]))
     final = progress_samples[-1] if progress_samples else 0
-    ok = monotone and final > 0 and cycles >= 1
+    bounds_ok = True
+    if inner_ms and not (med(inner_ms) <= args.inner_bound_ms):
+        bounds_ok = False
+    if outer_ms and not (med(outer_ms) <= args.outer_bound_ms):
+        bounds_ok = False
+    # faults were injected -> the matching ring must actually have run
+    rings_ok = (
+        (injected["exceptions"] + injected["quorum_stalls"] == 0 or inner_ms)
+        and (injected["crashes"] + injected["hangs"] == 0 or cycles >= 1)
+    )
+    ok = bool(monotone and final > 0 and bounds_ok and rings_ok)
     print(
         json.dumps(
             {
                 "metric": "soak_launcher",
                 "seconds": args.seconds,
+                "chaos_store": args.chaos_store,
+                "store_kills": chaos.kills if chaos else 0,
+                "quorum": args.quorum,
                 "final_progress": final,
-                "progress_samples": progress_samples,
+                "progress_samples": progress_samples[-12:],
                 "cycles": cycles,
-                "injected_crashes": crashes,
-                "injected_hangs": hangs,
-                "hang_kills": kills,
+                "injected": injected,
+                "inner_ring_recoveries": len(inner_ms),
+                "inner_detect_to_recover_ms_median": med(inner_ms),
+                "outer_ring_recoveries": len(outer_ms),
+                "outer_detect_to_recover_ms_median": med(outer_ms),
                 "monotone_progress": monotone,
+                "bounds_ok": bounds_ok,
                 "ok": ok,
             }
         )

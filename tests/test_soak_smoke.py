@@ -1,0 +1,46 @@
+"""CI smoke of the chaos-soak regression gate (benchmarks/soak_launcher.py).
+
+A compressed run of the full-stack gate: launcher + external journaled
+control plane (randomly killed mid-run) + in-process ring + quorum
+tripwire, randomized fault injection, detect->recover latencies derived
+from the shared profiling JSONL with bounds asserted.  The 15-minute gate
+is ``python benchmarks/soak_launcher.py --gate``; this smoke keeps the
+same machinery honest on every suite run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_soak_smoke_chaos_store_and_quorum():
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "50", "--chaos-store", "--quorum",
+            "--store-kill-every", "18", "28",
+            "--exc-p", "0.02", "--qstall-p", "0.012",
+            # generous bounds: this is a loaded 1-core CI host; the gate run
+            # uses the defaults
+            "--inner-bound-ms", "15000", "--outer-bound-ms", "60000",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["store_kills"] >= 1, report
+    assert report["monotone_progress"], report
+    # both rings actually exercised
+    assert report["inner_ring_recoveries"] >= 1, report
+    total_outer_faults = (
+        report["injected"]["crashes"] + report["injected"]["hangs"]
+    )
+    if total_outer_faults:
+        assert report["cycles"] >= 1, report
