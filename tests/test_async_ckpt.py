@@ -94,6 +94,50 @@ def test_sharded_tree_roundtrip(tmp_path):
     ckpt.close()
 
 
+def test_restore_into_different_mesh_and_sharding(tmp_path):
+    """The elastic-restart case: the mesh that loads a checkpoint is NOT
+    the mesh that saved it (world shrank/grew, axes re-shaped).  Values
+    must survive exactly and land in the TEMPLATE's sharding — restore is
+    template-driven, not save-layout-driven."""
+    devs = jax.devices()
+    save_mesh = Mesh(np.array(devs).reshape(4, 2), ("data", "model"))
+    x = jax.device_put(
+        jnp.arange(64.0).reshape(8, 8),
+        NamedSharding(save_mesh, P("data", "model")),
+    )
+    y = jax.device_put(
+        jnp.arange(16.0).reshape(4, 4), NamedSharding(save_mesh, P())
+    )
+    tree = {"x": x, "y": y}
+    ckpt = AsyncCheckpointer()
+    d = str(tmp_path / "ck-elastic")
+    ckpt.save(tree, d)
+    ckpt.close()
+
+    # a "restarted job": transposed axes AND a different factorization
+    load_mesh = Mesh(np.array(devs).reshape(2, 4), ("model", "data"))
+    new_sh = NamedSharding(load_mesh, P("data", "model"))
+    template = {
+        "x": jax.device_put(jnp.zeros((8, 8)), new_sh),
+        "y": jax.device_put(jnp.zeros((4, 4)),
+                            NamedSharding(load_mesh, P("model"))),
+    }
+    restored = load_checkpoint(d, template)
+    assert np.array_equal(np.asarray(restored["x"]), np.asarray(x))
+    assert np.array_equal(np.asarray(restored["y"]), np.asarray(y))
+    assert restored["x"].sharding.is_equivalent_to(new_sh, 2)
+    # pure-dp single-axis mesh too (the common shrink-to-survivors shape)
+    dp_mesh = Mesh(np.array(devs), ("dp",))
+    dp_sh = NamedSharding(dp_mesh, P("dp"))
+    template2 = {
+        "x": jax.device_put(jnp.zeros((8, 8)), dp_sh),
+        "y": jax.device_put(jnp.zeros((4, 4)), NamedSharding(dp_mesh, P())),
+    }
+    restored2 = load_checkpoint(d, template2)
+    assert np.array_equal(np.asarray(restored2["x"]), np.asarray(x))
+    assert restored2["x"].sharding.is_equivalent_to(dp_sh, 2)
+
+
 def test_multiple_pending_saves_finalize_in_order(tmp_path):
     ckpt = AsyncCheckpointer()
     dirs = [str(tmp_path / f"it{i}") for i in range(3)]

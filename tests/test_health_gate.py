@@ -1,0 +1,79 @@
+"""Pre-rendezvous health gate + failure injector (component 2.7-4).
+
+Reference analog: ``testing_utils/health_check_injector.py`` (env-driven
+``NVRX_INJECT_GPU_FAILURE="cycle:infra_rank"``) + the pre-join
+UnhealthyNodeException path in ``ft_rendezvous_barrier.py``.
+"""
+
+import pytest
+
+from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+from tpu_resiliency.fault_tolerance.health_gate import (
+    ENV_INJECT,
+    pre_rendezvous_health_check,
+)
+from tpu_resiliency.fault_tolerance.rendezvous import UnhealthyNodeError
+
+
+def _cfg(**kw):
+    defaults = dict(
+        enable_device_health_check=False,
+        enable_storage_health_check=False,
+    )
+    defaults.update(kw)
+    return FaultToleranceConfig(**defaults)
+
+
+class TestInjector:
+    def test_fires_at_cycle_and_later(self, monkeypatch):
+        monkeypatch.setenv(ENV_INJECT, "2:node-a")
+        pre_rendezvous_health_check(_cfg(), "node-a", current_cycle=1)
+        for cycle in (2, 3, 7):  # a dead node stays dead
+            with pytest.raises(UnhealthyNodeError):
+                pre_rendezvous_health_check(_cfg(), "node-a",
+                                            current_cycle=cycle)
+
+    def test_matches_node_id_substring_only(self, monkeypatch):
+        monkeypatch.setenv(ENV_INJECT, "0:host3")
+        with pytest.raises(UnhealthyNodeError):
+            pre_rendezvous_health_check(_cfg(), "tpu-host3-slice0")
+        pre_rendezvous_health_check(_cfg(), "tpu-host4-slice0")  # no match
+
+    def test_malformed_spec_is_ignored(self, monkeypatch):
+        for spec in ("nonsense", "x:node", ""):
+            monkeypatch.setenv(ENV_INJECT, spec)
+            pre_rendezvous_health_check(_cfg(), "node")
+
+    def test_unset_env_passes(self, monkeypatch):
+        monkeypatch.delenv(ENV_INJECT, raising=False)
+        pre_rendezvous_health_check(_cfg(), "node")
+
+
+class TestStorageGate:
+    def test_writable_path_passes(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ENV_INJECT, raising=False)
+        cfg = _cfg(
+            enable_storage_health_check=True,
+            storage_health_check_path=str(tmp_path / "ckpt"),
+        )
+        pre_rendezvous_health_check(cfg, "node")
+        # the probe cleans up after itself
+        assert list((tmp_path / "ckpt").iterdir()) == []
+
+    def test_unwritable_path_fails_the_gate(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(ENV_INJECT, raising=False)
+        # a FILE where a directory is needed: makedirs raises for any uid
+        # (chmod tricks don't block root, which CI may run as)
+        blocker = tmp_path / "blocked"
+        blocker.write_text("not a directory")
+        cfg = _cfg(
+            enable_storage_health_check=True,
+            storage_health_check_path=str(blocker),
+        )
+        with pytest.raises(UnhealthyNodeError, match="storage"):
+            pre_rendezvous_health_check(cfg, "node")
+
+    def test_storage_gate_off_by_default(self, monkeypatch):
+        monkeypatch.delenv(ENV_INJECT, raising=False)
+        cfg = _cfg(storage_health_check_path="/definitely/not/writable")
+        pre_rendezvous_health_check(cfg, "node")  # disabled -> not probed
