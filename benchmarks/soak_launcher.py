@@ -142,12 +142,12 @@ class StoreChaos(threading.Thread):
         self.min_s, self.max_s, self.down_s = min_s, max_s, down_s
         self.proc = spawn_fn()
         self.kills = 0
-        self._stop = threading.Event()
+        self._halt = threading.Event()
         self.rng = random.Random(0xC4A05)
 
     def run(self):
-        while not self._stop.is_set():
-            if self._stop.wait(self.rng.uniform(self.min_s, self.max_s)):
+        while not self._halt.is_set():
+            if self._halt.wait(self.rng.uniform(self.min_s, self.max_s)):
                 break
             try:
                 os.kill(self.proc.pid, signal.SIGKILL)
@@ -156,7 +156,7 @@ class StoreChaos(threading.Thread):
                 pass
             self.kills += 1
             print(f"soak: store host KILLED (#{self.kills})", flush=True)
-            if self._stop.wait(self.down_s):
+            if self._halt.wait(self.down_s):
                 break
             self.proc = self.spawn_fn()
             print("soak: store host restarted", flush=True)
@@ -164,7 +164,7 @@ class StoreChaos(threading.Thread):
     def stop(self):
         # join BEFORE terminating: run() may be mid-respawn, and killing the
         # old proc while it assigns a fresh one would leak an orphan store
-        self._stop.set()
+        self._halt.set()
         self.join(timeout=15)
         try:
             self.proc.terminate()

@@ -31,6 +31,7 @@ import socket
 import time
 from typing import Dict, List, Optional
 
+from ..store.client import StoreTimeout
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 
@@ -79,6 +80,13 @@ def k_open(n: int) -> str:
 
 def k_closed(n: int) -> str:
     return f"rdzv/closed/{n}"
+
+
+def k_count(n: int, c: int) -> str:
+    """Exact-count marker: the c-th joiner of round n sets this key, so the
+    host can block on 'count reached c' with one store WAIT instead of
+    polling the counter (event-driven round close)."""
+    return f"rdzv/count/{n}/{c}"
 
 
 def k_join_count(n: int) -> str:
@@ -292,8 +300,8 @@ class RendezvousHost:
         if cutoff < 0:
             return
         prefixes = ("rdzv/open/", "rdzv/closed/", "rdzv/join_count/",
-                    "rdzv/node/", "rdzv/result/", "rdzv/done/",
-                    "rdzv/restart_req/")
+                    "rdzv/count/", "rdzv/node/", "rdzv/result/",
+                    "rdzv/done/", "rdzv/restart_req/")
         try:
             for prefix in prefixes:
                 for key in self.store.list_keys(prefix):
@@ -310,24 +318,74 @@ class RendezvousHost:
         n = self.current_round()
         deadline = time.monotonic() + timeout
         settle_deadline: Optional[float] = None
+        # Node records are fetched once per key (O(N) total store reads for
+        # the whole close, not O(N^2) across wakes).  A record CAN be
+        # overwritten within a round (same node rejoining); the cache may
+        # then gate on a stale health bit — harmless: the authoritative
+        # re-read below the loop drives the actual assignment, and a
+        # too-early close surfaces as the assignment error the launcher
+        # already retries on.
+        desc_cache: Dict[bytes, NodeDesc] = {}
         while True:
             count = int(self.store.try_get(k_join_count(n)) or b"0")
-            if self.max_nodes is not None and count >= self.max_nodes:
+            for key in self.store.list_keys(f"rdzv/node/{n}/"):
+                if key not in desc_cache:
+                    desc_cache[key] = NodeDesc.from_json(self.store.get(key))
+            nodes_now = list(desc_cache.values())
+            if len(nodes_now) < count:
+                # arrival counters lead their node records by a few writes;
+                # the records carry the health bits the decisions below
+                # need.  A PERMANENT mismatch (joiner died between its ADD
+                # and its record write) must still honor the deadline.
+                if time.monotonic() >= deadline:
+                    if sum(1 for d in nodes_now if not d.excluded) >= self.min_nodes:
+                        break
+                    raise RendezvousTimeout(
+                        f"round {n}: {count} arrivals but only "
+                        f"{len(nodes_now)} node records"
+                    )
+                time.sleep(0.01)
+                continue
+            # min/max gates run on HEALTHY joiners: with event-driven joins
+            # an excluded node can re-join a fresh round milliseconds before
+            # its replacement spare — counting it toward max would close the
+            # round before the spare arrives and then fail assignment
+            healthy = sum(1 for d in nodes_now if not d.excluded)
+            if self.max_nodes is not None and healthy >= self.max_nodes:
                 break
-            if count >= self.min_nodes:
+            now = time.monotonic()
+            remaining = deadline - now
+            if healthy >= self.min_nodes:
+                # fixed settle window from the moment min was first reached
+                # (a trickle of joiners must not extend it); each arrival
+                # inside the window re-evaluates via its count marker
                 if settle_deadline is None:
-                    settle_deadline = time.monotonic() + self.settle_time
-                elif time.monotonic() >= settle_deadline:
+                    settle_deadline = now + self.settle_time
+                wait_s = min(settle_deadline - now, remaining)
+                if wait_s <= 0:
                     break
-            else:
-                settle_deadline = None
-            if time.monotonic() >= deadline:
-                if count >= self.min_nodes:
-                    break
+                try:
+                    self.store.wait(
+                        [k_count(n, count + 1)], timeout=max(0.01, wait_s)
+                    )
+                    continue  # someone arrived: re-evaluate health/max
+                except StoreTimeout:
+                    break  # settle expired with nobody new
+            settle_deadline = None
+            if remaining <= 0:
                 raise RendezvousTimeout(
-                    f"round {n}: only {count}/{self.min_nodes} nodes joined"
+                    f"round {n}: only {healthy}/{self.min_nodes} healthy "
+                    f"nodes joined ({count} total)"
                 )
-            time.sleep(self.close_poll_interval)
+            # block until the next joiner arrives (bounded chunks so the
+            # overall timeout is still honored)
+            try:
+                self.store.wait(
+                    [k_count(n, count + 1)],
+                    timeout=max(0.01, min(remaining, 30.0)),
+                )
+            except StoreTimeout:
+                continue
 
         self.store.set(k_closed(n), b"1")
         # small grace for in-flight joiners who passed the open-gate check
@@ -390,16 +448,36 @@ class RendezvousJoiner:
 
     def wait_round_open(self, timeout: float = 600.0) -> int:
         """Step 0: block until a joinable (open, not closed) round exists.
-        Hot spares and late arrivals park here."""
+        Hot spares and late arrivals park here.  Event-driven: when the
+        current round is already closed, the next one can only be ``n+1``
+        (``open_round`` advances the pointer by one), so block on that
+        round's open key with a store WAIT instead of polling — bounded
+        chunks keep the shutdown check and overall timeout honored."""
         deadline = time.monotonic() + timeout
         while True:
             self._check_shutdown()
             raw = self.store.try_get(K_ACTIVE_ROUND)
+            remaining = deadline - time.monotonic()
             if raw is not None:
                 n = int(raw)
-                if self.store.check([k_open(n)]) and not self.store.check([k_closed(n)]):
+                closed = self.store.check([k_closed(n)])
+                if self.store.check([k_open(n)]) and not closed:
                     return n
-            if time.monotonic() >= deadline:
+                if remaining <= 0:
+                    raise RendezvousTimeout("no open rendezvous round")
+                # round n closed -> the next joinable one is n+1; round n
+                # merely not-yet-open (bootstrap set the pointer before
+                # open_round set the key) -> wait on n itself
+                target = n + 1 if closed else n
+                try:
+                    self.store.wait(
+                        [k_open(target)],
+                        timeout=max(0.01, min(remaining, 2.0)),
+                    )
+                except StoreTimeout:
+                    pass
+                continue
+            if remaining <= 0:
                 raise RendezvousTimeout("no open rendezvous round")
             time.sleep(self.open_poll_interval)
 
@@ -414,6 +492,9 @@ class RendezvousJoiner:
             arrival = self.store.add(k_join_count(n), 1)
             desc = dataclasses.replace(self.desc, arrival=arrival)
             self.store.set(k_node(n, desc.node_id), desc.to_json())
+            # exact-count marker AFTER the node record: when the host's wait
+            # on this key fires, the corresponding node info is readable
+            self.store.set(k_count(n, arrival), b"1")
             try:
                 self.store.wait([k_done(n)], timeout=max(1.0, deadline - time.monotonic()))
             except Exception as exc:
@@ -472,4 +553,13 @@ class RendezvousJoiner:
                 self._check_shutdown()
                 if time.monotonic() >= deadline:
                     raise RendezvousTimeout("standby node: no new round opened")
-                time.sleep(self.open_poll_interval)
+                try:  # spare promotion is latency-sensitive: block, don't poll
+                    self.store.wait(
+                        [k_open(n + 1)],
+                        timeout=max(
+                            0.01,
+                            min(deadline - time.monotonic(), 2.0),
+                        ),
+                    )
+                except StoreTimeout:
+                    pass  # re-check shutdown / active round and re-wait
