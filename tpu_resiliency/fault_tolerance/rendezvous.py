@@ -346,19 +346,24 @@ class RendezvousHost:
                     )
                 time.sleep(0.01)
                 continue
-            # min/max gates run on HEALTHY joiners: with event-driven joins
-            # an excluded node can re-join a fresh round milliseconds before
-            # its replacement spare — counting it toward max would close the
-            # round before the spare arrives and then fail assignment
+            # The EARLY-close gate runs on HEALTHY joiners: with
+            # event-driven joins an excluded node can re-join a fresh round
+            # milliseconds before its replacement spare, and closing on raw
+            # arrivals would fail assignment before the spare lands.  Health
+            # only defers closing WITHIN the settle window though — once it
+            # expires the round closes with whatever arrived and
+            # ``assign_group_ranks`` arbitrates (its 'not enough healthy
+            # nodes' error is the prompt, precise failure a fleet with no
+            # spare must surface).
             healthy = sum(1 for d in nodes_now if not d.excluded)
             if self.max_nodes is not None and healthy >= self.max_nodes:
                 break
             now = time.monotonic()
             remaining = deadline - now
-            if healthy >= self.min_nodes:
-                # fixed settle window from the moment min was first reached
-                # (a trickle of joiners must not extend it); each arrival
-                # inside the window re-evaluates via its count marker
+            if count >= self.min_nodes:
+                # fixed settle window from the moment min ARRIVALS was first
+                # reached (a trickle of joiners must not extend it); each
+                # arrival inside the window re-evaluates via its count marker
                 if settle_deadline is None:
                     settle_deadline = now + self.settle_time
                 wait_s = min(settle_deadline - now, remaining)
@@ -374,8 +379,7 @@ class RendezvousHost:
             settle_deadline = None
             if remaining <= 0:
                 raise RendezvousTimeout(
-                    f"round {n}: only {healthy}/{self.min_nodes} healthy "
-                    f"nodes joined ({count} total)"
+                    f"round {n}: only {count}/{self.min_nodes} nodes joined"
                 )
             # block until the next joiner arrives (bounded chunks so the
             # overall timeout is still honored)
