@@ -1,0 +1,81 @@
+/* Free-running liveness beater: a pthread stamping wall-clock milliseconds
+ * into a caller-owned int64 slot at a fixed interval.
+ *
+ * Why native: the Python auto-beat thread's stamp jitter is GIL-scheduling
+ * noise — measured p99 ~1 ms on a contended host — and the calibrated
+ * detection budget must sit above safety*p99, putting a hard floor of
+ * several ms on end-to-end hang detection.  A C thread never touches the
+ * GIL, so its p99 is scheduler noise only (tens of µs), unlocking sub-ms
+ * budgets for the PROCESS/DEVICE-liveness class of hangs.
+ *
+ * What it deliberately does NOT prove: interpreter schedulability.  A
+ * GIL-wedged interpreter keeps a native beater stamping happily — exactly
+ * the hang class the Python beater exists to catch — so callers pair this
+ * with the pending-call watchdog ring (progress_watchdog.py), which owns
+ * GIL-wedge detection (reference split: ProgressWatchdog auto timestamps
+ * vs monitor-process soft/hard kills).
+ *
+ * Contract: the slot must stay valid until tpurx_beat_stop() returns.
+ * Stores are a single aligned 64-bit write (atomic on every supported
+ * target); readers see either the old or the new stamp, never a tear.
+ */
+
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <time.h>
+
+typedef struct {
+    pthread_t thread;
+    int64_t *slot;
+    int64_t interval_us;
+    volatile int stop;
+} tpurx_beater;
+
+static int64_t now_ms(void) {
+    /* folded into int32 range exactly like the Python side's
+     * now_stamp_ms() — consumers mix the two stamp sources and their age
+     * math is wrap-safe only on a shared epoch representation */
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return ((int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000)
+           % ((int64_t)1 << 31);
+}
+
+static void *beat_loop(void *arg) {
+    tpurx_beater *b = (tpurx_beater *)arg;
+    struct timespec nap;
+    nap.tv_sec = b->interval_us / 1000000;
+    nap.tv_nsec = (b->interval_us % 1000000) * 1000;
+    while (!b->stop) {
+        __atomic_store_n(b->slot, now_ms(), __ATOMIC_RELAXED);
+        nanosleep(&nap, NULL);
+    }
+    return NULL;
+}
+
+void *tpurx_beat_start(int64_t *slot, int64_t interval_us) {
+    tpurx_beater *b = (tpurx_beater *)calloc(1, sizeof(tpurx_beater));
+    if (!b) return NULL;
+    b->slot = slot;
+    b->interval_us = interval_us > 0 ? interval_us : 1000;
+    *slot = now_ms();
+    if (pthread_create(&b->thread, NULL, beat_loop, b) != 0) {
+        free(b);
+        return NULL;
+    }
+    return b;
+}
+
+/* ABI marker: v2 folds stamps into the int32 epoch (Python-side wrap
+ * parity).  load_native requires this symbol, forcing a rebuild over any
+ * stale v1 .so whose exported functions look identical. */
+int tpurx_beat_abi_v2(void) { return 2; }
+
+void tpurx_beat_stop(void *handle) {
+    if (!handle) return;
+    tpurx_beater *b = (tpurx_beater *)handle;
+    b->stop = 1;
+    pthread_join(b->thread, NULL);
+    free(b);
+}

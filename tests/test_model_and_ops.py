@@ -237,6 +237,41 @@ def test_quorum_dense_chain_and_load_calibration():
         mon.stop()
 
 
+def test_quorum_native_beater_stamps_and_freezes():
+    """native_beat=True: a C pthread stamps the liveness slot (no GIL);
+    stop_auto_beat freezes the slot so ages grow — the wedged-process
+    simulation contract the bench and tests rely on.  Skips cleanly when
+    the toolchain can't build the helper (python-beater fallback)."""
+    import jax
+
+    from tpu_resiliency.parallel.mesh import make_mesh
+
+    mesh = make_mesh(("all",), (len(jax.devices()),))
+    mon = QuorumMonitor(
+        mesh, budget_ms=1e9, interval=0.01, use_pallas=False,
+        auto_beat_interval=0.0005, native_beat=True,
+    )
+    try:
+        mon._start_beater()
+        if mon._native_handle is None:
+            pytest.skip("native beat helper unavailable (no toolchain)")
+        time.sleep(0.1)
+        first = mon._native_slot.value
+        assert first > 0
+        time.sleep(0.05)
+        assert mon._current_stamp() >= first
+        age_live = mon.tick()
+        assert age_live < 1000  # stamping keeps the pod fresh
+        mon.stop_auto_beat()
+        frozen = mon._native_slot.value
+        time.sleep(0.25)
+        assert mon._native_slot.value == frozen  # frozen: thread stopped
+        age_stale = mon.tick()
+        assert age_stale >= 200  # ages grow from the freeze instant
+    finally:
+        mon.stop()
+
+
 def test_quorum_online_recalibration_under_load():
     """After N in-vivo healthy ticks, the budget is recomputed from ages
     observed UNDER the real workload (idle pre-start calibration undershoots

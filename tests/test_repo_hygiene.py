@@ -53,6 +53,7 @@ def test_native_build_outputs_are_gitignored():
         "native/tpurx-store-server",
         "native/libtpurx-pending.so",
         "native/libtpurx-opring.so",
+        "native/libtpurx-beat.so",
     ):
         rc = subprocess.run(
             ["git", "check-ignore", "-q", artifact], cwd=REPO, timeout=30,

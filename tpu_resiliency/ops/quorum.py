@@ -218,6 +218,7 @@ class QuorumMonitor:
         identify: bool = False,
         online_recalibrate_after: Optional[int] = None,
         online_min_budget_ms: float = 2.0,
+        native_beat: bool = False,
     ):
         self.mesh = mesh
         self.budget_ms = budget_ms
@@ -280,6 +281,18 @@ class QuorumMonitor:
         self._recal_min_budget = online_min_budget_ms
         self._recal_ages: list = []
         self._recal_done = False
+        # Native liveness beater (north-star lane): a C pthread stamping the
+        # slot at machine cadence — its p99 jitter is scheduler noise (tens
+        # of µs), not GIL scheduling (~1 ms), so calibrated budgets can go
+        # sub-ms.  It proves PROCESS/DEVICE liveness only: a GIL-wedged
+        # interpreter keeps a C thread stamping, so the Python beater (GIL
+        # jitter is its feature) and the pending-call watchdog ring retain
+        # GIL-wedge detection.  Falls back to the Python beater when the
+        # toolchain can't build the helper.
+        self._native_beat = native_beat
+        self._native_slot = None
+        self._native_handle = None
+        self._native_lib = None
 
     def beat(self) -> None:
         self._last_beat_ms = now_stamp_ms()
@@ -295,8 +308,72 @@ class QuorumMonitor:
             self.beat()
             self._beater_stop.wait(self.auto_beat_interval)
 
+    def _current_stamp(self) -> int:
+        """Freshest liveness stamp: manual beat() or the native slot.
+
+        Freshness compares wrap-safe AGES, not raw stamps — both sources
+        fold into the int32 epoch (C side mirrors ``now_stamp_ms``), and a
+        raw max() would both break at the 24.8-day wrap and let a stale
+        native stamp shadow a fresh manual ``beat()``."""
+        if self._native_slot is None:
+            return self._last_beat_ms
+        now = now_stamp_ms()
+        a = self._last_beat_ms
+        b = self._native_slot.value % _WRAP
+        return a if (now - a) % _WRAP <= (now - b) % _WRAP else b
+
+    def _start_native_beater(self) -> bool:
+        import ctypes
+
+        from ..utils.native import load_native
+
+        if self._native_handle is not None:
+            return True
+        # the C thread writes into the slot until tpurx_beat_stop returns:
+        # the slot must outlive a monitor dropped without stop() (the
+        # registry pins it; __del__ is only best-effort)
+        global _NATIVE_SLOT_KEEPALIVE
+        if self._native_lib is None:
+            self._native_lib = load_native(
+                "libtpurx-beat.so", "beat_thread.c", extra_args=("-lpthread",),
+                required_symbols=(
+                    "tpurx_beat_start", "tpurx_beat_stop", "tpurx_beat_abi_v2",
+                ),
+            )
+            if self._native_lib is not None:
+                self._native_lib.tpurx_beat_start.argtypes = [
+                    ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ]
+                self._native_lib.tpurx_beat_start.restype = ctypes.c_void_p
+                self._native_lib.tpurx_beat_stop.argtypes = [ctypes.c_void_p]
+        if self._native_lib is None:
+            return False
+        if self._native_slot is None:
+            self._native_slot = ctypes.c_int64(now_stamp_ms())
+        interval_us = int(max(0.00005, self.auto_beat_interval or 0.001) * 1e6)
+        self._native_handle = self._native_lib.tpurx_beat_start(
+            ctypes.byref(self._native_slot), interval_us
+        )
+        if self._native_handle is not None:
+            _NATIVE_SLOT_KEEPALIVE[id(self)] = self._native_slot
+        return self._native_handle is not None
+
+    def _stop_native_beater(self) -> None:
+        if self._native_handle is not None:
+            self._native_lib.tpurx_beat_stop(self._native_handle)
+            self._native_handle = None
+            _NATIVE_SLOT_KEEPALIVE.pop(id(self), None)
+
+    def __del__(self):  # best-effort: registry already prevents UAF
+        try:
+            self._stop_native_beater()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
     def _start_beater(self) -> None:
         if self.auto_beat_interval is None:
+            return
+        if self._native_beat and self._start_native_beater():
             return
         if self._beater is None or not self._beater.is_alive():
             self._beater_stop.clear()  # un-latch a previous stop_auto_beat
@@ -312,6 +389,9 @@ class QuorumMonitor:
         self._beater_stop.set()
         if self._beater is not None:
             self._beater.join(timeout=2)
+        # freeze semantics: the slot keeps its last stamp so ages grow from
+        # the freeze instant, mirroring a wedged process
+        self._stop_native_beater()
 
     def resume_auto_beat(self) -> None:
         """Re-arm the liveness beater (a rank recovered by the restart ring
@@ -403,7 +483,7 @@ class QuorumMonitor:
             if hasattr(self.mesh, "local_devices")
             else int(np.prod(self.mesh.devices.shape))
         )
-        stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
+        stamps = np.full(n_local, self._current_stamp(), dtype=np.int64)
         age, dev = self._split(self._fn(stamps))
         self.last_max_age = age
         self.last_stale_device = dev
@@ -429,7 +509,7 @@ class QuorumMonitor:
             if hasattr(self.mesh, "local_devices")
             else int(np.prod(self.mesh.devices.shape))
         )
-        stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
+        stamps = np.full(n_local, self._current_stamp(), dtype=np.int64)
         pending = self._fn_async(stamps)
         previous, self._pending = self._pending, (time.monotonic(), pending)
         if previous is None:
@@ -541,7 +621,7 @@ class QuorumMonitor:
                     free = inflight[0] < self.fetch_workers
                 if free:
                     try:
-                        stamps = np.full(n_local, self._last_beat_ms, dtype=np.int64)
+                        stamps = np.full(n_local, self._current_stamp(), dtype=np.int64)
                         pending = self._fn_async(stamps)
                     except Exception as exc:  # noqa: BLE001
                         log.warning("quorum dispatch failed: %s", exc)
@@ -558,9 +638,7 @@ class QuorumMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        self._beater_stop.set()
-        if self._beater is not None:
-            self._beater.join(timeout=2)
+        self.stop_auto_beat()
         if self._thread.is_alive():
             self._thread.join(timeout=5)
 
@@ -577,3 +655,6 @@ def quorum_reduce(mesh, stamps_ms) -> int:
 
 
 _FN_CACHE: dict = {}
+# ctypes slots written by live native beater threads: pinned until the
+# matching tpurx_beat_stop returns (see _start_native_beater)
+_NATIVE_SLOT_KEEPALIVE: dict = {}
