@@ -328,6 +328,7 @@ def _compose_line(partial: dict, platform: str) -> dict:
     }
     for key in (
         "detection_budget_ms", "beat_jitter_p99_ms",
+        "detect_native_ms", "detect_native_budget_ms", "native_beat_p99_ms",
         "transport_readback_ms", "collective_extra_ms", "collective_only_ms",
         "ring_detect_ms", "ring_recover_ms", "async_ckpt_overhead_pct",
         "async_ckpt_vs_target", "d2h_mbps", "ckpt_state_mb",
@@ -448,7 +449,7 @@ def _median(xs):
     return float(np.median(np.asarray(xs, dtype=np.float64)))
 
 
-def bench_detection(mesh, step_dispatch, repeats: int):
+def bench_detection(mesh, step_dispatch, repeats: int, native_beat=False):
     """End-to-end hung-rank detection latency with a calibrated budget.
 
     Healthy phase: auto-beat at 1ms + training dispatches in flight.
@@ -475,7 +476,8 @@ def bench_detection(mesh, step_dispatch, repeats: int):
 
         mon = QuorumMonitor(
             mesh, budget_ms=1e9, interval=0.0, on_stale=on_stale,
-            auto_beat_interval=0.001, fetch_workers=8,
+            auto_beat_interval=0.0005 if native_beat else 0.001,
+            fetch_workers=8, native_beat=native_beat,
         )
         # min_budget_ms=1: let calibration find the PLATFORM floor (beat
         # jitter p99 x safety), not an operator default
@@ -883,6 +885,23 @@ def child_main(mode: str) -> None:
         _PARTIAL["detection_budget_ms"] = round(budget_ms, 3)
         _PARTIAL["beat_jitter_p99_ms"] = round(beat_p99_ms, 3)
         _save_partial()
+
+        if time_left() > 30:
+            try:
+                # native C beater lane: GIL-free liveness stamps (the
+                # hardware path toward the sub-ms north star); reported
+                # alongside the default python-beater number
+                nat_ms, nat_budget, nat_p99 = bench_detection(
+                    mesh, step_dispatch, repeats=2 if light else 3,
+                    native_beat=True,
+                )
+                _PARTIAL["detect_native_ms"] = round(nat_ms, 3)
+                _PARTIAL["detect_native_budget_ms"] = round(nat_budget, 3)
+                _PARTIAL["native_beat_p99_ms"] = round(nat_p99, 3)
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: native-beat arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
 
         if time_left() > 25:
             ring_detect_ms, ring_recover_ms = bench_detect_to_restart(

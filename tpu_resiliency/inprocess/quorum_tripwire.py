@@ -75,6 +75,7 @@ class QuorumTripwire:
         min_budget_ms: float = 2.0,
         use_pallas: Optional[bool] = None,
         fetch_workers: int = 0,
+        native_beat: bool = False,
         on_trip: Optional[Callable[[int, int], None]] = None,
     ):
         self.mesh = mesh
@@ -95,6 +96,7 @@ class QuorumTripwire:
             on_stale=self._on_stale,
             use_pallas=use_pallas,
             fetch_workers=fetch_workers,
+            native_beat=native_beat,
             identify=True,
             # pre-start calibration can only sample an idle interpreter;
             # after 256 in-vivo healthy ticks under the real workload the

@@ -78,6 +78,7 @@ class Wrapper:
         # this host) finds the real budget; 2ms keeps a guardrail while
         # letting low-jitter hosts detect in ~3ms instead of flooring at 5
         quorum_min_budget_ms: float = 2.0,
+        quorum_native_beat: bool = False,
     ):
         self.store_factory = store_factory or store_from_env
         self.group = group
@@ -107,6 +108,7 @@ class Wrapper:
         self.quorum_min_budget_ms = quorum_min_budget_ms
         self.quorum_interval = quorum_interval
         self.quorum_auto_beat_interval = quorum_auto_beat_interval
+        self.quorum_native_beat = quorum_native_beat
         self.quorum_calibrate = quorum_calibrate
 
     def __call__(self, fn: Callable) -> Callable:
@@ -260,6 +262,7 @@ class CallWrapper:
                 budget_ms=w.quorum_budget_ms,
                 interval=w.quorum_interval,
                 auto_beat_interval=w.quorum_auto_beat_interval,
+                native_beat=w.quorum_native_beat,
                 calibrate=w.quorum_calibrate,
                 min_budget_ms=w.quorum_min_budget_ms,
             ).start(state.iteration)
