@@ -335,7 +335,8 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "ckpt_save_every", "ckpt_stall_ms", "ckpt_call_ms",
         "ckpt1g_state_mb", "ckpt1g_d2h_mbps", "ckpt1g_call_ms",
         "ckpt1g_stall_ms", "ckpt1g_drain_s", "ckpt1g_write_mbps",
-        "ckpt1g_overhead_pct", "ckpt1g_scaled_down",
+        "ckpt1g_overhead_pct", "ckpt1g_fit_interval_s",
+        "ckpt1g_overhead_fit_pct", "host_cpus", "ckpt1g_scaled_down",
         "ckpt1g_extrapolated_overhead_pct", "ckpt1g_drain_truncated",
         "straggler_collector_overhead_pct",
     ):
@@ -801,6 +802,13 @@ def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
         stall_s = sum(max(0.0, q - base_s) for q in quanta)
         interval_s = 60.0
         overhead_pct = 100.0 * (call_s + stall_s) / interval_s
+        # production sizes the cadence so the drain FITS the interval (the
+        # small arm's save_every does exactly that); report overhead at that
+        # fitted cadence too so a host whose drain outgrows 60s (e.g. this
+        # 1-core sandbox, where the niced I/O path starves behind the
+        # foreground) is distinguishable from a framework regression
+        fit_interval_s = max(interval_s, 1.2 * drain_s)
+        overhead_fit_pct = 100.0 * (call_s + stall_s) / fit_interval_s
         scale = (target_mb * 1024 * 1024) / state_bytes  # MiB, like the leaves
         out = {
             "ckpt1g_state_mb": round(state_bytes / 1e6, 1),
@@ -810,6 +818,9 @@ def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
             "ckpt1g_drain_s": round(drain_s, 2),
             "ckpt1g_write_mbps": round(state_bytes / 1e6 / max(1e-9, drain_s), 1),
             "ckpt1g_overhead_pct": round(overhead_pct, 3),
+            "ckpt1g_fit_interval_s": round(fit_interval_s, 1),
+            "ckpt1g_overhead_fit_pct": round(overhead_fit_pct, 3),
+            "host_cpus": os.cpu_count(),
         }
         if truncated or not quanta:
             out["ckpt1g_drain_truncated"] = True

@@ -13,6 +13,7 @@ from tpu_resiliency.fault_tolerance.rendezvous import (
     NodeDesc,
     NodeRole,
     RendezvousClosedError,
+    RendezvousError,
     RendezvousHost,
     RendezvousJoiner,
     RendezvousTimeout,
@@ -116,6 +117,62 @@ def test_full_round(rdzv_store):
         assert r.group_world_size == 3
         assert r.rank_offset == r.group_rank * 4
         assert r.role == NodeRole.PARTICIPANT
+
+
+def test_excluded_rejoin_does_not_preempt_spare(rdzv_store):
+    """Event-driven close + health-aware gate: an EXCLUDED node re-joining a
+    fresh round milliseconds before the replacement spare must not satisfy
+    the max-nodes gate — the close waits (within the settle window) and the
+    spare still makes the round (r5 regression caught by the mid-cycle
+    exclusion e2e, pinned here as a unit test)."""
+    host = RendezvousHost(rdzv_store(), min_nodes=2, max_nodes=2,
+                          settle_time=1.0)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    excluded = NodeDesc.create("bad", slots=1)
+    excluded.excluded = True
+    t_a = threading.Thread(
+        target=_run_join, args=(rdzv_store, NodeDesc.create("good-a", slots=1), results)
+    )
+    t_bad = threading.Thread(target=_run_join, args=(rdzv_store, excluded, results))
+    t_a.start()
+    t_bad.start()
+    time.sleep(0.3)  # both arrivals land; raw count already == max
+
+    def late_spare():
+        time.sleep(0.2)  # inside the settle window
+        _run_join(rdzv_store, NodeDesc.create("good-b", slots=1), results)
+
+    t_spare = threading.Thread(target=late_spare)
+    t_spare.start()
+    host.close_round_when_ready(timeout=20.0)
+    for t in (t_a, t_bad, t_spare):
+        t.join(timeout=20.0)
+    assert results["good-a"].role == NodeRole.PARTICIPANT
+    assert results["good-b"].role == NodeRole.PARTICIPANT
+    assert isinstance(results["bad"], RendezvousClosedError)  # excluded
+
+
+def test_all_unhealthy_closes_after_settle_and_fails_fast(rdzv_store):
+    """No spare will ever come: once the settle window expires the round
+    closes with the unhealthy arrivals and assignment raises the precise
+    'not enough healthy nodes' error promptly (not the round timeout)."""
+    host = RendezvousHost(rdzv_store(), min_nodes=1, max_nodes=1,
+                          settle_time=0.3)
+    host.bootstrap()
+    host.open_round()
+    results = {}
+    bad = NodeDesc.create("only", slots=1)
+    bad.excluded = True
+    t = threading.Thread(target=_run_join, args=(rdzv_store, bad, results))
+    t.start()
+    t0 = time.monotonic()
+    with pytest.raises(RendezvousError, match="not enough healthy"):
+        host.close_round_when_ready(timeout=30.0)
+    assert time.monotonic() - t0 < 10.0  # settle expiry, not round timeout
+    host.shutdown("test over")
+    t.join(timeout=10.0)
 
 
 def test_hot_spare_promoted_on_restart(rdzv_store):
