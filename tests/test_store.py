@@ -95,6 +95,47 @@ def test_wait_and_check(store, store_server):
         store.wait(["nothere"], timeout=0.2)
 
 
+def test_wait_rides_out_server_restart(tmp_path):
+    """A blocked WAIT survives the store host dying and returning: the
+    client's sliced waits reconnect against the journal-restored server and
+    release when the key finally lands.  This is the exact contract the
+    event-driven rendezvous (joiners parked on k_done/k_open/k_count) and
+    the chaos-store soak rely on."""
+    from tpu_resiliency.store import StoreServer
+
+    journal = str(tmp_path / "j.log")
+    srv = StoreServer(host="127.0.0.1", port=0, journal_path=journal)
+    srv.start_in_thread()
+    port = srv.port
+    waiter = StoreClient("127.0.0.1", port, timeout=30.0)
+    released = {}
+
+    def block():
+        try:
+            waiter.wait(["late/key"], timeout=25.0)
+            released["ok"] = True
+        except Exception as exc:  # noqa: BLE001
+            released["err"] = exc
+
+    t = threading.Thread(target=block)
+    t.start()
+    time.sleep(0.3)          # the wait is parked server-side
+    srv.stop()               # store host "dies"
+    time.sleep(0.3)
+    srv2 = StoreServer(host="127.0.0.1", port=port, journal_path=journal)
+    srv2.start_in_thread()   # journal-restored on the SAME endpoint
+    try:
+        setter = StoreClient("127.0.0.1", port)
+        time.sleep(0.2)
+        setter.set("late/key", b"v")
+        t.join(timeout=20.0)
+        assert released.get("ok"), released
+        setter.close()
+    finally:
+        waiter.close()
+        srv2.stop()
+
+
 def test_delete_num_keys_list(store):
     store.multi_set({"p/x": b"1", "p/y": b"2", "q/z": b"3"})
     assert store.num_keys() == 3
