@@ -315,6 +315,86 @@ def test_metadata_merge_cache_verified(tmp_path):
     ckpt.close()
 
 
+# -- pipelined chunked drain (this PR) ----------------------------------------
+
+
+def test_chunked_bfloat16_roundtrip_bit_exact(tmp_path, monkeypatch):
+    """Shards split into many chunks (unaligned bfloat16 tail included) must
+    round-trip bit-exact through the multi-writer drain."""
+    monkeypatch.setenv("TPURX_CKPT_CHUNK_BYTES", "8192")  # force real chunking
+    tree = {
+        # 3 aligned chunks + unaligned 2050-byte tail, odd shape
+        "w": jax.random.normal(jax.random.PRNGKey(3), (13301,)).astype(jnp.bfloat16),
+        "b": jnp.arange(7, dtype=jnp.bfloat16),   # sub-chunk, unaligned
+        "empty": jnp.zeros((0,), dtype=jnp.bfloat16),
+        "f32": jnp.arange(4096.0),                # exactly chunk-aligned
+    }
+    ckpt = AsyncCheckpointer()
+    d = str(tmp_path / "bf16")
+    ckpt.save(tree, d)
+    # on-disk shard files carry the raw little-endian bytes (layout is
+    # chunk-invariant: same bytes whether written in 1 write or N pwrites)
+    meta = read_metadata(d)
+    w_leaf = meta["leaf_paths"].index("['w']")
+    fn = tmp_path / "bf16" / "process_0" / f"shard_{w_leaf}_0.bin"
+    assert fn.read_bytes() == np.asarray(tree["w"]).tobytes()
+    assert not fn.parent.joinpath(fn.name + ".tmp").exists()
+    restored = load_checkpoint(d, jax.tree_util.tree_map(np.zeros_like, tree))
+    for k in tree:
+        got, want = np.asarray(restored[k]), np.asarray(tree[k])
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got.view(np.uint16) if k != "f32" else got,
+                                      want.view(np.uint16) if k != "f32" else want)
+    ckpt.close()
+
+
+def test_interrupted_drain_commits_nothing(tmp_path):
+    """Atomic commit: a worker killed mid-drain must leave NO metadata.json
+    (readers fall back to the last committed checkpoint) and the failure
+    must surface as CheckpointSaveError."""
+    ckpt = AsyncCheckpointer()
+    prev = str(tmp_path / "good")
+    tree = make_tree()
+    ckpt.save(tree, prev)
+    assert is_committed(prev)
+
+    # a save big enough that the drain is still in flight when we kill
+    big = {"x": jnp.ones((4 << 20,), dtype=jnp.float32)}  # 16 MiB
+    d2 = str(tmp_path / "doomed")
+    ckpt.async_save(big, d2)
+    ckpt.queue.caller._ensure_worker().kill()  # the interruption
+    with pytest.raises(CheckpointSaveError):
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            ckpt.maybe_finalize(blocking=False)
+            time.sleep(0.02)
+    assert not is_committed(d2)  # no metadata.json ⇒ never half-committed
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(d2, big)
+    # the previous checkpoint is untouched and still loads
+    restored = load_checkpoint(prev, jax.tree_util.tree_map(np.zeros_like, tree))
+    assert_trees_equal(tree, restored)
+    ckpt.close()
+
+
+def test_second_save_reuses_shm_segments(tmp_path):
+    """The staging pool must hand the SAME shm segments (by name) to the
+    second save of an identically-shaped tree — reuse, not re-create."""
+    ckpt = AsyncCheckpointer()
+    d1, d2 = str(tmp_path / "r1"), str(tmp_path / "r2")
+    ckpt.save(make_tree(seed=0), d1)
+    first = {s.shm_name for s in ckpt._pool[0].shards if s.replica_owner}
+    assert first
+    ckpt.save(make_tree(seed=1), d2)
+    second = {s.shm_name for s in ckpt._pool[0].shards if s.replica_owner}
+    assert second == first  # identical segments, rewritten in place
+    assert ckpt.last_stage_stats["bytes_allocated"] == 0
+    assert ckpt.last_stage_stats["bytes_reused"] > 0
+    restored = load_checkpoint(d2, jax.tree_util.tree_map(np.zeros_like, make_tree()))
+    assert_trees_equal(make_tree(seed=1), restored)
+    ckpt.close()
+
+
 def test_snapshot_staging_error_surfaces(tmp_path):
     """A staging failure in the background thread must raise from
     maybe_finalize/finalize_all, not vanish."""

@@ -237,6 +237,33 @@ def test_quorum_dense_chain_and_load_calibration():
         mon.stop()
 
 
+def test_current_stamp_future_native_stamp_is_fresh():
+    """ADVICE r5 regression: the native C thread can stamp a NEWER
+    millisecond between ``_current_stamp``'s ``now`` read and its slot read.
+    The folded age then lands near 2^31 and a naive wrap-compare would
+    select a seconds-stale manual beat instead — a spurious trip.  Future
+    stamps must be treated as fresh (age clamped to 0)."""
+    import ctypes
+
+    from tpu_resiliency.ops.quorum import _WRAP
+
+    # __new__: _current_stamp needs only the two stamp fields, and the full
+    # constructor builds device collectives this logic test doesn't touch
+    mon = QuorumMonitor.__new__(QuorumMonitor)
+    now = now_stamp_ms()
+    mon._last_beat_ms = (now - 10_000) % _WRAP   # manual beat: 10s stale
+    fut = (now + 50) % _WRAP                     # native slot: "the future"
+    mon._native_slot = ctypes.c_int64(fut)
+    assert mon._current_stamp() == fut           # pre-fix: stale manual beat
+    # stale native + fresh manual: manual must still win
+    mon._native_slot = ctypes.c_int64((now - 60_000) % _WRAP)
+    mon._last_beat_ms = now
+    assert mon._current_stamp() == now
+    # no native slot: manual beat passes through
+    mon._native_slot = None
+    assert mon._current_stamp() == now
+
+
 def test_quorum_native_beater_stamps_and_freezes():
     """native_beat=True: a C pthread stamps the liveness slot (no GIL);
     stop_auto_beat freezes the slot so ages grow — the wedged-process

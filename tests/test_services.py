@@ -342,6 +342,44 @@ def test_smonsvc_gke_jobset_adapter_with_fake_kubectl(tmp_path, monkeypatch):
     assert stats["errors"] == 0
 
 
+def test_smonsvc_gke_all_namespaces_artifacts_use_bare_name(tmp_path, monkeypatch):
+    """ADVICE r5: in --all-namespaces mode job ids are '<ns>/<name>' (the
+    collision-safe tracking key), but artifacts live under the launcher
+    convention '<root>/<name>/...' — discovery must path by the bare name."""
+    from tpu_resiliency.services.smonsvc import GkeJobSetScheduler
+
+    payload = {
+        "items": [
+            {"metadata": {"name": "llama-70b", "namespace": "team-a"},
+             "status": {}},
+            {"metadata": {"name": "llama-70b", "namespace": "team-b"},
+             "status": {}},
+        ]
+    }
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "kubectl").write_text(
+        "#!/bin/sh\ncat <<'EOF'\n" + json.dumps(payload) + "\nEOF\n"
+    )
+    (bindir / "kubectl").chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+
+    root = tmp_path / "artifacts"
+    (root / "llama-70b" / "cycles").mkdir(parents=True)
+    (root / "llama-70b" / "logs").mkdir()
+
+    sched = GkeJobSetScheduler(str(root))  # namespace=None -> --all-namespaces
+    jobs = sched.discover()
+    # tracking keys stay namespaced (no cross-namespace shadowing)...
+    assert sorted(j[0] for j in jobs) == [
+        "team-a/llama-70b", "team-b/llama-70b",
+    ]
+    # ...but every job's artifacts resolve under the bare JobSet name
+    for _, cdir, ldir in jobs:
+        assert cdir == str(root / "llama-70b" / "cycles")
+        assert ldir == str(root / "llama-70b" / "logs")
+
+
 def test_smonsvc_gke_monitor_integration(tmp_path, monkeypatch):
     """A JobMonitor over the GKE adapter tracks a jobset through its cycle
     files and surfaces the adapter stats under /status's ``gke`` key."""

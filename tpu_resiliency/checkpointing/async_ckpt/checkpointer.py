@@ -9,11 +9,16 @@ Save pipeline per request (default ``stage_mode="snapshot"``):
                        so the training step never waits on D2H.  Device
                        ordering makes this donation-safe: the copy is
                        enqueued before the next step can reuse donated
-                       input buffers.
-  2. (stager thread)   stage_pytree: D2H of the snapshot into shm, reusing
-                       pooled segments when the plan signature matches
-                       (zero shm allocation in steady state)
-  3. (worker, async)   write_process_shards: shm -> files + process index
+                       input buffers.  The worker's streamed drain call is
+                       opened here too, before any bytes move.
+  2. (stager thread)   stage_pytree: pipelined D2H of the snapshot into
+                       pooled (double-buffered) shm — zero allocation and
+                       zero first-touch faults in steady state; each shard
+                       is streamed to the worker the moment its bytes land
+  3. (worker, async)   write_process_shards_streamed: chunked multi-writer
+                       drain (O_DIRECT when possible, batched durability),
+                       overlapping file writes with still-staging leaves,
+                       reporting bytes-written/total progress up the pipe
   4. (trainer, later)  finalize once ALL ranks' writes are done:
                        coordinator merges process indices -> metadata.json
                        (atomic commit), shm returns to the pool
@@ -37,20 +42,25 @@ import json
 import os
 import queue as queue_mod
 import threading
-import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ...utils.logging import get_logger
-from .core import AsyncCallsQueue, AsyncRequest, CheckpointSaveError, store_sync_fn
+from .core import (  # noqa: F401 - CheckpointSaveError re-exported for callers
+    AsyncCallsQueue,
+    AsyncRequest,
+    CheckpointSaveError,
+    store_sync_fn,
+)
 from .staging import StagedTree, plan_signature, shard_payload, stage_pytree
 from .writer import (
     is_committed,
     read_leaf,
     read_metadata,
+    resolve_write_threads,
     write_metadata,
-    write_process_shards,
+    write_process_shards_streamed,
 )
 
 log = get_logger("checkpointer")
@@ -87,14 +97,15 @@ def device_snapshot(tree: Any) -> Any:
 @dataclasses.dataclass
 class _StagingJob:
     tree: Any
-    ckpt_dir: str
-    extra_metadata: Optional[Dict]
-    save_id: str
     plan_sig: str
     ticket: int
+    stream: Any = None                    # core.StreamHandle feeding the worker
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     staged: Optional[StagedTree] = None
-    error: Optional[str] = None
+    # `cleaned` guards the staged-tree handoff between the stager thread and
+    # cleanup (finalize or abort) — whichever runs second releases the shm
+    lock: threading.Lock = dataclasses.field(default_factory=threading.Lock)
+    cleaned: bool = False
 
 
 class AsyncCheckpointer:
@@ -105,19 +116,21 @@ class AsyncCheckpointer:
         world_size: int = 1,
         process_index: Optional[int] = None,
         persistent_worker: bool = True,
-        write_threads: int = 4,
-        stage_mode: str = "snapshot",
-        pool_size: int = 1,
+        write_threads: Optional[int] = None,
+        stage_mode: Optional[str] = None,
+        pool_size: int = 2,
     ):
-        if stage_mode not in ("snapshot", "sync"):
-            raise ValueError(f"stage_mode must be snapshot|sync, got {stage_mode!r}")
+        if stage_mode not in (None, "snapshot", "sync"):
+            raise ValueError(
+                f"stage_mode must be None|snapshot|sync, got {stage_mode!r}"
+            )
         sync_fn = (
             store_sync_fn(store, rank, world_size) if store is not None else None
         )
         self.queue = AsyncCallsQueue(persistent=persistent_worker, sync_fn=sync_fn)
         self.rank = rank
         self.world_size = world_size
-        self.write_threads = write_threads
+        self.write_threads = resolve_write_threads(write_threads)
         self.stage_mode = stage_mode
         self.pool_size = pool_size
         if process_index is None:
@@ -129,8 +142,8 @@ class AsyncCheckpointer:
                 process_index = 0
         self.process_index = process_index
         self._merger = _MetadataMerger()
+        self._resolved_stage_mode: Optional[str] = None
         self._save_seq = 0
-        self._jobs: List[_StagingJob] = []
         self._pool: List[StagedTree] = []
         self._pool_lock = threading.Lock()
         self._stage_q: "queue_mod.Queue[Optional[_StagingJob]]" = queue_mod.Queue()
@@ -152,11 +165,15 @@ class AsyncCheckpointer:
         (``stage_mode="sync"``).  Returns a monotonic save ticket.  Call
         :meth:`maybe_finalize` every step.
 
+        The worker's drain is scheduled HERE, before staging runs: the
+        streamed plan lets the writer persist the first staged shards while
+        later leaves are still staging (no staging/writing barrier).
+
         ``save_id`` must match across ranks of one save (e.g. the training
         iteration); finalize only merges process indices carrying the same
         id, so stale index files from a previous run into the same directory
         (possibly with a different world size) are never committed."""
-        mode = stage_mode or self.stage_mode
+        mode = stage_mode or self.stage_mode or self._resolve_stage_mode(tree)
         os.makedirs(ckpt_dir, exist_ok=True)
         if save_id is None:
             save_id = str((extra_metadata or {}).get("iteration", "default"))
@@ -173,20 +190,25 @@ class AsyncCheckpointer:
             # also copies host-only trees: the stager must never hold raw
             # references the trainer can mutate in place after we return
             tree = device_snapshot(tree)  # async dispatch; no D2H yet
-        job = _StagingJob(
-            tree=tree,
-            ckpt_dir=ckpt_dir,
-            extra_metadata=extra_metadata,
-            save_id=save_id,
-            plan_sig=sig,
-            ticket=self._save_seq,
+        job = _StagingJob(tree=tree, plan_sig=sig, ticket=self._save_seq)
+        finalize_fns: List[Callable] = []
+        if self.rank == 0:
+            extra = extra_metadata
+            finalize_fns.append(
+                lambda: self._merger.finalize(ckpt_dir, job.staged, extra, save_id)
+            )
+        req = AsyncRequest(
+            async_fn=write_process_shards_streamed,
+            async_fn_args=(
+                ckpt_dir, self.process_index, self.write_threads, save_id, sig,
+            ),
+            finalize_fns=finalize_fns,
+            cleanup_fns=[lambda: self._release_job(job)],
         )
+        job.stream = self.queue.schedule_streamed_request(req)
         if mode == "sync":
             self._run_staging(job)
-            self._jobs.append(job)
-            self._drain_staged(block=False)
         else:
-            self._jobs.append(job)
             self._ensure_stager()
             self._stage_q.put(job)
         return self._save_seq
@@ -195,6 +217,29 @@ class AsyncCheckpointer:
         """Synchronous save (stage + write + commit before returning)."""
         self.async_save(tree, ckpt_dir, extra_metadata)
         self.finalize_all()
+
+    def _resolve_stage_mode(self, tree: Any) -> str:
+        """Platform default, resolved from the first device leaf and cached.
+
+        Accelerators get ``snapshot``: the device-side copy is a cheap
+        dispatch and lets D2H overlap later training steps.  The CPU backend
+        gets ``sync``: there the "device snapshot" is a full host memcpy and
+        background staging steals foreground cycles — staging inline in the
+        call pays ONE memcpy and is equally donation-safe (the bytes are in
+        shm before async_save returns)."""
+        if self._resolved_stage_mode is None:
+            platform = "cpu"
+            try:
+                import jax
+
+                for leaf in jax.tree_util.tree_leaves(tree):
+                    if isinstance(leaf, jax.Array):
+                        platform = list(leaf.devices())[0].platform
+                        break
+            except Exception:  # noqa: BLE001 - host-only trees
+                pass
+            self._resolved_stage_mode = "sync" if platform == "cpu" else "snapshot"
+        return self._resolved_stage_mode
 
     # -- staging thread ----------------------------------------------------
 
@@ -226,31 +271,55 @@ class AsyncCheckpointer:
             self._run_staging(job)
 
     def _run_staging(self, job: _StagingJob) -> None:
+        """Stage ``job.tree`` into shm, streaming the plan then each shard to
+        the worker the moment its bytes land — the drain overlaps staging."""
+        stream = job.stream
         try:
             pooled = self._pool_acquire(job.plan_sig)
             try:
-                job.staged = stage_pytree(
+                staged = stage_pytree(
                     job.tree,
                     process_index=self.process_index,
                     reuse=pooled,
                     plan_sig=job.plan_sig,
+                    on_plan=lambda total: stream.send(("plan", total)),
+                    on_shard_staged=lambda info: stream.send(
+                        ("shards", [shard_payload(info)])
+                    ),
                 )
             except BaseException:
                 if pooled is not None:
                     pooled.close(unlink=True)  # buffers in unknown state
                 raise
-            if pooled is not None and job.staged is not pooled:
+            if pooled is not None and staged is not pooled:
                 pooled.close(unlink=True)  # sig raced a layout change
             self.last_stage_stats = {
-                "bytes_allocated": job.staged.bytes_allocated,
-                "bytes_reused": job.staged.bytes_reused,
+                "bytes_allocated": staged.bytes_allocated,
+                "bytes_reused": staged.bytes_reused,
+                "stage_wait_s": staged.stage_wait_s,
+                "stage_copy_s": staged.stage_copy_s,
+                "stage_overlap_pct": staged.stage_overlap_pct,
             }
+            with job.lock:
+                if job.cleaned:
+                    # cleanup (abort) already ran: nobody else will release
+                    self._pool_release(staged)
+                else:
+                    job.staged = staged
+            stream.end()
         except Exception as exc:  # noqa: BLE001
             log.exception("checkpoint staging failed")
-            job.error = f"staging failed: {exc!r}"
+            stream.end(error=f"staging failed: {exc!r}")
         finally:
             job.tree = None  # free the device snapshot
             job.done.set()
+
+    def _release_job(self, job: _StagingJob) -> None:
+        with job.lock:
+            job.cleaned = True
+            staged, job.staged = job.staged, None
+        if staged is not None:
+            self._pool_release(staged)
 
     def _pool_acquire(self, sig: str) -> Optional[StagedTree]:
         with self._pool_lock:
@@ -272,57 +341,25 @@ class AsyncCheckpointer:
         for st in pool:
             st.close(unlink=True)
 
-    # -- scheduling + finalize --------------------------------------------
-
-    def _schedule_staged(self, job: _StagingJob) -> None:
-        staged = job.staged
-        payloads = [shard_payload(s) for s in staged.shards]
-        finalize_fns: List[Callable] = []
-        if self.rank == 0:
-            extra, save_id, ckpt_dir = job.extra_metadata, job.save_id, job.ckpt_dir
-            finalize_fns.append(
-                lambda: self._merger.finalize(ckpt_dir, staged, extra, save_id)
-            )
-        req = AsyncRequest(
-            async_fn=write_process_shards,
-            async_fn_args=(
-                job.ckpt_dir, self.process_index, payloads, self.write_threads,
-                job.save_id, job.plan_sig,
-            ),
-            finalize_fns=finalize_fns,
-            cleanup_fns=[lambda: self._pool_release(staged)],
-        )
-        self.queue.schedule_async_request(req)
-
-    def _drain_staged(self, block: bool, timeout: float = 600.0) -> None:
-        """Move completed staging jobs (in order) onto the write queue."""
-        deadline = time.monotonic() + timeout
-        while self._jobs:
-            job = self._jobs[0]
-            if block:
-                if not job.done.wait(timeout=max(0.0, deadline - time.monotonic())):
-                    raise TimeoutError(
-                        f"staging of save #{job.ticket} still running after {timeout}s"
-                    )
-            elif not job.done.is_set():
-                return
-            self._jobs.pop(0)
-            if job.error is not None:
-                raise CheckpointSaveError(f"save #{job.ticket}: {job.error}")
-            self._schedule_staged(job)
+    # -- finalize ---------------------------------------------------------
 
     def maybe_finalize(self, blocking: bool = False) -> List[int]:
-        self._drain_staged(block=blocking)
         return self.queue.maybe_finalize_async_calls(blocking=blocking)
 
     @property
     def num_pending_saves(self) -> int:
-        """Saves not yet fully committed (staging queue + write queue).
-        Zero means every ``async_save`` issued so far is durable."""
-        return len(self._jobs) + self.queue.num_unfinalized_calls
+        """Saves not yet fully committed (staging + drain).  Zero means every
+        ``async_save`` issued so far is durable.  (Every save is scheduled
+        on the worker at ``async_save`` time — its streamed call completes
+        only after staging AND writing finish, so the queue sees both.)"""
+        return self.queue.num_unfinalized_calls
+
+    def drain_progress(self) -> Tuple[int, int]:
+        """(bytes_written, bytes_total) across in-flight saves, as reported
+        by the worker through the drain-progress pipe frames."""
+        return self.queue.drain_progress()
 
     def finalize_all(self, timeout: float = 600.0) -> None:
-        self._drain_staged(block=True, timeout=timeout)
         self.queue.maybe_finalize_async_calls(blocking=True, timeout=timeout)
 
     def close(self) -> None:

@@ -81,7 +81,9 @@ class AsyncRequest:
 
 
 class _PipeWorker:
-    """One worker subprocess speaking the worker_main pickle-frame protocol.
+    """One worker subprocess speaking the worker_main pickle-frame protocol
+    (typed request/response frames incl. streamed calls and drain progress —
+    see ``worker_main.py``).
 
     Deliberately a plain subprocess, not multiprocessing spawn: mp-spawn
     re-imports the parent's ``__main__``, which crashes in any user script
@@ -105,7 +107,11 @@ class _PipeWorker:
             start_new_session=False,
         )
         self.results: Dict[int, Tuple[Optional[str], float]] = {}
+        self.progress: Dict[int, Tuple[int, int]] = {}  # call -> (written, total)
         self._cv = threading.Condition()
+        # the trainer thread schedules while the stager thread streams items:
+        # frame writes must not interleave
+        self._wlock = threading.Lock()
         self._reader = threading.Thread(
             target=self._read_loop, name="tpurx-ckpt-reader", daemon=True
         )
@@ -121,7 +127,13 @@ class _PipeWorker:
             raw = stream.read(n)
             if len(raw) < n:
                 break
-            call_idx, err, dur = pickle.loads(raw)
+            frame = pickle.loads(raw)
+            if frame[0] == "prog":
+                _, call_idx, written, total = frame
+                with self._cv:
+                    self.progress[call_idx] = (written, total)
+                continue
+            _, call_idx, err, dur = frame  # "done"
             with self._cv:
                 self.results[call_idx] = (err, dur)
                 self._cv.notify_all()
@@ -132,16 +144,27 @@ class _PipeWorker:
     def alive(self) -> bool:
         return self.proc.poll() is None
 
+    def _send(self, frame) -> None:
+        raw = pickle.dumps(frame)
+        with self._wlock:
+            self.proc.stdin.write(self._U32.pack(len(raw)) + raw)
+            self.proc.stdin.flush()
+
     def submit(self, call_idx: int, fn: Callable, args: Tuple) -> None:
-        raw = pickle.dumps((call_idx, fn, args))
-        self.proc.stdin.write(self._U32.pack(len(raw)) + raw)
-        self.proc.stdin.flush()
+        self._send(("call", call_idx, fn, args))
+
+    def stream_begin(self, call_idx: int, fn: Callable, args: Tuple) -> None:
+        self._send(("sbegin", call_idx, fn, args))
+
+    def stream_item(self, call_idx: int, item) -> None:
+        self._send(("sitem", call_idx, item))
+
+    def stream_end(self, call_idx: int, error: Optional[str] = None) -> None:
+        self._send(("send", call_idx, error))
 
     def shutdown(self, timeout: float = 10.0) -> None:
         try:
-            raw = pickle.dumps(None)
-            self.proc.stdin.write(self._U32.pack(len(raw)) + raw)
-            self.proc.stdin.flush()
+            self._send(None)
         except (BrokenPipeError, OSError, ValueError):
             pass
         try:
@@ -154,6 +177,35 @@ class _PipeWorker:
         if self.alive:
             self.proc.kill()
             self.proc.wait()
+
+
+class StreamHandle:
+    """Trainer-side feeder for one streamed worker call.  Send failures
+    (worker died mid-stream) are swallowed: the death surfaces through the
+    caller's is_done/error machinery, not through the staging thread."""
+
+    def __init__(self, worker: _PipeWorker, call_idx: int):
+        self._worker = worker
+        self.call_idx = call_idx
+        self._dead = False
+        self._ended = False
+
+    def send(self, item) -> None:
+        if self._dead or self._ended:
+            return
+        try:
+            self._worker.stream_item(self.call_idx, item)
+        except (BrokenPipeError, OSError, ValueError):
+            self._dead = True
+
+    def end(self, error: Optional[str] = None) -> None:
+        if self._dead or self._ended:
+            return
+        self._ended = True
+        try:
+            self._worker.stream_end(self.call_idx, error)
+        except (BrokenPipeError, OSError, ValueError):
+            self._dead = True
 
 
 class PersistentAsyncCaller:
@@ -173,6 +225,18 @@ class PersistentAsyncCaller:
         worker = self._ensure_worker()
         self._inflight[call_idx] = True
         worker.submit(call_idx, fn, args)
+
+    def schedule_streamed(self, call_idx: int, fn: Callable, args: Tuple) -> StreamHandle:
+        worker = self._ensure_worker()
+        self._inflight[call_idx] = True
+        worker.stream_begin(call_idx, fn, args)
+        return StreamHandle(worker, call_idx)
+
+    def progress(self, call_idx: int) -> Optional[Tuple[int, int]]:
+        if self._worker is None:
+            return None
+        with self._worker._cv:
+            return self._worker.progress.get(call_idx)
 
     def _collect(self) -> None:
         if self._worker is None:
@@ -236,6 +300,19 @@ class TemporalAsyncCaller:
         worker = _PipeWorker()
         worker.submit(call_idx, fn, args)
         self._workers[call_idx] = worker
+
+    def schedule_streamed(self, call_idx: int, fn: Callable, args: Tuple) -> StreamHandle:
+        worker = _PipeWorker()
+        worker.stream_begin(call_idx, fn, args)
+        self._workers[call_idx] = worker
+        return StreamHandle(worker, call_idx)
+
+    def progress(self, call_idx: int) -> Optional[Tuple[int, int]]:
+        worker = self._workers.get(call_idx)
+        if worker is None:
+            return None
+        with worker._cv:
+            return worker.progress.get(call_idx)
 
     def is_done(self, call_idx: int) -> bool:
         worker = self._workers.get(call_idx)
@@ -304,6 +381,38 @@ class AsyncCallsQueue:
             raise
         self._pending.append(req)
         return req.call_idx
+
+    def schedule_streamed_request(self, req: AsyncRequest) -> StreamHandle:
+        """Schedule a STREAMED async call: the worker starts ``async_fn``
+        immediately with an item iterator, and the returned handle feeds it
+        (possibly from another thread) — the drain begins before the plan is
+        fully staged.  ``finalize_fns``/``cleanup_fns`` semantics match
+        :meth:`schedule_async_request`."""
+        self._call_idx += 1
+        req = dataclasses.replace(req, call_idx=self._call_idx)
+        record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, call_idx=req.call_idx)
+        try:
+            if req.preload_fn is not None:
+                req.preload_fn()
+            handle = self.caller.schedule_streamed(
+                req.call_idx, req.async_fn, req.async_fn_args
+            )
+        except BaseException:
+            req.run_cleanup()
+            raise
+        self._pending.append(req)
+        return handle
+
+    def drain_progress(self) -> Tuple[int, int]:
+        """(bytes_written, bytes_total) summed over unfinalized streamed
+        calls — the worker reports through the pipe as chunks land."""
+        written = total = 0
+        for req in self._pending:
+            p = self.caller.progress(req.call_idx)
+            if p is not None:
+                written += p[0]
+                total += p[1]
+        return written, total
 
     @property
     def num_unfinalized_calls(self) -> int:

@@ -1,4 +1,4 @@
-"""D2H staging of JAX pytrees into shared memory.
+"""Pipelined D2H staging of JAX pytrees into shared memory.
 
 The TPU replacement for the reference's CUDA-stream preload
 (``async_ckpt/filesystem_async.py:230-330``): every ``jax.Array`` leaf starts
@@ -7,6 +7,22 @@ shard), then shards are materialized straight into POSIX shared-memory
 buffers.  The training step only pays for the D2H DMA + one memcpy into shm;
 file writes happen in the worker process reading the same shm — zero copies
 across the process boundary.
+
+Staging is **pipelined per shard**: the full shm plan (every shard's size and
+segment) is computed up-front from metadata alone, all owned D2H copies are
+kicked off asynchronously, and then each shard is memcpy'd into shm as soon
+as *its* transfer lands — the memcpy of shard *i* overlaps the in-flight DMA
+of shards *i+1..n* instead of the old stage-everything-then-copy sequence.
+Because the plan precedes the bytes, a streaming consumer (``writer.py``'s
+chunked multi-writer engine) can start persisting the first shards while
+later leaves are still in flight: ``on_plan`` fires once with the total
+owned byte count, ``on_shard_staged`` fires per shard the moment its bytes
+are in shm.
+
+Shm segments are pooled and **reused across saves** (double-buffered by the
+checkpointer): a steady-state save of an unchanged layout allocates zero new
+shm bytes and — critically on Linux — pays zero first-touch page-fault cost,
+which dominates fresh-segment staging at GiB scale.
 
 A leaf can be a replicated or sharded global array: we stage only
 **addressable** shards and record their global index, so multi-host saves
@@ -17,10 +33,11 @@ leaves to avoid N identical writes).
 from __future__ import annotations
 
 import dataclasses
+import time
 from multiprocessing import shared_memory
 
 from ...utils.shm import create_shm, unlink_shm
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +73,10 @@ class StagedTree:
     plan_sig: str = ""
     bytes_allocated: int = 0              # shm bytes newly created this staging
     bytes_reused: int = 0                 # shm bytes reused from a pooled tree
+    # pipelining telemetry for the last staging pass (bench: stage_overlap_pct)
+    stage_wait_s: float = 0.0             # summed per-shard D2H completion waits
+    stage_copy_s: float = 0.0             # summed memcpy-into-shm time
+    stage_overlap_pct: float = 0.0        # % of memcpy overlapped with live D2H
     _shms: List[shared_memory.SharedMemory] = dataclasses.field(default_factory=list)
 
     def close(self, unlink: bool = True) -> None:
@@ -119,11 +140,22 @@ def plan_signature(tree: Any, process_index: Optional[int] = None) -> str:
     return h.hexdigest()[:32]
 
 
+@dataclasses.dataclass
+class _OwnedWork:
+    """One owned shard awaiting its bytes: plan slot + data source."""
+
+    info: ShardInfo
+    source: Any          # jax shard (async D2H in flight) or host array
+    is_jax: bool
+
+
 def stage_pytree(
     tree: Any,
     process_index: Optional[int] = None,
     reuse: Optional[StagedTree] = None,
     plan_sig: Optional[str] = None,
+    on_plan: Optional[Callable[[int], None]] = None,
+    on_shard_staged: Optional[Callable[[ShardInfo], None]] = None,
 ) -> StagedTree:
     """Stage all array leaves into shared memory.  Scalars / numpy leaves are
     staged too (uniform handling keeps the writer simple).
@@ -131,140 +163,157 @@ def stage_pytree(
     With ``reuse`` (a previously staged tree whose ``plan_sig`` matches this
     tree's), existing shm segments are rewritten in place instead of
     allocated: a steady-state save of an unchanged layout creates zero new
-    shm bytes."""
+    shm bytes (and skips first-touch page faults, the dominant cost of fresh
+    GiB-scale segments).
+
+    ``on_plan(total_owned_bytes)`` fires once, before any bytes move, as soon
+    as the full shard plan is known.  ``on_shard_staged(info)`` fires per
+    owned shard the moment its bytes are fully in shm — a streaming writer
+    can persist it immediately while later shards are still staging."""
     treedef, paths, leaves = _leaf_paths(tree)
     pidx = process_index
     if pidx is None:
         pidx = jax.process_index() if _HAVE_JAX else 0
     sig = plan_sig if plan_sig is not None else plan_signature(tree, pidx)
-    if reuse is not None and reuse.plan_sig == sig and reuse._shms:
-        return _restage_into(tree, reuse, leaves)
-    staged = StagedTree(
-        treedef_repr=str(treedef), leaf_paths=paths, shards=[], plan_sig=sig
-    )
+    reusing = reuse is not None and reuse.plan_sig == sig and reuse._shms
+    if reusing:
+        staged = reuse
+    else:
+        staged = StagedTree(
+            treedef_repr=str(treedef), leaf_paths=paths, shards=[], plan_sig=sig
+        )
     try:
-        return _stage_fresh(staged, leaves, pidx)
+        return _stage_pipelined(staged, leaves, pidx, reusing,
+                                on_plan, on_shard_staged)
     except BaseException:
-        staged.close(unlink=True)  # partial staging must not leak shm
+        if not reusing:
+            staged.close(unlink=True)  # partial staging must not leak shm
         raise
 
 
-def _stage_fresh(staged: StagedTree, leaves: List[Any], pidx: int) -> StagedTree:
+def _owner(leaf, shard, pidx: int) -> bool:
+    # One replica owner per distinct shard; fully-replicated leaves are
+    # written by process 0 only (avoids N identical writes).
+    replicated = getattr(leaf.sharding, "is_fully_replicated", False)
+    if replicated:
+        return pidx == 0 and shard.replica_id == 0
+    return shard.replica_id == 0
 
-    def _owner(leaf, shard) -> bool:
-        # One replica owner per distinct shard; fully-replicated leaves are
-        # written by process 0 only (avoids N identical writes).
-        replicated = getattr(leaf.sharding, "is_fully_replicated", False)
-        if replicated:
-            return pidx == 0 and shard.replica_id == 0
-        return shard.replica_id == 0
 
-    # Phase 1: kick off async D2H for OWNED shards only (non-owned data is
-    # never written, so paying device bandwidth + host RAM for it would be
-    # pure waste), overlapping the DMA of every owned array.
-    for leaf in leaves:
-        if _HAVE_JAX and isinstance(leaf, jax.Array):
-            for shard in leaf.addressable_shards:
-                if _owner(leaf, shard):
-                    shard.data.copy_to_host_async()
+def _build_plan(
+    staged: StagedTree, leaves: List[Any], pidx: int, reusing: bool
+) -> List[_OwnedWork]:
+    """Metadata-only pass: the complete shard list (owned + non-owned) before
+    a single byte moves.  Reuse carries the prior plan over verbatim — only
+    the data sources are rebound."""
+    work: List[_OwnedWork] = []
+    if reusing:
+        for info in staged.shards:
+            if not info.replica_owner:
+                continue
+            leaf = leaves[info.leaf_idx]
+            if _HAVE_JAX and isinstance(leaf, jax.Array):
+                shard = leaf.addressable_shards[info.shard_idx]
+                if shard.data.nbytes != info.nbytes:
+                    raise ValueError(
+                        f"restage size mismatch on leaf {info.leaf_idx}: "
+                        f"{shard.data.nbytes} != {info.nbytes} "
+                        "(stale plan signature?)"
+                    )
+                work.append(_OwnedWork(info, shard, True))
+            else:
+                work.append(_OwnedWork(info, leaf, False))
+        return work
 
-    # Phase 2: materialize owned shards into shm; record non-owned shards as
-    # metadata-only entries.
     for i, leaf in enumerate(leaves):
         if _HAVE_JAX and isinstance(leaf, jax.Array):
             global_shape = tuple(leaf.shape)
             for j, shard in enumerate(leaf.addressable_shards):
-                owner = _owner(leaf, shard)
+                owner = _owner(leaf, shard, pidx)
                 index = _shard_index(shard, global_shape)
+                info = ShardInfo(
+                    leaf_idx=i, shard_idx=j, global_shape=global_shape,
+                    index=index, dtype=str(shard.data.dtype),
+                    shm_name="", nbytes=int(shard.data.nbytes) if owner else 0,
+                    replica_owner=owner,
+                )
+                staged.shards.append(info)
                 if owner:
-                    arr = np.asarray(shard.data)  # completes the async copy
-                    _stage_ndarray(staged, arr, i, j, global_shape, index, True)
-                else:
-                    shape = tuple(b - a for a, b in index)
-                    staged.shards.append(
-                        ShardInfo(
-                            leaf_idx=i, shard_idx=j, global_shape=global_shape,
-                            index=index, dtype=str(shard.data.dtype),
-                            shm_name="", nbytes=0, replica_owner=False,
-                        )
-                    )
+                    work.append(_OwnedWork(info, shard, True))
         else:
             arr = np.asarray(leaf)
-            _stage_ndarray(
-                staged, arr, i, 0, tuple(arr.shape),
-                tuple((0, s) for s in arr.shape), pidx == 0,
+            info = ShardInfo(
+                leaf_idx=i, shard_idx=0, global_shape=tuple(arr.shape),
+                index=tuple((0, s) for s in arr.shape), dtype=str(arr.dtype),
+                shm_name="", nbytes=arr.nbytes if pidx == 0 else 0,
+                replica_owner=pidx == 0,
             )
-    staged.bytes_allocated = sum(s.nbytes for s in staged.shards if s.replica_owner)
-    return staged
+            staged.shards.append(info)
+            if info.replica_owner:
+                work.append(_OwnedWork(info, arr, False))
+    return work
 
 
-def _restage_into(tree: Any, reuse: StagedTree, leaves: List[Any]) -> StagedTree:
-    """Rewrite a pooled StagedTree's shm buffers with this tree's values.
-    Plan (shard list, shm names, sizes) carries over verbatim; only bytes move.
-    D2H of every owned shard is kicked off async first, then copies land."""
-    owned_arrays: List[np.ndarray] = []
-    pending = []
-    oi = 0
-    for info in reuse.shards:
-        if not info.replica_owner:
-            continue
-        leaf = leaves[info.leaf_idx]
-        if _HAVE_JAX and isinstance(leaf, jax.Array):
-            shard = leaf.addressable_shards[info.shard_idx]
-            shard.data.copy_to_host_async()
-            pending.append((oi, shard))
-            owned_arrays.append(None)
-        else:
-            owned_arrays.append(np.asarray(leaf))
-        oi += 1
-    for slot, shard in pending:
-        owned_arrays[slot] = np.asarray(shard.data)  # completes the async copy
-    for arr, shm, info in zip(
-        owned_arrays,
-        reuse._shms,
-        [s for s in reuse.shards if s.replica_owner],
-    ):
-        if arr.nbytes != info.nbytes:
-            raise ValueError(
-                f"restage size mismatch on leaf {info.leaf_idx}: "
-                f"{arr.nbytes} != {info.nbytes} (stale plan signature?)"
-            )
-        dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
-        np.copyto(dst, arr, casting="no")
-    reuse.bytes_allocated = 0
-    reuse.bytes_reused = sum(s.nbytes for s in reuse.shards if s.replica_owner)
-    return reuse
-
-
-def _stage_ndarray(
+def _stage_pipelined(
     staged: StagedTree,
-    arr: np.ndarray,
-    leaf_idx: int,
-    shard_idx: int,
-    global_shape: Tuple[int, ...],
-    index: Tuple[Tuple[int, int], ...],
-    owner: bool,
-) -> ShardInfo:
-    nbytes = arr.nbytes  # true size; 0 for empty leaves (shm pads to 1)
-    shm_name = ""
-    if owner:
-        shm = create_shm(max(1, nbytes))
+    leaves: List[Any],
+    pidx: int,
+    reusing: bool,
+    on_plan: Optional[Callable[[int], None]],
+    on_shard_staged: Optional[Callable[[ShardInfo], None]],
+) -> StagedTree:
+    work = _build_plan(staged, leaves, pidx, reusing)
+    total = sum(w.info.nbytes for w in work)
+    if on_plan is not None:
+        on_plan(total)
+
+    # Kick off async D2H for every owned jax shard before copying anything:
+    # all DMAs are in flight while shard-by-shard memcpys land below.
+    jax_pending = 0
+    for w in work:
+        if w.is_jax:
+            w.source.data.copy_to_host_async()
+            jax_pending += 1
+
+    shms = staged._shms if reusing else []
+    wait_s = copy_s = hidden_copy_s = 0.0
+    for k, w in enumerate(work):
+        t0 = time.perf_counter()
+        if w.is_jax:
+            arr = np.asarray(w.source.data)  # completes THIS shard's D2H only
+            jax_pending -= 1
+        else:
+            arr = np.asarray(w.source)
+        t1 = time.perf_counter()
+        if reusing:
+            shm = shms[k]
+            if arr.nbytes != w.info.nbytes:
+                raise ValueError(
+                    f"restage size mismatch on leaf {w.info.leaf_idx}: "
+                    f"{arr.nbytes} != {w.info.nbytes} (stale plan signature?)"
+                )
+        else:
+            shm = create_shm(max(1, arr.nbytes))
+            staged._shms.append(shm)
+            w.info.shm_name = shm.name
+            w.info.nbytes = arr.nbytes
         dst = np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)
         np.copyto(dst, arr, casting="no")
-        staged._shms.append(shm)
-        shm_name = shm.name
-    info = ShardInfo(
-        leaf_idx=leaf_idx,
-        shard_idx=shard_idx,
-        global_shape=global_shape,
-        index=index,
-        dtype=str(arr.dtype),
-        shm_name=shm_name,
-        nbytes=nbytes,
-        replica_owner=owner,
-    )
-    staged.shards.append(info)
-    return info
+        t2 = time.perf_counter()
+        wait_s += t1 - t0
+        copy_s += t2 - t1
+        if jax_pending > 0:  # this memcpy ran under at least one live DMA
+            hidden_copy_s += t2 - t1
+        if on_shard_staged is not None:
+            on_shard_staged(w.info)
+
+    owned_bytes = sum(w.info.nbytes for w in work)
+    staged.bytes_allocated = 0 if reusing else owned_bytes
+    staged.bytes_reused = owned_bytes if reusing else 0
+    staged.stage_wait_s = wait_s
+    staged.stage_copy_s = copy_s
+    staged.stage_overlap_pct = 100.0 * hidden_copy_s / copy_s if copy_s > 0 else 0.0
+    return staged
 
 
 def shard_payload(info: ShardInfo) -> Dict[str, Any]:

@@ -8,21 +8,45 @@ library must not crash user jobs over that.  (The reference inherits this
 footgun from mp.spawn, ``core.py:482-515``; this design removes it.)
 
 Protocol over stdin/stdout pipes: u32-length-prefixed pickle frames.
-Request: (call_idx, fn, args) — fn must be importable (not defined in the
-user's __main__).  Response: (call_idx, error_str_or_None, duration_s).
 Pickle is acceptable here: the pipe is a private fd pair with our own parent,
-not a network surface.
+not a network surface.  Functions must be importable (not defined in the
+user's ``__main__``).
+
+Requests (trainer → worker):
+
+    ("call",   call_idx, fn, args)   run ``fn(*args)`` in a worker thread
+    ("sbegin", call_idx, fn, args)   begin a STREAMED call: run
+                                     ``fn(*args, item_iter, progress_cb)``
+                                     where ``item_iter`` yields subsequent
+                                     stream items as they arrive
+    ("sitem",  call_idx, item)       feed one item to the streamed call
+    ("send",   call_idx, err)        end the stream; ``err`` != None aborts
+                                     (the iterator raises inside ``fn``)
+    None                             shutdown: drain active calls and exit
+
+Responses (worker → trainer):
+
+    ("done", call_idx, error_str_or_None, duration_s)
+    ("prog", call_idx, bytes_written, bytes_total)   drain progress, emitted
+                                     by streamed fns through ``progress_cb``
+
+Calls run in threads so a long drain never blocks the frame loop — stream
+items for one save keep flowing while another save is still writing.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import queue as queue_mod
 import struct
 import sys
+import threading
 import time
 
 _U32 = struct.Struct("<I")
+
+_END = object()
 
 
 def _read_exact(stream, n: int):
@@ -58,6 +82,10 @@ def _set_io_priority() -> None:
         pass
 
 
+class _StreamAborted(RuntimeError):
+    pass
+
+
 def main() -> None:
     # The writer only touches numpy+shm, but imports can pull in jax — this
     # process must never claim TPU chips from the trainer.
@@ -73,30 +101,91 @@ def main() -> None:
     stdout = sys.stdout.buffer
     # anything the written fns print must not corrupt the response stream
     sys.stdout = sys.stderr
+
+    out_lock = threading.Lock()
+
+    def send(obj) -> None:
+        raw = pickle.dumps(obj)
+        try:
+            with out_lock:
+                stdout.write(_U32.pack(len(raw)) + raw)
+                stdout.flush()
+        except (BrokenPipeError, OSError):
+            pass  # trainer died; nothing to report to
+
+    threads: list = []
+    streams: dict = {}
+
+    def run(call_idx, fn, args, item_q=None) -> None:
+        t0 = time.monotonic()
+        try:
+            if item_q is None:
+                fn(*args)
+            else:
+                def items():
+                    while True:
+                        got = item_q.get()
+                        if got is _END:
+                            return
+                        if isinstance(got, _StreamAborted):
+                            raise got
+                        yield got
+
+                def progress(written, total):
+                    send(("prog", call_idx, int(written), int(total)))
+
+                fn(*args, items(), progress)
+            send(("done", call_idx, None, time.monotonic() - t0))
+        except BaseException as exc:  # noqa: BLE001 - report to trainer
+            send(("done", call_idx, f"{type(exc).__name__}: {exc}",
+                  time.monotonic() - t0))
+
+    def spawn(call_idx, fn, args, item_q=None) -> None:
+        t = threading.Thread(
+            target=run, args=(call_idx, fn, args, item_q),
+            name=f"tpurx-ckpt-call{call_idx}", daemon=True,
+        )
+        threads.append(t)
+        t.start()
+
     while True:
         hdr = _read_exact(stdin, 4)
         if hdr is None:
-            return
+            break
         (n,) = _U32.unpack(hdr)
         raw = _read_exact(stdin, n)
         if raw is None:
-            return
+            break
         req = pickle.loads(raw)
         if req is None:
-            return
-        call_idx, fn, args = req
-        t0 = time.monotonic()
-        try:
-            fn(*args)
-            resp = (call_idx, None, time.monotonic() - t0)
-        except BaseException as exc:  # noqa: BLE001 - report to trainer
-            resp = (call_idx, f"{type(exc).__name__}: {exc}", time.monotonic() - t0)
-        out = pickle.dumps(resp)
-        try:
-            stdout.write(_U32.pack(len(out)) + out)
-            stdout.flush()
-        except BrokenPipeError:
-            return  # trainer died; nothing to report to
+            break
+        kind = req[0]
+        if kind == "call":
+            _, call_idx, fn, args = req
+            spawn(call_idx, fn, args)
+        elif kind == "sbegin":
+            _, call_idx, fn, args = req
+            q: "queue_mod.Queue" = queue_mod.Queue()
+            streams[call_idx] = q
+            spawn(call_idx, fn, args, q)
+        elif kind == "sitem":
+            _, call_idx, item = req
+            q = streams.get(call_idx)
+            if q is not None:
+                q.put(item)
+        elif kind == "send":
+            _, call_idx, err = req
+            q = streams.pop(call_idx, None)
+            if q is not None:
+                q.put(_StreamAborted(err) if err else _END)
+
+    # shutdown (explicit or trainer EOF): open streams can never complete —
+    # abort them so their threads unwind and clean up tmp files, then drain
+    for q in streams.values():
+        q.put(_StreamAborted("stream closed before completion (trainer exit)"))
+    streams.clear()
+    for t in threads:
+        t.join()
 
 
 if __name__ == "__main__":

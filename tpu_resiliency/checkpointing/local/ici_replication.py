@@ -104,12 +104,14 @@ class IciReplication:
 
             return _jax.lax.ppermute(x, axis, perm)
 
-        smapped = jax.shard_map(
+        from ...utils.jax_compat import shard_map as shard_map_compat
+
+        smapped = shard_map_compat(
             body,
             mesh=self.mesh,
             in_specs=P(self.axis),
             out_specs=P(self.axis),
-            check_vma=False,
+            check=False,
         )
         jitted = jax.jit(smapped)
         self._fns[shift] = (jitted, NamedSharding(self.mesh, P(self.axis)))

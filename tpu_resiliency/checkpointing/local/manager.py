@@ -258,7 +258,9 @@ class LocalCheckpointManager:
         elif self.store is not None and self.replication is not None:
             # still participate in the exchange plan as a sender
             self._retrieve_from_peers(iteration, have_own=True)
-        tat = TensorAwareTree.from_bytes(blob)
+        # zero-copy parse: device_put consumes the views straight out of the
+        # blob; host leaves are copied out by to_tree (views never escape)
+        tat = TensorAwareTree.from_bytes(blob, copy=False)
         tree = tat.to_tree_like(template)
         record_event(
             ProfilingEvent.CHECKPOINT_LOAD_COMPLETED, kind="local", iteration=iteration

@@ -168,7 +168,10 @@ class TensorAwareTree:
                 whole = _maybe_whole(meta, shards)
                 if whole is None:
                     raise ValueError("non-jax template leaf needs whole capture")
-                out.append(whole)
+                # zero-copy loads hand out read-only views over the blob;
+                # host leaves escape to the user, so give them an owned,
+                # writable array (and let the blob be freed)
+                out.append(whole if whole.flags.writeable else whole.copy())
         return jtu.tree_unflatten(tmpl_def, out)
 
     # alias kept for symmetry with earlier API
@@ -177,6 +180,9 @@ class TensorAwareTree:
     # -- byte serialization ------------------------------------------------
 
     def to_bytes(self) -> bytes:
+        """One serialization pass with no per-array intermediate copy: each
+        array's buffer is written straight into the output (``tobytes()``
+        would materialize every leaf twice — 2x peak RAM at GiB scale)."""
         if self.arrays is None:
             raise RuntimeError("cannot serialize a hollow tree")
         header = {
@@ -192,13 +198,19 @@ class TensorAwareTree:
         buf.write(_U64.pack(len(hdr)))
         buf.write(hdr)
         for a in self.arrays:
-            raw = np.ascontiguousarray(a).tobytes()
-            buf.write(_U64.pack(len(raw)))
-            buf.write(raw)
+            a2 = np.ascontiguousarray(a)
+            buf.write(_U64.pack(a2.nbytes))
+            buf.write(a2.data)
         return buf.getvalue()
 
     @classmethod
-    def from_bytes(cls, raw: bytes) -> "TensorAwareTree":
+    def from_bytes(cls, raw: bytes, copy: bool = True) -> "TensorAwareTree":
+        """Parse a serialized tree.  With ``copy=False`` the arrays are
+        read-only zero-copy VIEWS over ``raw`` — the loader's fast path
+        (``device_put`` consumes them immediately; ``raw`` must outlive any
+        view the caller keeps).  The chunked async-drain writer changed
+        nothing about this layout: blobs remain raw little-endian buffers
+        behind a JSON header, whatever chunk size produced them."""
         view = memoryview(raw)
         if bytes(view[:8]) != _MAGIC:
             raise ValueError("bad local-checkpoint magic")
@@ -212,7 +224,8 @@ class TensorAwareTree:
             (n,) = _U64.unpack(view[off : off + 8])
             off += 8
             arr = np.frombuffer(view[off : off + n], dtype=resolve_dtype(dtype))
-            arrays.append(arr.reshape(shape).copy())
+            arr = arr.reshape(shape)
+            arrays.append(arr.copy() if copy else arr)
             off += n
         return cls(
             treedef=header["treedef"],  # repr only — rebuild needs a template

@@ -30,7 +30,7 @@ from ..utils.profiling import ProfilingEvent, record_event
 from .attribution import Interruption, InterruptionRecord
 from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
 from .monitor_process import MonitorProcess
-from .monitor_thread import MonitorThread, quiesce_with_retry
+from .monitor_thread import MonitorThread
 from .progress_watchdog import ProgressWatchdog
 from .rank_assignment import RankAssignmentCtx, RankDiscontinued, ShiftRanks
 from .sibling_monitor import SiblingMonitor

@@ -149,12 +149,14 @@ def make_quorum_fn(
     def _body(ages):
         return jax.lax.pmax(local_max(ages), axis)
 
-    smapped = jax.shard_map(
+    from ..utils.jax_compat import shard_map as shard_map_compat
+
+    smapped = shard_map_compat(
         _body,
         mesh=mesh,
         in_specs=P(axis),
         out_specs=P(),
-        check_vma=False,  # the pallas local-reduce's out vma is opaque to the checker
+        check=False,  # the pallas local-reduce's out vma is opaque to the checker
     )
     sharding = NamedSharding(mesh, P(axis))
     jitted = jax.jit(smapped)
@@ -314,13 +316,28 @@ class QuorumMonitor:
         Freshness compares wrap-safe AGES, not raw stamps — both sources
         fold into the int32 epoch (C side mirrors ``now_stamp_ms``), and a
         raw max() would both break at the 24.8-day wrap and let a stale
-        native stamp shadow a fresh manual ``beat()``."""
+        native stamp shadow a fresh manual ``beat()``.
+
+        A source can legitimately stamp a NEWER millisecond than our
+        pre-read ``now`` (the C thread runs concurrently; NTP skew across
+        processes): its age then folds to ~2^31 and a naive compare would
+        discard the freshest stamp for a stale one — on a monitor whose
+        manual beat() is seconds old, that single race tick trips a
+        spurious restart.  Any age past the half-wrap horizon can only be
+        a future stamp (a genuinely stale one would have tripped eons
+        earlier), so clamp it to 0: future == fresh."""
         if self._native_slot is None:
             return self._last_beat_ms
         now = now_stamp_ms()
         a = self._last_beat_ms
         b = self._native_slot.value % _WRAP
-        return a if (now - a) % _WRAP <= (now - b) % _WRAP else b
+        age_a = (now - a) % _WRAP
+        age_b = (now - b) % _WRAP
+        if age_a > _WRAP // 2:
+            age_a = 0
+        if age_b > _WRAP // 2:
+            age_b = 0
+        return a if age_a <= age_b else b
 
     def _start_native_beater(self) -> bool:
         import ctypes

@@ -330,7 +330,11 @@ class GkeJobSetScheduler:
         return states
 
     def _job_dirs(self, job_id: str) -> Tuple[str, Optional[str]]:
-        jdir = os.path.join(self.artifacts_root, job_id)
+        # ``--all-namespaces`` ids are ``<namespace>/<name>`` (collision-safe
+        # tracking keys), but artifacts follow the launcher convention
+        # ``<root>/<jobset-name>/...`` — path by the bare name, never the
+        # namespaced id, or monitoring points at nonexistent directories.
+        jdir = os.path.join(self.artifacts_root, job_id.rsplit("/", 1)[-1])
         cand = os.path.join(jdir, "cycles")
         cdir = cand if os.path.isdir(cand) else jdir
         ldir = os.path.join(jdir, "logs")

@@ -1,0 +1,30 @@
+"""Version-portability shims for moving-target JAX APIs.
+
+The repo must run across the jax versions fleets actually pin: ``shard_map``
+graduated from ``jax.experimental.shard_map`` (replication check kwarg
+``check_rep``) to top-level ``jax.shard_map`` (kwarg ``check_vma``) — code
+written against either spelling breaks on the other.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(body, mesh, in_specs, out_specs, check: bool = False):
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map`` on old.
+
+    ``check`` maps to ``check_vma`` (new) / ``check_rep`` (old) — both
+    gate the same replication/varying-manual-axes validation, which callers
+    here disable (pallas local-reduce outputs are opaque to the checker)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check,
+    )
