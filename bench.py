@@ -341,6 +341,9 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "ckpt1g_stage_overlap_pct", "ckpt1g_write_threads",
         "ckpt1g_drain_progress_pct",
         "straggler_collector_overhead_pct",
+        "tm_store_ops", "tm_store_op_p50_us", "tm_store_op_p99_us",
+        "tm_ckpt_saves", "tm_ckpt_stage_mb", "tm_restarts",
+        "tm_restart_p50_ms", "tm_monitor_trips", "tm_metric_inc_ns",
     ):
         if key in partial:
             line[key] = partial[key]
@@ -854,6 +857,77 @@ def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
     return out
 
 
+def _telemetry_keys() -> dict:
+    """Derive bench keys from the in-process telemetry registry — the same
+    series production scrapes from the per-rank exporter, so bench numbers
+    and dashboards can be cross-checked against each other."""
+    from tpu_resiliency.telemetry import get_registry
+
+    reg = get_registry()
+    out = {}
+
+    def fam_sum(name):
+        m = reg.get(name)
+        if m is None:
+            return None
+        return sum(v.get("value", 0.0) for _l, v in m._sample_rows())
+
+    def hist_quantile(name, q):
+        m = reg.get(name)
+        if m is None:
+            return None
+        rows = m._sample_rows()
+        if not rows:
+            return None
+        bounds = rows[0][1]["bounds"]
+        counts = [0] * (len(bounds) + 1)
+        for _l, v in rows:
+            counts = [a + b for a, b in zip(counts, v["counts"])]
+        total = sum(counts)
+        if not total:
+            return None
+        target = max(1, int(q * total + 0.5))
+        seen = 0
+        for i, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                return bounds[min(i, len(bounds) - 1)]
+        return bounds[-1]
+
+    ops = fam_sum("tpurx_store_ops_total")
+    if ops:
+        out["tm_store_ops"] = int(ops)
+        p50 = hist_quantile("tpurx_store_op_latency_ns", 0.5)
+        p99 = hist_quantile("tpurx_store_op_latency_ns", 0.99)
+        if p50 is not None:
+            out["tm_store_op_p50_us"] = round(p50 / 1e3, 1)
+        if p99 is not None:
+            out["tm_store_op_p99_us"] = round(p99 / 1e3, 1)
+    saves = fam_sum("tpurx_ckpt_saves_total")
+    if saves:
+        out["tm_ckpt_saves"] = int(saves)
+        stage_b = fam_sum("tpurx_ckpt_stage_bytes_total") or 0
+        out["tm_ckpt_stage_mb"] = round(stage_b / 1e6, 1)
+    restarts = fam_sum("tpurx_inprocess_restarts_total")
+    if restarts:
+        out["tm_restarts"] = int(restarts)
+        p50 = hist_quantile("tpurx_restart_total_latency_ns", 0.5)
+        if p50 is not None:
+            out["tm_restart_p50_ms"] = round(p50 / 1e6, 1)
+    trips = fam_sum("tpurx_monitor_trips_total")
+    if trips:
+        out["tm_monitor_trips"] = int(trips)
+    # hot-path cost of one enabled counter increment (the instrumented
+    # paths above pay this per event)
+    probe = reg.counter("tpurx_bench_probe_total", "bench: inc cost probe")
+    n = 100_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n):
+        probe.inc()
+    out["tm_metric_inc_ns"] = round((time.perf_counter_ns() - t0) / n, 1)
+    return out
+
+
 def child_main(mode: str) -> None:
     budget_s = float(os.environ.get("TPURX_BENCH_CHILD_BUDGET_S", "300"))
     light = os.environ.get("TPURX_BENCH_LIGHT") == "1"
@@ -993,6 +1067,12 @@ def child_main(mode: str) -> None:
               "partial results", file=sys.stderr, flush=True)
         _PARTIAL["partial"] = True
     signal.alarm(0)
+    try:
+        _PARTIAL.update(_telemetry_keys())
+        _save_partial()
+    except Exception as exc:  # optional keys, never fatal
+        print(f"bench: telemetry keys skipped: {exc!r}",
+              file=sys.stderr, flush=True)
     if _PARTIAL.get("detect_ms") is None:
         # Nothing measurable — leave partials for the supervisor, exit loud.
         sys.exit(4)

@@ -411,3 +411,33 @@ def test_snapshot_staging_error_surfaces(tmp_path):
     with pytest.raises(CheckpointSaveError, match="staging failed"):
         ckpt.finalize_all()
     ckpt.close()
+
+
+def test_drain_progress_monotonic_and_terminal(tmp_path, monkeypatch):
+    """PR 1's drain_progress(): (written, total) is monotonic 0→total while
+    the save is in flight (reaching written == total once the worker's final
+    progress frame lands) and terminal (0, 0) after finalize empties the
+    in-flight set."""
+    monkeypatch.setenv("TPURX_CKPT_CHUNK_BYTES", str(1 << 20))  # many frames
+    ckpt = AsyncCheckpointer()
+    tree = {"big": np.ones((8 << 20,), np.float32)}  # 32 MiB, 32 chunks
+    d = str(tmp_path / "prog")
+    ckpt.async_save(tree, d, save_id="p")
+    samples = []
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        samples.append(ckpt.drain_progress())  # call stays pending: no
+        w, t = samples[-1]                     # maybe_finalize in this loop
+        if t and w == t:
+            break
+        time.sleep(0.005)
+    ckpt.finalize_all()
+    terminal = ckpt.drain_progress()
+    ckpt.close()
+    assert is_committed(d)
+    written_seq = [w for w, _t in samples]
+    assert written_seq == sorted(written_seq), "drain progress went backwards"
+    totals = {t for _w, t in samples if t}
+    assert totals == {tree["big"].nbytes}, f"unexpected totals {totals}"
+    assert samples[-1] == (tree["big"].nbytes, tree["big"].nbytes)  # reached 1.0
+    assert terminal == (0, 0)  # nothing in flight after finalize

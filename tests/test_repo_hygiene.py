@@ -4,14 +4,34 @@ The native helpers (``native/*.so``, ``native/tpurx-store-server``) are
 built on first use by ``tpu_resiliency/utils/native.py`` — compiled
 artifacts must never be tracked in git, where they are unreviewable and go
 stale against their sources (VERDICT r4 weak #5).
+
+Library output discipline: structured logging only — a bare ``print()`` in
+a library module bypasses rank prefixes, the log funnel, and level control.
+CLI entry points (argparse mains that talk to a terminal) are allowlisted.
+
+Telemetry discipline: every metric name an instrumentation call site
+references must be declared exactly once with a valid OpenMetrics name, and
+importing the defining module must actually register it.
 """
 
+import ast
+import importlib
 import os
 import subprocess
 
 import pytest
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "tpu_resiliency")
+
+# CLI entry points: argparse mains whose stdout IS the interface
+PRINT_ALLOWLIST = {
+    "tpu_resiliency/straggler/inspect.py",
+    "tpu_resiliency/utils/shm_janitor.py",
+    "tpu_resiliency/health/device.py",
+    "tpu_resiliency/fault_tolerance/per_cycle_logs.py",
+    "tpu_resiliency/telemetry/trace.py",
+}
 
 
 def _tracked_files():
@@ -59,3 +79,94 @@ def test_native_build_outputs_are_gitignored():
             ["git", "check-ignore", "-q", artifact], cwd=REPO, timeout=30,
         ).returncode
         assert rc == 0, f"{artifact} is not gitignored"
+
+
+def _library_sources():
+    for root, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+            yield rel, path
+
+
+def test_no_bare_print_in_library_modules():
+    """AST-based (strings and comments can't false-positive): any
+    ``print(...)`` call outside the CLI allowlist is an offender."""
+    offenders = []
+    for rel, path in _library_sources():
+        if rel in PRINT_ALLOWLIST:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        f"bare print() in library modules (use utils.logging.get_logger, or "
+        f"add a CLI entry point to PRINT_ALLOWLIST): {offenders}"
+    )
+
+
+def _declared_metric_names():
+    """(name, rel, lineno) for every registry-constructor call with a
+    literal first argument anywhere in the package."""
+    ctors = {"counter", "gauge", "histogram"}
+    out = []
+    for rel, path in _library_sources():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Name) and func.id in ctors:
+                name = func.id
+            elif isinstance(func, ast.Attribute) and func.attr in ctors:
+                name = func.attr
+            if name is None or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                if first.value.startswith("tpurx_"):
+                    out.append((first.value, rel, node.lineno))
+    return out
+
+
+def test_metric_names_valid_and_declared_exactly_once():
+    from tpu_resiliency.telemetry import valid_metric_name
+
+    declared = _declared_metric_names()
+    assert declared, "no metric declarations found — scanner broken?"
+    seen = {}
+    for name, rel, lineno in declared:
+        assert valid_metric_name(name), f"invalid OpenMetrics name {name!r} at {rel}:{lineno}"
+        seen.setdefault(name, []).append(f"{rel}:{lineno}")
+    dupes = {n: sites for n, sites in seen.items() if len(sites) > 1}
+    assert not dupes, (
+        f"metric names declared at more than one call site (move the "
+        f"declaration to one module and import the handle): {dupes}"
+    )
+
+
+def test_declared_metrics_register_on_import():
+    """Importing each declaring module must land its names in the default
+    registry — a typo'd registration (or a module-local registry) would
+    silently drop the series from every exporter."""
+    from tpu_resiliency.telemetry import get_registry
+
+    declared = _declared_metric_names()
+    for _name, rel, _lineno in declared:
+        mod = rel[: -len(".py")].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        importlib.import_module(mod)
+    registered = set(get_registry().names())
+    missing = {n for n, _r, _l in declared} - registered
+    assert not missing, f"declared but never registered: {sorted(missing)}"

@@ -514,5 +514,13 @@ def test_smonsvc_status_server_endpoints(tmp_path):
         assert jobs[0]["job_id"] == "default"
         health = json.loads(_rq.urlopen(f"http://127.0.0.1:{port}/health").read())
         assert health["status"] == "ok"
+        # /metrics: smonsvc's own registry, plus spliced job-level aggregates
+        mon.aggregated_text_fn = lambda: 'tpurx_job_probe{agg="sum"} 42'
+        body = _rq.urlopen(f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert body.rstrip().endswith("# EOF")
+        assert "tpurx_smonsvc_polls_total" in body
+        assert 'tpurx_job_probe{agg="sum"} 42' in body
+        eof_at = body.index("# EOF")
+        assert body.index("tpurx_job_probe") < eof_at
     finally:
         server.shutdown()

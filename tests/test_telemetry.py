@@ -1,0 +1,378 @@
+"""Telemetry plane unit tests: registry semantics, OpenMetrics rendering,
+cross-rank aggregation, trace export, and the disabled-path cost contract."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_resiliency.telemetry import (
+    DEFAULT_NS_BUCKETS,
+    NOOP,
+    Registry,
+)
+from tpu_resiliency.telemetry.aggregate import (
+    CrossRankAggregator,
+    aggregate_snapshots,
+    outliers,
+    render_job_metrics,
+)
+from tpu_resiliency.telemetry.exporter import (
+    MetricsHTTPServer,
+    TextfileSink,
+    render_openmetrics,
+)
+from tpu_resiliency.telemetry.trace import to_chrome_trace
+
+
+# ---- registry ---------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_and_gauge(self):
+        r = Registry(enabled=True)
+        c = r.counter("tpurx_x_total", "help")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        g = r.gauge("tpurx_g")
+        g.set(2.5)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 3.0
+
+    def test_counter_requires_total_suffix_and_valid_name(self):
+        r = Registry(enabled=True)
+        with pytest.raises(ValueError):
+            r.counter("tpurx_x")
+        with pytest.raises(ValueError):
+            r.gauge("bad name!")
+
+    def test_counters_never_decrease(self):
+        r = Registry(enabled=True)
+        with pytest.raises(ValueError):
+            r.counter("tpurx_x_total").inc(-1)
+
+    def test_labels(self):
+        r = Registry(enabled=True)
+        c = r.counter("tpurx_ops_total", labels=("op",))
+        c.labels("GET").inc(2)
+        c.labels(op="SET").inc()
+        assert r.value_of("tpurx_ops_total", {"op": "GET"}) == 2
+        assert r.value_of("tpurx_ops_total", {"op": "SET"}) == 1
+        with pytest.raises(ValueError):
+            c.labels("a", "b")
+
+    def test_duplicate_registration(self):
+        r = Registry(enabled=True)
+        a = r.counter("tpurx_x_total")
+        assert r.counter("tpurx_x_total") is a  # idempotent
+        with pytest.raises(ValueError):
+            r.gauge("tpurx_x_total")  # kind conflict
+        with pytest.raises(ValueError):
+            r.counter("tpurx_x_total", labels=("op",))  # label conflict
+
+    def test_histogram_buckets_and_quantile(self):
+        r = Registry(enabled=True)
+        h = r.histogram("tpurx_lat_ns", buckets=(10, 100, 1000))
+        for v in (5, 50, 50, 500, 5000):
+            h.observe(v)
+        assert h.count == 5
+        d = h._value_dict()
+        assert d["counts"] == [1, 2, 1, 1]
+        assert d["sum"] == 5605
+        assert h.quantile(0.5) == 100  # 3rd of 5 lands in the <=100 bucket
+
+    def test_histogram_timer(self):
+        r = Registry(enabled=True)
+        h = r.histogram("tpurx_t_ns")
+        with h.time_ns():
+            pass
+        assert h.count == 1
+
+    def test_thread_safety(self):
+        r = Registry(enabled=True)
+        c = r.counter("tpurx_mt_total")
+
+        def spin():
+            for _ in range(10_000):
+                c.inc()
+
+        threads = [threading.Thread(target=spin) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 80_000
+
+
+class TestDisabledPath:
+    def test_disabled_returns_shared_noop(self):
+        r = Registry(enabled=False)
+        c = r.counter("tpurx_x_total")
+        assert c is NOOP
+        assert r.histogram("tpurx_h_ns") is NOOP
+        assert r.gauge("tpurx_g").labels() is NOOP
+        c.inc()
+        NOOP.observe(5)
+        with NOOP.time_ns():
+            pass
+        assert r.collect() == []  # nothing ever materializes
+        # the catalog still knows the names (one-time registration cost)
+        assert "tpurx_x_total" in r.names()
+
+    def test_env_switch(self, monkeypatch):
+        monkeypatch.setenv("TPURX_TELEMETRY", "0")
+        assert Registry().counter("tpurx_e_total") is NOOP
+        monkeypatch.setenv("TPURX_TELEMETRY", "1")
+        assert Registry().counter("tpurx_e_total") is not NOOP
+
+    def test_increment_cost_microbenchmark(self):
+        """Acceptance contract: disabled increments are no-ops, enabled
+        increments are sub-microsecond.  Best-of-5 batches to shrug off CI
+        scheduler noise."""
+        n = 50_000
+
+        def per_op(c):
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter_ns()
+                for _ in range(n):
+                    c.inc()
+                best = min(best, (time.perf_counter_ns() - t0) / n)
+            return best
+
+        disabled = per_op(Registry(enabled=False).counter("tpurx_b_total"))
+        enabled = per_op(Registry(enabled=True).counter("tpurx_b_total"))
+        assert disabled < 1_000, f"disabled inc cost {disabled:.0f}ns"
+        assert enabled < 1_000, f"enabled inc cost {enabled:.0f}ns"
+
+
+# ---- exporter ---------------------------------------------------------------
+
+
+OM_SAMPLE_RE = (
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [^ ]+$'
+)
+
+
+def assert_valid_openmetrics(text: str):
+    import re
+
+    lines = text.strip().split("\n")
+    assert lines[-1] == "# EOF"
+    for line in lines[:-1]:
+        if line.startswith("#"):
+            assert line.startswith(("# TYPE ", "# HELP ")), line
+        else:
+            assert re.match(OM_SAMPLE_RE, line), f"bad sample line: {line!r}"
+
+
+def _populated_registry():
+    r = Registry(enabled=True)
+    r.counter("tpurx_ops_total", "ops", labels=("op",)).labels("GET").inc(7)
+    r.gauge("tpurx_depth", "queue depth").set(3)
+    h = r.histogram("tpurx_lat_ns", "latency")
+    h.observe(2_000)
+    h.observe(3e9)
+    return r
+
+
+class TestExporter:
+    def test_render_valid_and_complete(self):
+        text = render_openmetrics(_populated_registry())
+        assert_valid_openmetrics(text)
+        assert 'tpurx_ops_total{op="GET"} 7' in text
+        assert "# TYPE tpurx_ops counter" in text  # family drops _total
+        assert "tpurx_depth 3" in text
+        assert "tpurx_lat_ns_count 2" in text
+        assert 'tpurx_lat_ns_bucket{le="+Inf"} 2' in text
+
+    def test_label_escaping(self):
+        r = Registry(enabled=True)
+        r.counter("tpurx_esc_total", labels=("p",)).labels('a"b\\c\nd').inc()
+        text = render_openmetrics(r)
+        assert '{p="a\\"b\\\\c\\nd"}' in text
+
+    def test_http_server_scrape(self):
+        server = MetricsHTTPServer(_populated_registry(), host="127.0.0.1").start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ) as resp:
+                assert resp.status == 200
+                assert "openmetrics-text" in resp.headers["Content-Type"]
+                body = resp.read().decode()
+            assert_valid_openmetrics(body)
+            assert 'tpurx_ops_total{op="GET"} 7' in body
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=5
+            ) as resp:
+                assert resp.read() == b"ok"
+        finally:
+            server.close()
+
+    def test_serve_from_env_local_rank_port_offset(self, monkeypatch):
+        from tpu_resiliency.telemetry import exporter as exp_mod
+
+        srv = MetricsHTTPServer(Registry(enabled=True), host="127.0.0.1").start()
+        base = srv.port  # a port we know is free... after close
+        srv.close()
+        monkeypatch.setenv("TPURX_METRICS_PORT", str(base))
+        monkeypatch.setenv("TPURX_LOCAL_RANK", "0")
+        started = exp_mod.serve_from_env(Registry(enabled=True))
+        try:
+            assert [s.port for s in started] == [base]
+        finally:
+            for s in started:
+                s.close()
+
+    def test_textfile_sink_expansion_and_atomicity(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPURX_RANK", "3")
+        sink = TextfileSink(
+            str(tmp_path / "metrics_%r.prom"), _populated_registry()
+        )
+        path = sink.write_once()
+        assert path.endswith("metrics_3.prom")
+        with open(path) as f:
+            assert_valid_openmetrics(f.read())
+        assert not list(tmp_path.glob("*.tmp"))
+
+
+# ---- aggregation ------------------------------------------------------------
+
+
+def _rank_registry(rank):
+    r = Registry(enabled=True)
+    r.counter("tpurx_drops_total").inc(rank * 10)
+    r.gauge("tpurx_score").set(1.0 / (rank + 1))
+    h = r.histogram("tpurx_lat_ns", buckets=(100, 1000))
+    h.observe(50 * (rank + 1))
+    return r
+
+
+class TestAggregate:
+    def test_sums_maxes_outliers(self):
+        snaps = {rank: _rank_registry(rank).snapshot() for rank in range(4)}
+        agg = aggregate_snapshots(snaps)
+        drops = agg["tpurx_drops_total"]["samples"][json.dumps({})]
+        assert drops["sum"] == 60
+        assert drops["max"] == 30 and drops["max_rank"] == 3
+        assert drops["min"] == 0
+        assert outliers(agg, "tpurx_drops_total", k=2) == [(3, 30.0), (2, 20.0)]
+        lat = agg["tpurx_lat_ns"]["samples"][json.dumps({})]
+        assert lat["count"] == 4
+        assert sum(lat["counts"]) == 4
+
+    def test_render_job_metrics(self):
+        snaps = {rank: _rank_registry(rank).snapshot() for rank in range(2)}
+        text = render_job_metrics(aggregate_snapshots(snaps))
+        assert 'tpurx_drops_total{agg="sum"} 10' in text
+        assert 'tpurx_drops_total{agg="max",rank="1"} 10' in text
+        assert 'tpurx_score{agg="max",rank="0"} 1' in text
+
+    def test_cross_rank_gather_over_store(self, store):
+        """Full collective: N rank threads publish, rank 0 reduces, round
+        keys are cleaned up (the straggler reporting round pattern)."""
+        world = 3
+        regs = {r: _rank_registry(r) for r in range(world)}
+        results = {}
+
+        def run(rank):
+            aggr = CrossRankAggregator(store.clone(), rank, world)
+            results[rank] = aggr.round(regs[rank], timeout=20.0)
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert results[1] is None and results[2] is None
+        agg = results[0]
+        drops = agg["tpurx_drops_total"]["samples"][json.dumps({})]
+        assert drops["sum"] == 30 and drops["max_rank"] == 2
+        assert store.list_keys("telemetry/round/0/") == []
+
+
+# ---- trace export -----------------------------------------------------------
+
+
+def _evt(event, mono_ns, **extra):
+    return {"ts": 0.0, "mono_ns": mono_ns, "event": event, "pid": 1, **extra}
+
+
+class TestTrace:
+    def test_pairs_spans_per_rank(self):
+        events = [
+            _evt("rendezvous_started", 1_000, rank=0, round=1),
+            _evt("rendezvous_completed", 4_000, rank=0, round=1, participants=2),
+            _evt("checkpoint_save_started", 2_000, rank=1),
+            _evt("checkpoint_save_finalized", 9_000, rank=1),
+            _evt("hang_detected", 5_000, rank=0, reason="x"),
+        ]
+        trace = to_chrome_trace(events)
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {s["name"] for s in spans} == {"rendezvous", "checkpoint_save"}
+        rdzv = next(s for s in spans if s["name"] == "rendezvous")
+        assert rdzv["pid"] == 0 and rdzv["ts"] == 0.0 and rdzv["dur"] == 3.0
+        assert rdzv["args"]["participants"] == 2
+        save = next(s for s in spans if s["name"] == "checkpoint_save")
+        assert save["pid"] == 1 and save["dur"] == 7.0
+        instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "hang_detected" for e in instants)
+        metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert {m["args"]["name"] for m in metas} == {"rank 0", "rank 1"}
+
+    def test_unfinished_span_becomes_instant(self):
+        trace = to_chrome_trace([_evt("inprocess_restart_started", 1_000, rank=2)])
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "inprocess_restart (unfinished)" in names
+
+    def test_health_checks_match_by_name(self):
+        events = [
+            _evt("health_check_started", 1_000, rank=0, check="tpu"),
+            _evt("health_check_started", 2_000, rank=0, check="storage"),
+            _evt("health_check_completed", 3_000, rank=0, check="tpu", healthy=True),
+            _evt("health_check_completed", 8_000, rank=0, check="storage", healthy=True),
+        ]
+        spans = [
+            e for e in to_chrome_trace(events)["traceEvents"] if e["ph"] == "X"
+        ]
+        by_check = {s["args"]["check"]: s for s in spans}
+        assert by_check["tpu"]["dur"] == 2.0
+        assert by_check["storage"]["dur"] == 6.0
+
+    def test_cli_end_to_end(self, tmp_path):
+        """`python -m tpu_resiliency.telemetry.trace` on a real
+        ProfilingRecorder JSONL file emits spans pairing the recorder's
+        start/end events (acceptance criterion)."""
+        import subprocess
+        import sys
+
+        from tpu_resiliency.utils.profiling import ProfilingEvent, ProfilingRecorder
+
+        jsonl = tmp_path / "prof.jsonl"
+        rec = ProfilingRecorder(path=str(jsonl))
+        rec.record(ProfilingEvent.RENDEZVOUS_STARTED, rank=0, round=0)
+        rec.record(ProfilingEvent.RENDEZVOUS_COMPLETED, rank=0, round=0)
+        rec.record(ProfilingEvent.CHECKPOINT_SAVE_STARTED, rank=0)
+        rec.record(ProfilingEvent.CHECKPOINT_SAVE_FINALIZED, rank=0)
+        rec.record(ProfilingEvent.HANG_DETECTED, rank=0, reason="test")
+        rec.close()
+        out = tmp_path / "trace.json"
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_resiliency.telemetry.trace",
+                str(jsonl), "-o", str(out),
+            ],
+            capture_output=True, text=True, timeout=60,
+            env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        trace = json.loads(out.read_text())
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {s["name"] for s in spans} == {"rendezvous", "checkpoint_save"}
+        assert all(s["dur"] >= 0 for s in spans)

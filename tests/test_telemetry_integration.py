@@ -1,0 +1,368 @@
+"""End-to-end telemetry: instrumented components feeding the per-rank
+exporter, exact log-drop accounting, and the utils satellite fixes
+(profiling sink, deferred %r expansion)."""
+
+import asyncio
+import json
+import logging
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.telemetry import get_registry
+from tpu_resiliency.telemetry.exporter import MetricsHTTPServer
+from tests.test_telemetry import assert_valid_openmetrics
+
+
+def _scrape(port):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        return resp.read().decode()
+
+
+class _MonitorServerThread:
+    """RankMonitorServer's asyncio loop on a daemon thread (test_rank_monitor
+    pattern)."""
+
+    def __init__(self, cfg, socket_path):
+        from tpu_resiliency.fault_tolerance.rank_monitor_server import (
+            RankMonitorServer,
+        )
+
+        self.server = RankMonitorServer(cfg, socket_path)
+        self._loop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10)
+
+    def _run(self):
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        try:
+            self._loop.run_until_complete(self.server.run_async(self._started))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def stop(self):
+        if self._loop:
+            self._loop.call_soon_threadsafe(
+                lambda: [t.cancel() for t in asyncio.all_tasks(self._loop)]
+            )
+        self._thread.join(timeout=3)
+
+
+def test_exporter_scrapes_all_series_during_restart_and_save(
+    store, tmp_path
+):
+    """Acceptance: curl the per-rank exporter during a simulated in-process
+    restart + async save; the exposition is valid OpenMetrics and carries
+    heartbeat-latency, rendezvous-duration, restart-phase, checkpoint-drain,
+    straggler, and log-drop series."""
+    from tpu_resiliency.checkpointing import AsyncCheckpointer
+    from tpu_resiliency.fault_tolerance.config import FaultToleranceConfig
+    from tpu_resiliency.fault_tolerance.data import RankInfo
+    from tpu_resiliency.fault_tolerance.rank_monitor_client import (
+        RankMonitorClient,
+    )
+    from tpu_resiliency.fault_tolerance.rendezvous import (
+        NodeDesc,
+        RendezvousHost,
+        RendezvousJoiner,
+    )
+    from tpu_resiliency.inprocess import Wrapper
+    from tpu_resiliency.straggler.detector import Detector
+    from tpu_resiliency.utils.log_funnel import LogForwarder
+
+    exporter = MetricsHTTPServer(get_registry(), host="127.0.0.1").start()
+    try:
+        # -- heartbeat latency: real client -> real monitor over UDS
+        cfg = FaultToleranceConfig(
+            workload_check_interval=0.1, skip_section_response=False
+        )
+        mon = _MonitorServerThread(cfg, str(tmp_path / "monitor.sock"))
+        client = RankMonitorClient(cfg)
+        client.init_workload_monitoring(
+            socket_path=str(tmp_path / "monitor.sock"),
+            rank_info=RankInfo(global_rank=0, local_rank=0, pid=os.getpid()),
+        )
+        for _ in range(5):
+            client.send_heartbeat()
+        client.shutdown_workload_monitoring()
+        mon.stop()
+
+        # -- rendezvous round duration: host + one joiner over the store
+        host = RendezvousHost(store, min_nodes=1, max_nodes=1, settle_time=0.05)
+        host.bootstrap()
+        host.open_round()
+        result = {}
+
+        def join():
+            joiner = RendezvousJoiner(
+                store.clone(), NodeDesc.create("n0", slots=1),
+                open_poll_interval=0.05,
+            )
+            result["r"] = joiner.join(timeout=20.0)
+
+        jt = threading.Thread(target=join)
+        jt.start()
+        host.close_round_when_ready(timeout=20.0)
+        jt.join(timeout=20)
+        assert result["r"].group_rank == 0
+
+        # -- simulated in-process restart: fault at iteration 0, recover
+        def train(call_wrapper=None):
+            if call_wrapper.iteration == 0:
+                raise ValueError("injected fault")
+            return "recovered"
+
+        wrapper = Wrapper(
+            store_factory=lambda: store.clone(),
+            group="telemetry-e2e",
+            soft_timeout=3600.0,
+            hard_timeout=7200.0,
+            enable_monitor_process=False,
+            enable_sibling_monitor=False,
+            last_call_wait=0.0,
+        )
+        assert wrapper(train)() == "recovered"
+
+        # -- async save with the drain-progress gauge polled mid-flight
+        ckpt = AsyncCheckpointer()
+        try:
+            tree = {"w": np.ones((1 << 20,), np.float32)}
+            ckpt.async_save(tree, str(tmp_path / "ckpt"), save_id="t")
+            while ckpt.num_pending_saves:
+                ckpt.drain_progress()
+                ckpt.maybe_finalize()
+                time.sleep(0.01)
+            ckpt.drain_progress()
+        finally:
+            ckpt.close()
+
+        # -- straggler verdicts (single-rank round)
+        det = Detector(rank=0, world_size=1, report_interval=1, always_on=False)
+        det.initialize()
+        with det.detection_section("data"):
+            time.sleep(0.001)
+        report = det.generate_report()
+        assert report.identify_stragglers() is not None
+
+        # -- log-drop series: overflow a forwarder aimed at a dead port
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()  # nothing listens here
+        fwd = LogForwarder(
+            "127.0.0.1", dead_port, source="t", batch_lines=10_000,
+            batch_age=30.0, max_buffer=2,
+        )
+        rec = logging.LogRecord("t", logging.INFO, __file__, 1, "m", (), None)
+        for _ in range(5):
+            fwd.emit(rec)
+        assert fwd.dropped_total == 3
+
+        # -- the scrape itself
+        body = _scrape(exporter.port)
+    finally:
+        exporter.close()
+
+    assert_valid_openmetrics(body)
+    for series in (
+        "tpurx_heartbeat_send_latency_ns_count",
+        "tpurx_heartbeat_received_total",
+        "tpurx_rendezvous_round_duration_ns_count",
+        "tpurx_rendezvous_join_latency_ns_count",
+        'tpurx_restart_phase_latency_ns_bucket{phase="finalize"',
+        "tpurx_restart_total_latency_ns_count",
+        "tpurx_inprocess_restarts_total",
+        "tpurx_ckpt_saves_total",
+        "tpurx_ckpt_stage_bytes_total",
+        "tpurx_ckpt_drain_progress",
+        'tpurx_straggler_verdicts_total{straggler="false"}',
+        "tpurx_log_forwarder_dropped_total",
+        "tpurx_store_ops_total",
+        "tpurx_monitor_trips_total",
+    ):
+        assert series in body, f"series missing from exposition: {series}"
+    # drop counter is cumulative across the process; this test added 3
+    reg = get_registry()
+    assert reg.value_of("tpurx_log_forwarder_dropped_total") >= 3
+
+
+# ---- satellite: exact LogForwarder drop accounting --------------------------
+
+
+def test_log_forwarder_exact_drop_accounting_end_to_end(tmp_path):
+    """Force buffer overflow and assert the SAME drop count at all three
+    observation points: the local ``dropped_total`` property, the registry
+    counter, and the root funnel's consolidated file."""
+    from tpu_resiliency.utils.log_funnel import LogForwarder, RootLogServer
+
+    reg = get_registry()
+    before = reg.value_of("tpurx_log_forwarder_dropped_total")
+    root = RootLogServer(str(tmp_path / "consolidated.log"), host="127.0.0.1",
+                         flush_age=0.05)
+    fwd = LogForwarder(
+        "127.0.0.1", root.port, source="rank7",
+        batch_lines=10_000,  # kick never fires: flushes only by age
+        batch_age=0.5,
+        max_buffer=10,
+    )
+    try:
+        rec = lambda i: logging.LogRecord(  # noqa: E731
+            "t", logging.INFO, __file__, 1, f"line-{i}", (), None
+        )
+        # 17 emits in <<0.5s: 10 buffered, exactly 7 dropped
+        for i in range(17):
+            fwd.emit(rec(i))
+        assert fwd.dropped_total == 7
+        assert reg.value_of("tpurx_log_forwarder_dropped_total") - before == 7
+        # the pending drop count rides the next batch to the root
+        deadline = time.monotonic() + 10
+        content = ""
+        while time.monotonic() < deadline:
+            fwd._kick.set()  # hasten the age-based flush
+            with root._lock:
+                root._file.flush()
+            with open(tmp_path / "consolidated.log") as f:
+                content = f.read()
+            if "dropped 7 lines" in content:
+                break
+            time.sleep(0.05)
+        assert "[logfunnel] rank7 dropped 7 lines" in content
+        assert "[rank7] line-0" in content and "[rank7] line-9" in content
+        assert "line-10" not in content  # the dropped ones never arrive
+        # cumulative property keeps counting across episodes
+        for i in range(3):
+            fwd.emit(rec(100 + i))
+        assert fwd.dropped_total == 7  # buffer drained: no new drops
+    finally:
+        fwd.close()
+        root.close()
+
+
+# ---- satellite: ProfilingRecorder sink + bounded history --------------------
+
+
+class TestProfilingRecorder:
+    def test_bounded_history_and_full_file(self, tmp_path):
+        from tpu_resiliency.utils.profiling import (
+            ProfilingEvent,
+            ProfilingRecorder,
+        )
+
+        path = tmp_path / "prof.jsonl"
+        rec = ProfilingRecorder(path=str(path), history=10)
+        for i in range(50):
+            rec.record(ProfilingEvent.FAILURE_DETECTED, i=i)
+        assert len(rec.events) == 10  # bounded in memory
+        assert rec.events[0]["i"] == 40  # oldest evicted
+        rec.close()
+        with open(path) as f:
+            lines = [json.loads(l) for l in f]
+        assert len(lines) == 50  # file keeps the full stream
+        assert [l["i"] for l in lines] == list(range(50))
+
+    def test_persistent_fd_not_reopened_per_event(self, tmp_path):
+        """Regression: the old implementation re-opened the sink per event —
+        deleting the file mid-stream would silently recreate it.  With a
+        held fd, writes keep flowing to the (unlinked) inode and no new
+        file appears at the path."""
+        from tpu_resiliency.utils.profiling import (
+            ProfilingEvent,
+            ProfilingRecorder,
+        )
+
+        path = tmp_path / "prof.jsonl"
+        rec = ProfilingRecorder(path=str(path), history=100)
+        rec.record(ProfilingEvent.FAILURE_DETECTED)
+        assert path.exists()
+        os.unlink(path)
+        for _ in range(5):
+            rec.record(ProfilingEvent.FAILURE_DETECTED)
+        assert not path.exists(), "sink was re-opened per event"
+        rec.close()
+
+    def test_env_history_cap(self, tmp_path, monkeypatch):
+        from tpu_resiliency.utils.profiling import (
+            ProfilingEvent,
+            ProfilingRecorder,
+        )
+
+        monkeypatch.setenv("TPURX_PROFILING_HISTORY", "3")
+        rec = ProfilingRecorder()
+        for i in range(9):
+            rec.record(ProfilingEvent.FAILURE_DETECTED, i=i)
+        assert [e["i"] for e in rec.events] == [6, 7, 8]
+
+    def test_latency_ns_still_works_on_deque(self):
+        from tpu_resiliency.utils.profiling import (
+            ProfilingEvent,
+            ProfilingRecorder,
+        )
+
+        rec = ProfilingRecorder(history=100)
+        rec.record(ProfilingEvent.RENDEZVOUS_STARTED)
+        rec.record(ProfilingEvent.RENDEZVOUS_COMPLETED)
+        assert rec.latency_ns(
+            ProfilingEvent.RENDEZVOUS_STARTED, ProfilingEvent.RENDEZVOUS_COMPLETED
+        ) >= 0
+
+
+# ---- satellite: deferred %r expansion in the file log sink ------------------
+
+
+class TestLogFileRankExpansion:
+    @pytest.fixture(autouse=True)
+    def _restore_logger(self):
+        yield
+        # drop the test's file handler so later tests log to stderr only
+        from tpu_resiliency.utils.logging import LogConfig, setup_logger
+
+        setup_logger(LogConfig(), force=True)
+
+    def test_rank_set_before_setup(self, tmp_path, monkeypatch):
+        from tpu_resiliency.utils.logging import LogConfig, setup_logger
+
+        monkeypatch.setenv("TPURX_RANK", "5")
+        logger = setup_logger(
+            LogConfig(to_file=str(tmp_path / "log_%r.txt")), force=True
+        )
+        logger.warning("hello")
+        assert (tmp_path / "log_5.txt").exists()
+
+    def test_rank_set_after_setup_before_first_record(self, tmp_path, monkeypatch):
+        """The launcher order: import (setup) happens first, TPURX_RANK is
+        exported later.  The old eager expansion baked in '?'."""
+        from tpu_resiliency.utils.logging import LogConfig, setup_logger
+
+        monkeypatch.delenv("TPURX_RANK", raising=False)
+        monkeypatch.delenv("TPURX_GROUP_RANK", raising=False)
+        monkeypatch.delenv("TPURX_INFRA_RANK", raising=False)
+        logger = setup_logger(
+            LogConfig(to_file=str(tmp_path / "log_%r.txt")), force=True
+        )
+        monkeypatch.setenv("TPURX_RANK", "7")  # after setup, before 1st record
+        logger.warning("hello")
+        assert (tmp_path / "log_7.txt").exists()
+        assert not (tmp_path / "log_?.txt").exists()
+
+    def test_rank_change_reopens_at_new_path(self, tmp_path, monkeypatch):
+        from tpu_resiliency.utils.logging import LogConfig, setup_logger
+
+        monkeypatch.setenv("TPURX_RANK", "1")
+        logger = setup_logger(
+            LogConfig(to_file=str(tmp_path / "log_%r.txt")), force=True
+        )
+        logger.warning("first")
+        monkeypatch.setenv("TPURX_RANK", "2")  # re-rank across a restart cycle
+        logger.warning("second")
+        assert "first" in (tmp_path / "log_1.txt").read_text()
+        assert "second" in (tmp_path / "log_2.txt").read_text()

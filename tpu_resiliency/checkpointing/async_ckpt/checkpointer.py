@@ -42,10 +42,12 @@ import json
 import os
 import queue as queue_mod
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...telemetry import counter, gauge, histogram
 from ...utils.logging import get_logger
 from .core import (  # noqa: F401 - CheckpointSaveError re-exported for callers
     AsyncCallsQueue,
@@ -64,6 +66,26 @@ from .writer import (
 )
 
 log = get_logger("checkpointer")
+
+_SAVES = counter("tpurx_ckpt_saves_total", "async_save requests issued")
+_SAVES_FINALIZED = counter(
+    "tpurx_ckpt_saves_finalized_total", "Saves fully committed (finalize ran)"
+)
+_SAVE_CALL_NS = histogram(
+    "tpurx_ckpt_save_call_ns",
+    "Trainer-visible async_save stall (snapshot + handoff; full staging in "
+    "sync mode)",
+)
+_STAGE_BYTES = counter(
+    "tpurx_ckpt_stage_bytes_total", "Bytes staged into shared memory"
+)
+_STAGE_OVERLAP = gauge(
+    "tpurx_ckpt_stage_overlap_pct", "Last staging's D2H/shm-copy overlap (%)"
+)
+_DRAIN_PROGRESS = gauge(
+    "tpurx_ckpt_drain_progress",
+    "Fraction (0-1) of in-flight save bytes the worker has written",
+)
 
 
 _SNAP_FN = None
@@ -173,6 +195,7 @@ class AsyncCheckpointer:
         iteration); finalize only merges process indices carrying the same
         id, so stale index files from a previous run into the same directory
         (possibly with a different world size) are never committed."""
+        call_t0 = time.monotonic_ns()
         mode = stage_mode or self.stage_mode or self._resolve_stage_mode(tree)
         os.makedirs(ckpt_dir, exist_ok=True)
         if save_id is None:
@@ -211,6 +234,8 @@ class AsyncCheckpointer:
         else:
             self._ensure_stager()
             self._stage_q.put(job)
+        _SAVES.inc()
+        _SAVE_CALL_NS.observe(time.monotonic_ns() - call_t0)
         return self._save_seq
 
     def save(self, tree: Any, ckpt_dir: str, extra_metadata: Optional[Dict] = None) -> None:
@@ -300,6 +325,8 @@ class AsyncCheckpointer:
                 "stage_copy_s": staged.stage_copy_s,
                 "stage_overlap_pct": staged.stage_overlap_pct,
             }
+            _STAGE_BYTES.inc(staged.bytes_allocated + staged.bytes_reused)
+            _STAGE_OVERLAP.set(staged.stage_overlap_pct)
             with job.lock:
                 if job.cleaned:
                     # cleanup (abort) already ran: nobody else will release
@@ -344,7 +371,10 @@ class AsyncCheckpointer:
     # -- finalize ---------------------------------------------------------
 
     def maybe_finalize(self, blocking: bool = False) -> List[int]:
-        return self.queue.maybe_finalize_async_calls(blocking=blocking)
+        done = self.queue.maybe_finalize_async_calls(blocking=blocking)
+        if done:
+            _SAVES_FINALIZED.inc(len(done))
+        return done
 
     @property
     def num_pending_saves(self) -> int:
@@ -356,8 +386,13 @@ class AsyncCheckpointer:
 
     def drain_progress(self) -> Tuple[int, int]:
         """(bytes_written, bytes_total) across in-flight saves, as reported
-        by the worker through the drain-progress pipe frames."""
-        return self.queue.drain_progress()
+        by the worker through the drain-progress pipe frames.  Monotonic per
+        save; ``(0, 0)`` is the terminal value once finalize empties the
+        in-flight set."""
+        written, total = self.queue.drain_progress()
+        if total > 0:
+            _DRAIN_PROGRESS.set(written / total)
+        return written, total
 
     def finalize_all(self, timeout: float = 600.0) -> None:
         self.queue.maybe_finalize_async_calls(blocking=True, timeout=timeout)

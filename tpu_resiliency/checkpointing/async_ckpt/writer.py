@@ -53,12 +53,37 @@ import os
 import threading
 import time
 
+from ...telemetry import BYTE_BUCKETS, counter, gauge, histogram
 from ...utils.shm import attach_shm
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 _ALIGN = 4096  # O_DIRECT offset/length/address granularity (conservative)
+
+# These live in whichever process runs the engine — the async worker for
+# background drains, the trainer for in-process writes; each exposes its own
+# endpoint, so the series never mix.
+_WRITE_BYTES = counter(
+    "tpurx_ckpt_write_bytes_total", "Checkpoint bytes written to disk"
+)
+_WRITE_CHUNKS = counter(
+    "tpurx_ckpt_write_chunks_total", "Chunk writes issued by the drain engine"
+)
+_SHARD_BYTES = histogram(
+    "tpurx_ckpt_shard_bytes", "Shard size distribution", buckets=BYTE_BUCKETS
+)
+_DRAIN_NS = histogram(
+    "tpurx_ckpt_drain_duration_ns", "Engine lifetime: first payload to index commit"
+)
+_DRAIN_BPS = gauge(
+    "tpurx_ckpt_drain_throughput_bps", "Last completed drain's write throughput"
+)
+_DRAIN_STALL_NS = histogram(
+    "tpurx_ckpt_drain_stall_ns",
+    "Time the drain pool spent with work pending but no chunk in flight "
+    "(producer-bound staging)",
+)
 
 
 def default_chunk_bytes() -> int:
@@ -212,6 +237,7 @@ class _WriteEngine:
         os.makedirs(self.pdir, exist_ok=True)
         self._progress_cb = progress_cb
         self._progress_last = 0.0
+        self._t0_ns = time.monotonic_ns()
         self.total_bytes: Optional[int] = None  # announced plan total, if any
         self.bytes_written = 0
         self.payloads_done: List[Dict[str, Any]] = []
@@ -241,6 +267,7 @@ class _WriteEngine:
         if not payload.get("shm_name"):
             return  # non-owned: metadata-only entry, nothing to write
         sink = _ShardSink(self.pdir, payload, self.use_direct)
+        _SHARD_BYTES.observe(sink.nbytes)
         # Chunks never straddle the direct/buffered boundary: the region
         # below ``aligned_end`` splits into block-aligned chunks for the
         # O_DIRECT fd, the unaligned tail is one buffered chunk.
@@ -298,6 +325,10 @@ class _WriteEngine:
             os.fsync(f.fileno())
         os.replace(tmp, idx_path)
         _fsync_dir(self.ckpt_dir)
+        elapsed_ns = time.monotonic_ns() - self._t0_ns
+        _DRAIN_NS.observe(elapsed_ns)
+        if self.bytes_written and elapsed_ns:
+            _DRAIN_BPS.set(self.bytes_written / (elapsed_ns / 1e9))
         self._report_progress(force=True)
 
     def abort(self, exc: Optional[BaseException] = None) -> None:
@@ -319,7 +350,10 @@ class _WriteEngine:
 
     def _take(self):
         """Largest non-empty bucket first: idle threads steal whatever chunk
-        class still has work, so a late huge shard fans out immediately."""
+        class still has work, so a late huge shard fans out immediately.
+        Time spent parked before more work arrives is the drain's
+        producer-bound stall (staging slower than the pool can write)."""
+        waited_t0 = None
         with self._cv:
             while True:
                 if self._error is not None:
@@ -327,9 +361,15 @@ class _WriteEngine:
                 for b in sorted(self._buckets, reverse=True):
                     dq = self._buckets[b]
                     if dq:
+                        if waited_t0 is not None:
+                            _DRAIN_STALL_NS.observe(
+                                time.monotonic_ns() - waited_t0
+                            )
                         return dq.popleft()
                 if self._closed and self._pending_chunks <= 0:
                     return None
+                if waited_t0 is None:
+                    waited_t0 = time.monotonic_ns()
                 self._cv.wait()
 
     def _worker(self) -> None:
@@ -340,6 +380,8 @@ class _WriteEngine:
             sink, off, length = task
             try:
                 sink.write_chunk(off, length)
+                _WRITE_BYTES.inc(length)
+                _WRITE_CHUNKS.inc()
                 with sink.lock:
                     sink.chunks_left -= 1
                     last = sink.chunks_left == 0

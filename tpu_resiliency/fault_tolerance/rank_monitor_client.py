@@ -18,8 +18,10 @@ import contextlib
 import os
 import socket
 import threading
+import time
 from typing import Any, Dict, Optional
 
+from ..telemetry import counter, histogram
 from ..utils.ipc import recv_msg, send_msg
 from ..utils.logging import get_logger
 from .config import FaultToleranceConfig
@@ -38,6 +40,19 @@ from .data import (
 from .timeouts import TimeoutsCalc
 
 log = get_logger("rank_monitor_client")
+
+_HB_SENT = counter(
+    "tpurx_heartbeat_sent_total", "Heartbeats sent to the rank monitor"
+)
+_HB_SEND_NS = histogram(
+    "tpurx_heartbeat_send_latency_ns",
+    "Heartbeat send latency over the monitor UDS (ack wait included when "
+    "skip_section_response is off)",
+)
+_SECTION_NS = histogram(
+    "tpurx_monitor_section_msg_latency_ns",
+    "Section start/end message latency over the monitor UDS",
+)
 
 ENV_MONITOR_SOCKET = "TPURX_RANK_MONITOR_SOCKET"
 ENV_LAUNCHER_IPC_SOCKET = "TPURX_LAUNCHER_IPC_SOCKET"
@@ -102,6 +117,9 @@ class RankMonitorClient:
             "workload monitoring initialized (rank=%s cycle=%s)",
             self.rank_info.global_rank, self.cycle,
         )
+        from ..telemetry.exporter import serve_from_env_once
+
+        serve_from_env_once()  # per-rank scrape endpoint, when env asks
 
     def shutdown_workload_monitoring(self) -> None:
         with self._lock:
@@ -141,19 +159,26 @@ class RankMonitorClient:
 
     def send_heartbeat(self) -> None:
         ack = not self.cfg.skip_section_response
+        t0 = time.monotonic_ns()
         self._send({"type": MsgType.HEARTBEAT.value}, want_ack=ack)
+        _HB_SEND_NS.observe(time.monotonic_ns() - t0)
+        _HB_SENT.inc()
         if self.timeouts_calc is not None:
             self.timeouts_calc.update_on_heartbeat()
 
     def start_section(self, name: str) -> None:
         ack = not self.cfg.skip_section_response
+        t0 = time.monotonic_ns()
         self._send({"type": MsgType.SECTION_START.value, "name": name}, want_ack=ack)
+        _SECTION_NS.observe(time.monotonic_ns() - t0)
         if self.timeouts_calc is not None:
             self.timeouts_calc.update_on_section_start(name)
 
     def end_section(self, name: str) -> None:
         ack = not self.cfg.skip_section_response
+        t0 = time.monotonic_ns()
         self._send({"type": MsgType.SECTION_END.value, "name": name}, want_ack=ack)
+        _SECTION_NS.observe(time.monotonic_ns() - t0)
         if self.timeouts_calc is not None:
             self.timeouts_calc.update_on_section_end(name)
 

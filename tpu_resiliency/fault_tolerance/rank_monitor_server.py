@@ -29,6 +29,7 @@ import signal
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..telemetry import counter, histogram
 from ..utils.ipc import _U32
 from ..utils.logging import get_logger, setup_logger
 from ..utils.profiling import ProfilingEvent, record_event
@@ -46,6 +47,19 @@ from .data import (
 import json
 
 log = get_logger("rank_monitor")
+
+_HB_RECEIVED = counter(
+    "tpurx_heartbeat_received_total", "Heartbeats received by the rank monitor"
+)
+_HB_GAP_NS = histogram(
+    "tpurx_heartbeat_gap_ns",
+    "Observed gap between consecutive heartbeats of the monitored rank",
+)
+_HANGS = counter(
+    "tpurx_hang_detected_total",
+    "Hangs the rank monitor terminated a worker for",
+    labels=("kind",),
+)
 
 
 @dataclasses.dataclass
@@ -123,6 +137,7 @@ class RankMonitorServer:
 
     def _shutdown_rank(self, reason: str) -> None:
         pid = self.state.pid
+        _HANGS.labels("section" if "section" in reason else "heartbeat").inc()
         log.error(
             "hang detected (cycle=%s rank=%s pid=%s): %s — terminating rank",
             self.cycle, self.state.rank, pid, reason,
@@ -308,7 +323,10 @@ class RankMonitorServer:
                     "error": "stale connection: another worker owns this monitor",
                 }
             if mtype == MsgType.HEARTBEAT:
+                if st.last_hb is not None:
+                    _HB_GAP_NS.observe((now - st.last_hb) * 1e9)
                 st.last_hb = now
+                _HB_RECEIVED.inc()
             elif mtype == MsgType.SECTION_START:
                 st.seen_section_msgs = True
                 st.open_sections[msg["name"]] = now

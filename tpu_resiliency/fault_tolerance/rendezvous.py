@@ -32,10 +32,27 @@ import time
 from typing import Dict, List, Optional
 
 from ..store.client import StoreTimeout
+from ..telemetry import counter, gauge, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 
 log = get_logger("rendezvous")
+
+_ROUNDS = counter("tpurx_rendezvous_rounds_total", "Rendezvous rounds opened")
+_ROUND_NS = histogram(
+    "tpurx_rendezvous_round_duration_ns",
+    "Host-side round duration, open to published result",
+)
+_JOIN_NS = histogram(
+    "tpurx_rendezvous_join_latency_ns",
+    "Joiner-side latency from join entry to an assignment",
+)
+_PARTICIPANTS = gauge(
+    "tpurx_rendezvous_participants", "Participant nodes in the last closed round"
+)
+_STANDBY = gauge(
+    "tpurx_rendezvous_standby_nodes", "Standby (hot-spare) nodes in the last round"
+)
 
 # Store key schema (all round-fenced)
 K_ACTIVE_ROUND = "rdzv/active_round"
@@ -266,6 +283,8 @@ class RendezvousHost:
         self.settle_time = settle_time
         self.close_poll_interval = close_poll_interval
         self.require_equal_slots = require_equal_slots
+        # round -> monotonic-ns open stamp (for the round-duration metric)
+        self._opened_ns: Dict[int, int] = {}
 
     def bootstrap(self) -> None:
         """Initialize round/cycle counters if this is a fresh store."""
@@ -288,6 +307,13 @@ class RendezvousHost:
             cycle = self.store.add(K_CYCLE, 1) - 1
             self._gc_old_rounds(target)
             log.info("rendezvous round %s open (cycle %s)", target, cycle)
+            _ROUNDS.inc()
+            # stamps of rounds that never reached close must not accumulate
+            # across a long crash loop
+            self._opened_ns = {
+                r: ns for r, ns in self._opened_ns.items() if r >= target - 2
+            }
+            self._opened_ns[target] = time.monotonic_ns()
             record_event(ProfilingEvent.RENDEZVOUS_STARTED, round=target, cycle=cycle)
             return target
         return n
@@ -415,11 +441,19 @@ class RendezvousHost:
         }
         self.store.set(k_result(n), json.dumps(result))
         self.store.set(k_done(n), b"1")
+        standby = sum(
+            1 for a in assignment.values() if a["role"] == NodeRole.STANDBY.value
+        )
+        _PARTICIPANTS.set(len(participants))
+        _STANDBY.set(standby)
+        opened = self._opened_ns.pop(n, None)
+        if opened is not None:
+            _ROUND_NS.observe(time.monotonic_ns() - opened)
         log.info(
             "round %s closed: %s participants, %s standby",
             n,
             len(participants),
-            sum(1 for a in assignment.values() if a["role"] == NodeRole.STANDBY.value),
+            standby,
         )
         record_event(
             ProfilingEvent.RENDEZVOUS_COMPLETED, round=n, participants=len(participants)
@@ -489,6 +523,7 @@ class RendezvousJoiner:
         """Full join: wait for open round → health check → register → await
         assignment.  Raises UnhealthyNodeError if the local check fails."""
         deadline = time.monotonic() + timeout
+        join_t0 = time.monotonic_ns()
         while True:
             n = self.wait_round_open(timeout=deadline - time.monotonic())
             if self.pre_join_health_check is not None:
@@ -520,6 +555,7 @@ class RendezvousJoiner:
             participants = result["participants"]
             slots = result["slots"]
             global_world = sum(slots[p] for p in participants)
+            _JOIN_NS.observe(time.monotonic_ns() - join_t0)
             if role == NodeRole.PARTICIPANT:
                 grank = mine["group_rank"]
                 self.desc.prev_group_rank = grank

@@ -7,10 +7,20 @@ import dataclasses
 import time
 from typing import List
 
+from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 
 log = get_logger("health")
+
+_CHECKS = counter(
+    "tpurx_health_checks_total",
+    "Health check runs by outcome",
+    labels=("check", "result"),
+)
+_CHECK_NS = histogram(
+    "tpurx_health_check_duration_ns", "Health check runtime", labels=("check",)
+)
 
 
 @dataclasses.dataclass
@@ -45,6 +55,8 @@ class HealthCheck(abc.ABC):
             # its result — "which check failed" is the useful signal
             result.name = self.name
         result.duration_s = time.monotonic() - t0
+        _CHECKS.labels(self.name, "pass" if result.healthy else "fail").inc()
+        _CHECK_NS.labels(self.name).observe(result.duration_s * 1e9)
         record_event(
             ProfilingEvent.HEALTH_CHECK_COMPLETED,
             check=self.name,

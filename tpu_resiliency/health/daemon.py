@@ -24,9 +24,20 @@ import os
 import socket
 from typing import Optional
 
+from ..telemetry import counter
 from .base import HealthCheck, HealthCheckResult
 
 ENDPOINT_ENV = "TPURX_NODE_HEALTH_ENDPOINT"
+
+_DAEMON_UNREACHABLE = counter(
+    "tpurx_health_daemon_unreachable_total",
+    "Node-health daemon connection/reply failures (degraded observability "
+    "even when the check itself passes as optional)",
+)
+_DAEMON_UNHEALTHY = counter(
+    "tpurx_health_daemon_unhealthy_total",
+    "Times the node-health daemon reported this node unhealthy",
+)
 
 
 class NodeHealthDaemonCheck(HealthCheck):
@@ -75,6 +86,7 @@ class NodeHealthDaemonCheck(HealthCheck):
             # unreachable daemon: the reference treats this as a failed check
             # only when required; otherwise degraded observability, not a
             # node failure
+            _DAEMON_UNREACHABLE.inc()
             msg = f"health daemon {target} unreachable: {exc}"
             return HealthCheckResult(not self.required, msg)
         try:
@@ -87,6 +99,7 @@ class NodeHealthDaemonCheck(HealthCheck):
                 buf += chunk
             reply = json.loads(buf.split(b"\n", 1)[0].decode())
         except (OSError, ValueError) as exc:
+            _DAEMON_UNREACHABLE.inc()
             return HealthCheckResult(
                 not self.required, f"health daemon {target} bad reply: {exc}"
             )
@@ -94,6 +107,7 @@ class NodeHealthDaemonCheck(HealthCheck):
             sock.close()
         if reply.get("healthy", False):
             return HealthCheckResult(True, f"daemon: healthy ({target})")
+        _DAEMON_UNHEALTHY.inc()
         return HealthCheckResult(
             False, f"daemon reports unhealthy: {reply.get('reason', 'unspecified')}"
         )

@@ -20,11 +20,21 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from .exceptions import RankShouldRestart
 from .store_ops import InprocStore
 
 log = get_logger("monitor_thread")
+
+_TRIPS = counter(
+    "tpurx_monitor_trips_total",
+    "Monitor-thread trips (any-rank interruption observed)",
+)
+_TRIP_TO_CAUGHT_NS = histogram(
+    "tpurx_monitor_trip_to_caught_ns",
+    "Interruption observed to RankShouldRestart acknowledged by the wrapper",
+)
 
 
 def cancel_async_raise(tid: int) -> None:
@@ -81,6 +91,7 @@ class MonitorThread:
         # already-scheduled raise sits undelivered in the thread's single
         # async-exc slot — quiesce_raises() cancels that one)
         self._raise_lock = threading.Lock()
+        self._trip_ns: Optional[int] = None
         self.tripped = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"tpurx-inproc-monitor-thread-{iteration}", daemon=True
@@ -104,6 +115,8 @@ class MonitorThread:
             self.iteration,
             [(r.rank, r.interruption.value) for r in records],
         )
+        _TRIPS.inc()
+        self._trip_ns = time.monotonic_ns()
         self.tripped.set()
         if self.on_trip:
             try:
@@ -136,6 +149,9 @@ class MonitorThread:
         check-and-raise; on return no further raise will be scheduled."""
         with self._raise_lock:
             self._caught.set()
+            trip_ns, self._trip_ns = self._trip_ns, None
+        if trip_ns is not None:
+            _TRIP_TO_CAUGHT_NS.observe(time.monotonic_ns() - trip_ns)
 
     def quiesce_raises(self) -> None:
         """Deterministically absorb any async raise still in flight.

@@ -25,6 +25,7 @@ from typing import Any, Callable, Optional
 
 from ..store.barrier import BarrierTimeout
 from ..store.client import StoreClient, store_from_env
+from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 from .attribution import Interruption, InterruptionRecord
@@ -38,6 +39,31 @@ from .state import Mode, State
 from .store_ops import InprocStore
 
 log = get_logger("inproc.wrap")
+
+_RESTARTS = counter(
+    "tpurx_inprocess_restarts_total", "In-process restart cycles entered"
+)
+_INTERRUPTIONS = counter(
+    "tpurx_inprocess_interruptions_total",
+    "Faults observed by the wrapper",
+    labels=("kind",),
+)
+_PHASE_NS = histogram(
+    "tpurx_restart_phase_latency_ns",
+    "Duration of each restart-pipeline phase",
+    labels=("phase",),
+)
+_RESTART_NS = histogram(
+    "tpurx_restart_total_latency_ns",
+    "Fault observed to wrapped fn re-entered, end to end",
+)
+
+
+def _observe_phase(phase: str, t0_ns: int) -> int:
+    """Record one restart phase; returns a fresh stamp for the next one."""
+    now = time.monotonic_ns()
+    _PHASE_NS.labels(phase).observe(now - t0_ns)
+    return now
 
 
 class Wrapper:
@@ -142,6 +168,8 @@ class CallWrapper:
         self.monitor_process: Optional[MonitorProcess] = None
         self.quorum = None  # QuorumTripwire when wrapper.quorum_mesh is set
         self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
+        # stamp of the last fault, cleared when the restarted fn re-enters
+        self._restart_started_ns: Optional[int] = None
 
     # -- public API for the wrapped fn ------------------------------------
 
@@ -197,6 +225,9 @@ class CallWrapper:
     # -- lifecycle ---------------------------------------------------------
 
     def __enter__(self) -> "CallWrapper":
+        from ..telemetry.exporter import serve_from_env_once
+
+        serve_from_env_once()  # per-rank scrape endpoint, when env asks
         self._store = self.w.store_factory()
         self.ops = InprocStore(self._store, self.w.group)
         # the monitor process is exec'd (never forked — the parent is
@@ -322,6 +353,11 @@ class CallWrapper:
                         w.initialize(state.freeze())
                     state.set_distributed_vars()
                     self.watchdog.ping()
+                    if self._restart_started_ns is not None:
+                        _RESTART_NS.observe(
+                            time.monotonic_ns() - self._restart_started_ns
+                        )
+                        self._restart_started_ns = None
                     record_event(
                         ProfilingEvent.INPROCESS_RESTART_COMPLETED
                         if iteration
@@ -381,6 +417,11 @@ class CallWrapper:
                 return ret
 
             # ---- restart path ---- (async-exc slot empty from here on)
+            phase_t0 = self._restart_started_ns = time.monotonic_ns()
+            _RESTARTS.inc()
+            _INTERRUPTIONS.labels(
+                "exception" if fault_exc is not None else "peer_signal"
+            ).inc()
             if fault_exc is not None:
                 state.fn_exception = fault_exc
                 log.warning(
@@ -416,6 +457,7 @@ class CallWrapper:
             monitor.stop()
             if sibling:
                 sibling.stop()
+            phase_t0 = _observe_phase("abort_wait", phase_t0)
             if self.ops.any_completed(iteration):
                 # a peer finished fn in the same iteration our restart
                 # signal fired: the job is DONE — restarting (or joining the
@@ -428,9 +470,11 @@ class CallWrapper:
                 return None
             if w.finalize:
                 w.finalize(state.freeze())
+            phase_t0 = _observe_phase("finalize", phase_t0)
             try:
                 if w.health_check:
                     w.health_check(state.freeze())
+                phase_t0 = _observe_phase("health_check", phase_t0)
             except HealthCheckError as exc:
                 log.error("rank %s failed restart health check: %s", state.initial_rank, exc)
                 self.ops.mark_terminated(state.initial_rank)
@@ -451,9 +495,11 @@ class CallWrapper:
                     " %s barrier; exiting", state.initial_rank, iteration,
                 )
                 return None
+            phase_t0 = _observe_phase("iteration_barrier", phase_t0)
             state.rank = state.initial_rank
             state.world_size = state.initial_world_size
             self._assign()
+            _observe_phase("reassign", phase_t0)
             state.advance()
             self.watchdog.ping()
             gc.collect()

@@ -52,9 +52,22 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ..telemetry import counter, gauge
 from ..utils.logging import get_logger, setup_logger
 
 log = get_logger("smonsvc")
+
+_POLLS = counter("tpurx_smonsvc_polls_total", "Discovery/scan poll iterations")
+_POLL_ERRORS = counter("tpurx_smonsvc_poll_errors_total", "Polls that raised")
+_CYCLES = counter(
+    "tpurx_smonsvc_cycles_observed_total",
+    "Job cycles observed ending",
+    labels=("outcome",),
+)
+_JOBS_TRACKED = gauge("tpurx_smonsvc_jobs_tracked", "Jobs currently tracked")
+_CRASH_LOOPING = gauge(
+    "tpurx_smonsvc_crash_looping", "1 when the 15-minute restart rate is critical"
+)
 
 
 class JobState(enum.Enum):
@@ -469,6 +482,10 @@ class JobMonitor:
         }
         self.verdicts: Dict[str, int] = {}
         self.lock = threading.Lock()
+        # optional provider of job-level aggregated series (OpenMetrics
+        # sample lines, e.g. telemetry.aggregate.render_job_metrics over
+        # gathered rank snapshots); spliced into /metrics when set
+        self.aggregated_text_fn = None
 
     # -- polling -----------------------------------------------------------
 
@@ -498,6 +515,10 @@ class JobMonitor:
             if cdir:
                 self._scan_job(job_id, cdir, ldir)
         self.last_poll_at = time.time()
+        _POLLS.inc()
+        with self.lock:
+            _JOBS_TRACKED.set(len(self.jobs))
+            _CRASH_LOOPING.set(1.0 if self.windows.snapshot().get("crash_looping") else 0.0)
         self.polls += 1
 
     def _scan_job(self, job_id: str, cdir: str, ldir: Optional[str]) -> None:
@@ -549,6 +570,7 @@ class JobMonitor:
                 rec.cycles_failed += 1
                 self.totals["cycles_failed"] += 1
                 self.windows.record(info.get("ended_at") or time.time())
+        _CYCLES.labels("success" if reason == "success" else "failure").inc()
         log.info(
             "[%s] cycle %s ended: %s (failed ranks %s)",
             rec.job_id, info.get("cycle"), reason, info.get("failed_ranks"),
@@ -632,6 +654,7 @@ class JobMonitor:
                 self.poll_once()
             except Exception:  # noqa: BLE001
                 self.poll_errors += 1
+                _POLL_ERRORS.inc()
                 log.exception("poll failed")
             self._stop.wait(self.poll_interval)
 
@@ -659,6 +682,31 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
                 return self._send(200, monitor.status())
             if self.path == "/jobs":
                 return self._send(200, monitor.jobs_payload())
+            if self.path == "/metrics":
+                # smonsvc's own registry, plus job-level aggregates when a
+                # rank-snapshot provider was wired (see aggregated_text_fn)
+                from ..telemetry.exporter import CONTENT_TYPE, render_openmetrics
+
+                text = render_openmetrics()
+                extra_fn = getattr(monitor, "aggregated_text_fn", None)
+                if extra_fn is not None:
+                    try:
+                        extra = extra_fn()
+                    except Exception:  # noqa: BLE001 - aggregates best-effort
+                        extra = ""
+                    if extra:
+                        text = (
+                            text[: -len("# EOF\n")]
+                            + extra.rstrip("\n")
+                            + "\n# EOF\n"
+                        )
+                body = text.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             if self.path == "/health":
                 ok = monitor.healthy()
                 return self._send(

@@ -19,11 +19,30 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
+from ..telemetry import counter, histogram
 from .protocol import Op, Status, itob
 
 _U32 = struct.Struct("<I")
 
 _DEFAULT_TIMEOUT = 300.0
+
+_OPS_TOTAL = counter(
+    "tpurx_store_ops_total", "KV store client round trips", labels=("op",)
+)
+_OP_LATENCY = histogram(
+    "tpurx_store_op_latency_ns",
+    "KV store round-trip latency (per sliced request for blocking ops)",
+    labels=("op",),
+)
+# per-op metric children resolved once — the hot path does one dict lookup
+_OP_METRICS: dict = {}
+
+
+def _op_metrics(op: Op):
+    m = _OP_METRICS.get(op)
+    if m is None:
+        m = _OP_METRICS[op] = (_OPS_TOTAL.labels(op.name), _OP_LATENCY.labels(op.name))
+    return m
 
 # Ops safe to resend after a connection drop: resending cannot change the
 # final store state.  ADD/APPEND/COMPARE_SET are NOT here — the server may
@@ -135,6 +154,17 @@ class StoreClient:
         return buf
 
     def _roundtrip(
+        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
+    ) -> tuple[Status, List[bytes]]:
+        ops_total, op_latency = _op_metrics(op)
+        t0 = time.monotonic_ns()
+        try:
+            return self._roundtrip_inner(op, args, io_timeout)
+        finally:
+            op_latency.observe(time.monotonic_ns() - t0)
+            ops_total.inc()
+
+    def _roundtrip_inner(
         self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
     ) -> tuple[Status, List[bytes]]:
         with self._lock:

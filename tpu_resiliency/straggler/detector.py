@@ -27,6 +27,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..store.barrier import barrier
+from ..telemetry import counter, gauge
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 from .interval_tracker import ReportIntervalTracker
@@ -35,6 +36,14 @@ from .timers import DeviceTimer, DurationStore
 from .name_mapper import NameMapper
 
 log = get_logger("straggler")
+
+_REPORT_ROUNDS = counter(
+    "tpurx_straggler_report_rounds_total", "Straggler reporting rounds completed"
+)
+_INDIVIDUAL_SCORE = gauge(
+    "tpurx_straggler_individual_score",
+    "This rank's current-vs-own-best score (1.0 = at historical best)",
+)
 
 
 class Detector:
@@ -167,6 +176,7 @@ class Detector:
                     self._best_medians[name] = st.median
 
         if self.store is None or self.world_size == 1:
+            _REPORT_ROUNDS.inc()
             return Report(
                 round_idx,
                 {self.rank: section_stats},
@@ -210,6 +220,7 @@ class Detector:
                 self.store.delete(k)
             for k in self.store.list_keys(f"barrier/straggler/round/{round_idx}/"):
                 self.store.delete(k)
+        _REPORT_ROUNDS.inc()
         return report
 
     def individual_score(self) -> Optional[float]:
@@ -218,7 +229,10 @@ class Detector:
         if self.collector is not None:
             device = {**device, **self.collector.stats()}
         stats = device or self.sections.stats()
-        return Report.individual_scores(stats, self._best_medians)
+        score = Report.individual_scores(stats, self._best_medians)
+        if score is not None:
+            _INDIVIDUAL_SCORE.set(score)
+        return score
 
     def reset(self) -> None:
         self.sections.reset()

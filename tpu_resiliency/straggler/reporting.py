@@ -18,7 +18,17 @@ import dataclasses
 import json
 from typing import Dict, List, Optional
 
+from ..telemetry import counter, gauge
 from .timers import SectionStats
+
+_VERDICTS = counter(
+    "tpurx_straggler_verdicts_total",
+    "Per-rank verdicts produced by identify_stragglers",
+    labels=("straggler",),
+)
+_FLAGGED = gauge(
+    "tpurx_straggler_flagged_ranks", "Ranks flagged straggler in the last round"
+)
 
 
 @dataclasses.dataclass
@@ -177,4 +187,8 @@ class Report:
                     detail={"relative_section": rel_sec.get(rank, 1.0)},
                 )
             )
+        flagged = sum(1 for v in verdicts if v.is_straggler)
+        _VERDICTS.labels("true").inc(flagged)
+        _VERDICTS.labels("false").inc(len(verdicts) - flagged)
+        _FLAGGED.set(flagged)
         return verdicts

@@ -1,0 +1,47 @@
+"""Resiliency telemetry plane.
+
+- :mod:`.registry` — process-local metrics (counters / gauges / fixed-bucket
+  ns histograms) with a no-op fast path under ``TPURX_TELEMETRY=0``;
+- :mod:`.exporter` — OpenMetrics text over HTTP (per-rank scrape endpoint)
+  or an atomically-rewritten textfile sink (``%r``/``%h`` expansion);
+- :mod:`.aggregate` — cross-rank snapshot gather through the KV store with
+  job-level sum/max/min reductions and per-rank outliers;
+- :mod:`.trace` — ProfilingRecorder JSONL → Chrome-trace/Perfetto JSON
+  (``python -m tpu_resiliency.telemetry.trace``).
+
+See ``docs/observability.md`` for the metric catalog.
+"""
+
+from .registry import (
+    BYTE_BUCKETS,
+    DEFAULT_NS_BUCKETS,
+    ENV_TELEMETRY,
+    NOOP,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    gauge,
+    get_registry,
+    histogram,
+    telemetry_enabled,
+    valid_metric_name,
+)
+
+__all__ = [
+    "BYTE_BUCKETS",
+    "DEFAULT_NS_BUCKETS",
+    "ENV_TELEMETRY",
+    "NOOP",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "counter",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "telemetry_enabled",
+    "valid_metric_name",
+]

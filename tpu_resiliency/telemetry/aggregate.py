@@ -1,0 +1,213 @@
+"""Cross-rank metric aggregation over the KV store.
+
+Follows the straggler reporting round pattern (``straggler/reporting.py``
+``rank_payload`` / ``from_payloads``): every rank serializes its registry
+snapshot to one store key per round, a barrier fences the round, rank 0 (or
+``smonsvc`` polling the same keys) reads all payloads in one ``multi_get``
+and reduces them to job-level series:
+
+- counters / gauges → **sum**, **max** (with the owning rank), **min**;
+- histograms → bucket-wise sums (job-level latency distribution);
+- per-rank **outliers** → the top-k ranks by value for any sample, so "which
+  rank is dropping log lines / stalling drains" is one lookup, not a
+  per-rank scrape.
+
+``render_job_metrics`` re-exports the reduction as OpenMetrics text with an
+``agg`` label (``sum`` / ``max`` / ``min``) and a ``rank`` label on ``max``,
+ready to splice into an exporter endpoint.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..store.barrier import barrier
+from .registry import Registry, get_registry
+
+K_PREFIX = "telemetry"
+
+
+def k_rank(round_idx: int, rank: int) -> str:
+    return f"{K_PREFIX}/round/{round_idx}/rank/{rank}"
+
+
+def rank_payload(registry: Optional[Registry] = None) -> str:
+    return json.dumps((registry or get_registry()).snapshot())
+
+
+def _sample_key(labels: Dict[str, str]) -> str:
+    return json.dumps(labels, sort_keys=True)
+
+
+def aggregate_snapshots(snapshots: Dict[int, dict]) -> dict:
+    """Reduce ``{rank: snapshot}`` into job-level aggregates.
+
+    Returns ``{name: {"kind", "labels", "samples": {labels_json: agg}}}``
+    where ``agg`` is ``{"sum", "max", "max_rank", "min", "per_rank"}`` for
+    scalar kinds and ``{"bounds", "counts", "sum", "count"}`` for
+    histograms.
+    """
+    out: dict = {}
+    for rank in sorted(snapshots):
+        for name, fam in snapshots[rank].items():
+            agg_fam = out.setdefault(
+                name,
+                {"kind": fam["kind"], "labels": fam.get("labels", []), "samples": {}},
+            )
+            for sample in fam.get("samples", ()):
+                key = _sample_key(sample.get("labels", {}))
+                if fam["kind"] == "histogram":
+                    slot = agg_fam["samples"].get(key)
+                    if slot is None:
+                        slot = agg_fam["samples"][key] = {
+                            "labels": sample.get("labels", {}),
+                            "bounds": list(sample["bounds"]),
+                            "counts": [0] * len(sample["counts"]),
+                            "sum": 0.0,
+                            "count": 0,
+                        }
+                    if slot["bounds"] == list(sample["bounds"]):
+                        slot["counts"] = [
+                            a + b for a, b in zip(slot["counts"], sample["counts"])
+                        ]
+                        slot["sum"] += sample["sum"]
+                        slot["count"] += sample["count"]
+                else:
+                    v = float(sample.get("value", 0.0))
+                    slot = agg_fam["samples"].get(key)
+                    if slot is None:
+                        slot = agg_fam["samples"][key] = {
+                            "labels": sample.get("labels", {}),
+                            "sum": 0.0,
+                            "max": float("-inf"),
+                            "max_rank": None,
+                            "min": float("inf"),
+                            "per_rank": {},
+                        }
+                    slot["sum"] += v
+                    slot["per_rank"][rank] = v
+                    if v > slot["max"]:
+                        slot["max"], slot["max_rank"] = v, rank
+                    if v < slot["min"]:
+                        slot["min"] = v
+    return out
+
+
+def outliers(
+    aggregated: dict, name: str, labels: Optional[Dict[str, str]] = None, k: int = 3
+) -> List[Tuple[int, float]]:
+    """Top-k (rank, value) for one scalar sample, highest first."""
+    fam = aggregated.get(name)
+    if not fam or fam["kind"] == "histogram":
+        return []
+    key = _sample_key(labels or {})
+    slot = fam["samples"].get(key)
+    if slot is None:
+        return []
+    ranked = sorted(slot["per_rank"].items(), key=lambda kv: -kv[1])
+    return ranked[:k]
+
+
+def render_job_metrics(aggregated: dict, prefix: str = "") -> str:
+    """Aggregates → OpenMetrics sample lines (no ``# EOF``; meant to be
+    spliced into an exposition by ``MetricsHTTPServer(extra_text_fn=...)``)."""
+    from .exporter import _fmt_labels, _fmt_value  # local: avoid import cycle
+
+    lines: List[str] = []
+    for name in sorted(aggregated):
+        fam = aggregated[name]
+        kind = fam["kind"]
+        family = prefix + (name[: -len("_total")] if kind == "counter" else name)
+        sample_name = family + "_total" if kind == "counter" else family
+        lines.append(f"# TYPE {family} {kind}")
+        for slot in fam["samples"].values():
+            labels = slot["labels"]
+            if kind == "histogram":
+                cum = 0
+                for bound, c in zip(slot["bounds"], slot["counts"][:-1]):
+                    cum += c
+                    lines.append(
+                        f"{family}_bucket"
+                        f"{_fmt_labels(labels, {'le': _fmt_value(bound)})} {cum}"
+                    )
+                cum += slot["counts"][-1]
+                lines.append(
+                    f"{family}_bucket{_fmt_labels(labels, {'le': '+Inf'})} {cum}"
+                )
+                lines.append(
+                    f"{family}_sum{_fmt_labels(labels)} {_fmt_value(slot['sum'])}"
+                )
+                lines.append(f"{family}_count{_fmt_labels(labels)} {slot['count']}")
+                continue
+            lines.append(
+                f"{sample_name}{_fmt_labels(labels, {'agg': 'sum'})} "
+                f"{_fmt_value(slot['sum'])}"
+            )
+            if slot["max_rank"] is not None:
+                lines.append(
+                    f"{sample_name}"
+                    f"{_fmt_labels(labels, {'agg': 'max', 'rank': slot['max_rank']})}"
+                    f" {_fmt_value(slot['max'])}"
+                )
+                lines.append(
+                    f"{sample_name}{_fmt_labels(labels, {'agg': 'min'})} "
+                    f"{_fmt_value(slot['min'])}"
+                )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class CrossRankAggregator:
+    """Collective gather of every rank's snapshot through the KV store.
+
+    Every rank calls :meth:`round` at the same cadence (e.g. alongside the
+    straggler report round).  Rank 0 gets the reduction; other ranks get
+    ``None``.  Round keys are deleted after consumption so multi-day jobs
+    don't grow the store.
+    """
+
+    def __init__(self, store, rank: int, world_size: int):
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self._round = 0
+
+    def round(
+        self, registry: Optional[Registry] = None, timeout: float = 60.0
+    ) -> Optional[dict]:
+        round_idx = self._round
+        self._round += 1
+        self.store.set(k_rank(round_idx, self.rank), rank_payload(registry))
+        barrier(
+            self.store,
+            f"{K_PREFIX}/round/{round_idx}/gather",
+            self.world_size,
+            timeout=timeout,
+        )
+        if self.rank != 0:
+            return None
+        keys = [k_rank(round_idx, r) for r in range(self.world_size)]
+        raws = self.store.multi_get(keys)
+        if raws is None:
+            raise RuntimeError(
+                f"telemetry round {round_idx}: payload vanished after the "
+                "gather barrier"
+            )
+        snapshots = {r: json.loads(raw.decode()) for r, raw in enumerate(raws)}
+        aggregated = aggregate_snapshots(snapshots)
+        for k in self.store.list_keys(f"{K_PREFIX}/round/{round_idx}/"):
+            self.store.delete(k)
+        for k in self.store.list_keys(f"barrier/{K_PREFIX}/round/{round_idx}/"):
+            self.store.delete(k)
+        return aggregated
+
+
+def read_latest_snapshots(store, world_size: int, round_idx: int) -> Dict[int, dict]:
+    """Non-collective read (``smonsvc`` side): best-effort fetch of whatever
+    ranks have published for ``round_idx`` — absent ranks are skipped."""
+    out: Dict[int, dict] = {}
+    for r in range(world_size):
+        raw = store.try_get(k_rank(round_idx, r))
+        if raw is not None:
+            out[r] = json.loads(raw.decode())
+    return out

@@ -1,0 +1,271 @@
+"""OpenMetrics text exposition: HTTP scrape endpoint + textfile sink.
+
+Two transports, both stdlib-only:
+
+- :class:`MetricsHTTPServer` — a ``ThreadingHTTPServer`` on a background
+  thread serving ``GET /metrics`` (plus ``/healthz``).  Port 0 binds an
+  ephemeral port (``.port`` reports it) so every rank on a host can expose
+  its own endpoint without coordination.
+- :class:`TextfileSink` — periodic atomic writes of the exposition to a
+  path template with the same ``%r`` (rank) / ``%h`` (hostname) expansion as
+  ``utils/logging.py``, for node-exporter-textfile-style collection on
+  hosts where an extra listening port is unwelcome.
+
+``serve_from_env()`` wires both from ``TPURX_METRICS_PORT`` /
+``TPURX_METRICS_TEXTFILE``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..utils.logging import _resolve_rank
+from .registry import Registry, get_registry
+
+ENV_METRICS_PORT = "TPURX_METRICS_PORT"
+ENV_METRICS_TEXTFILE = "TPURX_METRICS_TEXTFILE"
+
+CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:  # NaN
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    if v == -math.inf:
+        return "-Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    merged = {**labels, **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in merged.items()
+    )
+    return "{" + inner + "}"
+
+
+def render_openmetrics(registry: Optional[Registry] = None) -> str:
+    """Serialize a registry in OpenMetrics text format (ends with ``# EOF``).
+
+    Counter families drop the mandatory ``_total`` suffix in the family name
+    (``# TYPE``) and keep it on the sample, per the spec.
+    """
+    reg = registry or get_registry()
+    lines: List[str] = []
+    for fam in reg.collect():
+        name = fam["name"]
+        kind = fam["kind"]
+        family = name[: -len("_total")] if kind == "counter" else name
+        lines.append(f"# TYPE {family} {kind}")
+        if fam["help"]:
+            lines.append(f"# HELP {family} {_escape_label_value(fam['help'])}")
+        for labels, value in fam["samples"]:
+            if kind == "histogram":
+                cum = 0
+                bounds = value["bounds"]
+                counts = value["counts"]
+                for bound, c in zip(bounds, counts[:-1]):
+                    cum += c
+                    lines.append(
+                        f"{family}_bucket"
+                        f"{_fmt_labels(labels, {'le': _fmt_value(bound)})} {cum}"
+                    )
+                cum += counts[-1]
+                lines.append(
+                    f"{family}_bucket{_fmt_labels(labels, {'le': '+Inf'})} {cum}"
+                )
+                lines.append(
+                    f"{family}_sum{_fmt_labels(labels)} {_fmt_value(value['sum'])}"
+                )
+                lines.append(f"{family}_count{_fmt_labels(labels)} {value['count']}")
+            else:
+                sample = f"{family}_total" if kind == "counter" else family
+                lines.append(
+                    f"{sample}{_fmt_labels(labels)} {_fmt_value(value['value'])}"
+                )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsHTTPServer:
+    """Background scrape endpoint for one process ("per-rank exporter")."""
+
+    def __init__(
+        self,
+        registry: Optional[Registry] = None,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        extra_text_fn=None,
+    ):
+        self.registry = registry or get_registry()
+        # appended after the registry's families, BEFORE '# EOF' (used by
+        # smonsvc to splice in job-level aggregated series)
+        self._extra_text_fn = extra_text_fn
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # noqa: N802 - stdlib name
+                pass
+
+            def do_GET(self):  # noqa: N802 - stdlib name
+                if self.path in ("/metrics", "/"):
+                    body = outer.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if self.path == "/healthz":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                    return
+                self.send_response(404)
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="tpurx-metrics-http"
+        )
+
+    def render(self) -> str:
+        text = render_openmetrics(self.registry)
+        if self._extra_text_fn is not None:
+            try:
+                extra = self._extra_text_fn()
+            except Exception:  # noqa: BLE001 - extras are best-effort
+                extra = ""
+            if extra:
+                # splice before the EOF marker to keep one valid exposition
+                text = text[: -len("# EOF\n")] + extra.rstrip("\n") + "\n# EOF\n"
+        return text
+
+    def start(self) -> "MetricsHTTPServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        if self._thread.is_alive():
+            # shutdown() blocks on serve_forever's exit handshake — calling
+            # it on a never-started server would wait forever
+            self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=2)
+
+
+def expand_sink_path(template: str) -> str:
+    """``%r``/``%h`` expansion, identical to the log-file sink's."""
+    return template.replace("%r", _resolve_rank(None)).replace(
+        "%h", socket.gethostname()
+    )
+
+
+class TextfileSink:
+    """Atomic exposition writes for textfile-collector scrapes."""
+
+    def __init__(
+        self,
+        path_template: str,
+        registry: Optional[Registry] = None,
+        interval: float = 15.0,
+    ):
+        self.path_template = path_template
+        self.registry = registry or get_registry()
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> str:
+        return expand_sink_path(self.path_template)
+
+    def write_once(self) -> str:
+        path = self.path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(render_openmetrics(self.registry))
+        os.replace(tmp, path)  # scrapers never see a half-written exposition
+        return path
+
+    def start(self) -> "TextfileSink":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="tpurx-metrics-textfile"
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.write_once()
+            except OSError:
+                pass  # transient sink trouble must never hurt the trainer
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        try:
+            self.write_once()  # final flush
+        except OSError:
+            pass
+
+
+def serve_from_env(registry: Optional[Registry] = None):
+    """Start whatever exporters the environment asks for.
+
+    ``TPURX_METRICS_PORT=<n>`` starts the HTTP endpoint (0 = ephemeral);
+    ``TPURX_METRICS_TEXTFILE=/path/metrics_%r.prom`` starts the textfile
+    sink.  Returns the list of started exporters (possibly empty).
+    """
+    started = []
+    port = os.environ.get(ENV_METRICS_PORT)
+    if port is not None:
+        try:
+            base = int(port)
+            if base:
+                # multi-worker hosts: each local rank claims base+local_rank
+                base += int(os.environ.get("TPURX_LOCAL_RANK", "0") or 0)
+            started.append(MetricsHTTPServer(registry, port=base).start())
+        except (OSError, ValueError):
+            pass  # a taken port must not kill the workload
+    template = os.environ.get(ENV_METRICS_TEXTFILE)
+    if template:
+        started.append(TextfileSink(template, registry).start())
+    return started
+
+
+_env_exporters: Optional[list] = None
+_env_lock = threading.Lock()
+
+
+def serve_from_env_once(registry: Optional[Registry] = None) -> list:
+    """Idempotent :func:`serve_from_env` — called from per-rank entry points
+    (rank-monitor init, the in-process wrapper) so a worker that passes
+    through several of them still binds one endpoint."""
+    global _env_exporters
+    with _env_lock:
+        if _env_exporters is None:
+            _env_exporters = serve_from_env(registry)
+        return _env_exporters

@@ -1,0 +1,214 @@
+"""ProfilingRecorder JSONL → Chrome-trace / Perfetto JSON.
+
+``utils/profiling.py`` records the restart pipeline as flat JSONL events
+(``rendezvous_started`` … ``inprocess_restart_completed``).  This module
+pairs the start/end events into complete spans ("ph": "X") and emits the
+Chrome trace-event format both ``chrome://tracing`` and Perfetto load
+directly — one track (pid) per rank, category tracks (tid) per subsystem,
+unpaired events as instants.
+
+CLI::
+
+    python -m tpu_resiliency.telemetry.trace profiling.jsonl -o cycle.trace.json
+
+Multiple input files concatenate (e.g. one JSONL per rank collected off a
+shared mount); each record's ``rank`` (fallback: ``pid``) selects its track.
+Timestamps are the recorder's ``mono_ns`` normalized to the earliest event,
+so spans from one host line up exactly; cross-host files only share a
+relative timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# start event -> (end event, span name, category)
+SPAN_PAIRS: Dict[str, Tuple[str, str, str]] = {
+    "rendezvous_started": ("rendezvous_completed", "rendezvous", "fault_tolerance"),
+    "worker_start_requested": ("worker_started", "worker_start", "fault_tolerance"),
+    "worker_stop_requested": ("worker_stopped", "worker_stop", "fault_tolerance"),
+    "checkpoint_save_started": (
+        "checkpoint_save_finalized", "checkpoint_save", "checkpointing",
+    ),
+    "checkpoint_load_started": (
+        "checkpoint_load_completed", "checkpoint_load", "checkpointing",
+    ),
+    "inprocess_restart_started": (
+        "inprocess_restart_completed", "inprocess_restart", "inprocess",
+    ),
+    "health_check_started": ("health_check_completed", "health_check", "health"),
+}
+_END_TO_START = {end: start for start, (end, _, _) in SPAN_PAIRS.items()}
+
+INSTANT_CATEGORIES = {
+    "failure_detected": "fault_tolerance",
+    "hang_detected": "fault_tolerance",
+    "straggler_detected": "straggler",
+    "inprocess_interrupted": "inprocess",
+    "health_failure": "health",
+    "node_exclude_requested": "health",
+    "worker_started": "fault_tolerance",  # only when its start was never seen
+}
+
+_META_KEYS = ("ts", "mono_ns", "event", "pid")
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line from a killed process
+            if isinstance(rec, dict) and "event" in rec and "mono_ns" in rec:
+                events.append(rec)
+    return events
+
+
+def _track(rec: Dict[str, Any]) -> int:
+    rank = rec.get("rank")
+    if rank is not None:
+        return int(rank)
+    return int(rec.get("pid", 0))
+
+
+def _span_key(rec: Dict[str, Any], start_event: str) -> Tuple:
+    # health checks of different names legitimately nest/overlap — keep them
+    # on separate matching stacks; everything else matches LIFO per track
+    if start_event == "health_check_started":
+        return (start_event, rec.get("check", ""))
+    return (start_event,)
+
+
+def to_chrome_trace(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Pair start/end events into complete spans; returns the trace dict."""
+    events = sorted(events, key=lambda r: r["mono_ns"])
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(r["mono_ns"] for r in events)
+    out: List[Dict[str, Any]] = []
+    tracks = set()
+    # (track, span_key) -> stack of pending start records
+    pending: Dict[Tuple, List[Dict[str, Any]]] = {}
+
+    def args_of(rec: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: v for k, v in rec.items() if k not in _META_KEYS}
+
+    for rec in events:
+        event = rec["event"]
+        track = _track(rec)
+        tracks.add(track)
+        ts_us = (rec["mono_ns"] - t0) / 1e3
+        if event in SPAN_PAIRS:
+            key = (track, _span_key(rec, event))
+            pending.setdefault(key, []).append(rec)
+            continue
+        start_event = _END_TO_START.get(event)
+        if start_event is not None:
+            key = (track, _span_key(rec, start_event))
+            stack = pending.get(key)
+            if stack:
+                start = stack.pop()
+                _, name, cat = SPAN_PAIRS[start_event]
+                out.append(
+                    {
+                        "name": name,
+                        "cat": cat,
+                        "ph": "X",
+                        "ts": (start["mono_ns"] - t0) / 1e3,
+                        "dur": (rec["mono_ns"] - start["mono_ns"]) / 1e3,
+                        "pid": track,
+                        "tid": 0,
+                        "args": {**args_of(start), **args_of(rec)},
+                    }
+                )
+                continue
+            # end without a start (file truncated at the front): instant
+        out.append(
+            {
+                "name": event,
+                "cat": INSTANT_CATEGORIES.get(event, "events"),
+                "ph": "i",
+                "s": "t",
+                "ts": ts_us,
+                "pid": track,
+                "tid": 0,
+                "args": args_of(rec),
+            }
+        )
+    # dangling starts (crash before the end event): zero-length instants so
+    # the abandoned phase is still visible on the timeline
+    for (track, key), stack in pending.items():
+        for start in stack:
+            _, name, cat = SPAN_PAIRS[key[0]]
+            out.append(
+                {
+                    "name": f"{name} (unfinished)",
+                    "cat": cat,
+                    "ph": "i",
+                    "s": "t",
+                    "ts": (start["mono_ns"] - t0) / 1e3,
+                    "pid": track,
+                    "tid": 0,
+                    "args": args_of(start),
+                }
+            )
+    for track in sorted(tracks):
+        out.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": track,
+                "args": {"name": f"rank {track}"},
+            }
+        )
+    out.sort(key=lambda e: (e.get("ts", 0.0), e.get("ph") != "M"))
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def convert(paths: List[str], output: Optional[str] = None) -> Dict[str, Any]:
+    events: List[Dict[str, Any]] = []
+    for p in paths:
+        events.extend(read_jsonl(p))
+    trace = to_chrome_trace(events)
+    if output:
+        with open(output, "w") as f:
+            json.dump(trace, f)
+    return trace
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_resiliency.telemetry.trace",
+        description="Convert ProfilingRecorder JSONL into Chrome-trace JSON "
+        "(open in chrome://tracing or ui.perfetto.dev)",
+    )
+    parser.add_argument("inputs", nargs="+", help="JSONL file(s), one per rank")
+    parser.add_argument(
+        "-o", "--output",
+        help="output path (default: stdout)",
+    )
+    args = parser.parse_args(argv)
+    trace = convert(args.inputs, args.output)
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    if args.output:
+        print(
+            f"wrote {args.output}: {n_spans} spans, "
+            f"{len(trace['traceEvents'])} events",
+            file=sys.stderr,
+        )
+    else:
+        json.dump(trace, sys.stdout)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

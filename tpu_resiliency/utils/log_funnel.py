@@ -29,7 +29,20 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..telemetry import counter
+
 _U32 = struct.Struct("<I")
+
+_DROPPED = counter(
+    "tpurx_log_forwarder_dropped_total",
+    "Log lines dropped under backpressure (full buffer or failed send)",
+)
+_FWD_LINES = counter(
+    "tpurx_log_forwarder_lines_total", "Log lines shipped to the root funnel"
+)
+_FWD_BATCHES = counter(
+    "tpurx_log_forwarder_batches_total", "Batches shipped to the root funnel"
+)
 
 
 class RootLogServer:
@@ -144,7 +157,8 @@ class LogForwarder(logging.Handler):
         self.batch_age = batch_age
         self.max_buffer = max_buffer
         self._buf: List[str] = []
-        self._dropped = 0
+        self._dropped = 0        # pending: reported to the root on next flush
+        self._dropped_total = 0  # cumulative: never reset (local observability)
         self._seq = 0
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
@@ -158,11 +172,22 @@ class LogForwarder(logging.Handler):
         host, _, port = store.get("logfunnel/root").decode().rpartition(":")
         return cls(host, int(port), **kwargs)
 
+    @property
+    def dropped_total(self) -> int:
+        """Cumulative lines this forwarder has dropped (buffer overflow +
+        failed sends).  Unlike the per-batch ``dropped`` field — which only
+        reaches the root's consolidated file — this is locally observable
+        and mirrored into the ``tpurx_log_forwarder_dropped_total`` metric."""
+        with self._lock:
+            return self._dropped_total
+
     def emit(self, record: logging.LogRecord) -> None:
         line = self.format(record)
         with self._lock:
             if len(self._buf) >= self.max_buffer:
                 self._dropped += 1  # never block the training host
+                self._dropped_total += 1
+                _DROPPED.inc()
                 return
             self._buf.append(line)
             if len(self._buf) >= self.batch_lines:
@@ -190,6 +215,8 @@ class LogForwarder(logging.Handler):
             if self._sock is None:
                 self._sock = socket.create_connection(self.addr, timeout=5.0)
             self._sock.sendall(_U32.pack(len(payload)) + payload)
+            _FWD_BATCHES.inc()
+            _FWD_LINES.inc(len(lines))
         except OSError:
             if self._sock is not None:
                 try:
@@ -199,6 +226,8 @@ class LogForwarder(logging.Handler):
                 self._sock = None
             with self._lock:
                 self._dropped += len(lines)
+                self._dropped_total += len(lines)
+            _DROPPED.inc(len(lines))
 
     def close(self) -> None:
         self._stop.set()

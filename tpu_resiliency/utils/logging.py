@@ -80,14 +80,62 @@ class _RankFilter(logging.Filter):
 _FORMAT = "[%(asctime)s] [%(levelname)s] [%(host)s:r%(rank)s] [%(name)s] %(message)s"
 
 
-def setup_logger(config: Optional[LogConfig] = None) -> logging.Logger:
-    """Configure and return the root ``tpurx`` logger. Idempotent."""
+class _TemplateFileHandler(logging.FileHandler):
+    """File handler whose ``%r``/``%h`` placeholders expand lazily.
+
+    ``setup_logger`` routinely runs at import time (``get_logger`` at module
+    scope), *before* the launcher exports ``TPURX_RANK`` into the worker —
+    eager expansion bakes ``"?"`` into the path for the life of the process.
+    Expansion therefore happens per record: the first emit resolves the
+    template, and a later rank change (env set between setup and first log,
+    or a re-rank across restart cycles) closes the old stream and reopens at
+    the new path.
+    """
+
+    def __init__(self, template: str, rank: Optional[int] = None):
+        self._template = template
+        self._explicit_rank = rank
+        # delay=True: no stream (and no directory) is created until a record
+        # actually arrives — by which time the rank env is usually set
+        super().__init__(self._expand(), delay=True)
+
+    def _expand(self) -> str:
+        return os.path.abspath(
+            self._template.replace("%r", _resolve_rank(self._explicit_rank))
+            .replace("%h", socket.gethostname())
+        )
+
+    def emit(self, record: logging.LogRecord) -> None:
+        # runs under the handler lock (Handler.handle); swap the stream
+        # directly — Handler.close() would also deregister us from logging's
+        # shutdown flush list
+        path = self._expand()
+        if path != self.baseFilename:
+            stream, self.stream = self.stream, None
+            if stream is not None:
+                stream.flush()
+                stream.close()
+            self.baseFilename = path
+        if self.stream is None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        super().emit(record)
+
+
+def setup_logger(
+    config: Optional[LogConfig] = None, force: bool = False
+) -> logging.Logger:
+    """Configure and return the root ``tpurx`` logger.  Idempotent unless
+    ``force=True`` (which drops existing handlers and reconfigures)."""
     cfg = config or LogConfig.from_env()
     logger = logging.getLogger(_ROOT_NAME)
     level = getattr(logging, os.environ.get(ENV_LOG_LEVEL, cfg.level).upper(), logging.INFO)
     logger.setLevel(level)
     if getattr(logger, "_tpurx_configured", False):
-        return logger
+        if not force:
+            return logger
+        for handler in list(logger.handlers):
+            logger.removeHandler(handler)
+            handler.close()
 
     logger.propagate = False
     rank_filter = _RankFilter(cfg.rank)
@@ -100,11 +148,7 @@ def setup_logger(config: Optional[LogConfig] = None) -> logging.Logger:
 
     to_file = os.environ.get(ENV_LOG_FILE, cfg.to_file)
     if to_file:
-        path = to_file.replace("%r", _resolve_rank(cfg.rank)).replace(
-            "%h", socket.gethostname()
-        )
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        fh = logging.FileHandler(path)
+        fh = _TemplateFileHandler(to_file, cfg.rank)
         fh.setFormatter(formatter)
         fh.addFilter(rank_filter)
         logger.addHandler(fh)

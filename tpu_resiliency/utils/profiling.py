@@ -11,6 +11,8 @@ them without importing the package.
 
 from __future__ import annotations
 
+import atexit
+import collections
 import enum
 import json
 import os
@@ -47,17 +49,65 @@ class ProfilingEvent(str, enum.Enum):
     NODE_EXCLUDE_REQUESTED = "node_exclude_requested"
 
 
-class ProfilingRecorder:
-    """Thread-safe in-memory recorder with optional JSONL file sink."""
+ENV_HISTORY = "TPURX_PROFILING_HISTORY"
+_DEFAULT_HISTORY = 4096
 
-    def __init__(self, path: Optional[str] = None, cycle: int = 0):
+
+class ProfilingRecorder:
+    """Thread-safe in-memory recorder with optional JSONL file sink.
+
+    The sink fd is opened once (lazily, on the first record) and held
+    line-buffered for the life of the process — the restart pipeline emits
+    events from hot paths, and an open()/close() per event costs two
+    syscalls plus a dentry walk each time.  In-memory history is a bounded
+    deque (``TPURX_PROFILING_HISTORY``, default 4096): the file keeps the
+    full stream, the deque only serves in-process queries like
+    :meth:`latency_ns`, so a multi-day crash-looping job cannot grow the
+    heap without bound.
+    """
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        cycle: int = 0,
+        history: Optional[int] = None,
+    ):
         self._path = path
         self._cycle = cycle
         self._lock = threading.Lock()
-        self._events: List[Dict[str, Any]] = []
+        if history is None:
+            try:
+                history = int(os.environ.get(ENV_HISTORY, _DEFAULT_HISTORY))
+            except ValueError:
+                history = _DEFAULT_HISTORY
+        self._events: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=history if history > 0 else None
+        )
+        self._file = None
 
     def set_cycle(self, cycle: int) -> None:
         self._cycle = cycle
+
+    def _sink(self):
+        """The persistent line-buffered sink (None when pathless/broken)."""
+        if self._file is None and self._path:
+            try:
+                self._file = open(self._path, "a", buffering=1)
+            except OSError:
+                self._path = None  # don't retry the open on every event
+                return None
+            atexit.register(self.close)
+        return self._file
+
+    def close(self) -> None:
+        with self._lock:
+            f, self._file = self._file, None
+            self._path = None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
 
     def record(self, event: ProfilingEvent, **extra: Any) -> Dict[str, Any]:
         rec = {
@@ -70,11 +120,11 @@ class ProfilingRecorder:
         }
         with self._lock:
             self._events.append(rec)
-            if self._path:
+            f = self._sink()
+            if f is not None:
                 try:
-                    with open(self._path, "a") as f:
-                        f.write(json.dumps(rec) + "\n")
-                except OSError:
+                    f.write(json.dumps(rec) + "\n")
+                except (OSError, ValueError):
                     pass
         return rec
 
