@@ -9,6 +9,12 @@ tripwire, with four randomized fault classes injected per worker step:
 - ``exception`` — absorbed by the in-process ring (no respawn),
 - ``quorum_stall`` — ping-less stall; the on-device quorum collective
   trips and the in-process ring restarts the iteration,
+- ``collective_wedge`` — the wedged-collective injection: the rank
+  dispatches a named collective every step (feeding the at-abort
+  fingerprint tail) and then parks ping-less "inside" it; the quorum
+  tripwire trips, every rank's abort LADDER runs, and the report asserts
+  the ladder's recorded stage outcomes (fingerprint rung released) from
+  the profiling stream,
 - ``hang`` — GIL-released C sleep; the rank monitor's heartbeat timeout
   kills the worker (outer ring respawn),
 - ``crash`` — hard exit (outer ring respawn).
@@ -16,6 +22,10 @@ tripwire, with four randomized fault classes injected per worker step:
 With ``--chaos-store`` the KV store runs as an EXTERNAL control plane
 with a journal, and a chaos thread SIGKILLs and restarts it at random
 intervals mid-run — launchers and monitors must ride the outage out.
+With ``--store-kill-mid-save`` the kills are TARGETED instead: rank 0
+runs a periodic store-backed "save" (chunked marker writes through the
+unified retry policy) and the chaos thread kills the store inside the
+save window — the gate asserts every started save still completed.
 
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
@@ -50,7 +60,7 @@ import os, random, sys, time
 sys.path.insert(0, os.environ["TPURX_REPO"])
 from tpu_resiliency.fault_tolerance import RankMonitorClient
 from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
-from tpu_resiliency.inprocess import ShiftRanks, Wrapper
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper, record_dispatch
 
 rank = int(os.environ["TPURX_RANK"])
 cycle = int(os.environ["TPURX_CYCLE"])
@@ -58,9 +68,36 @@ p_exc = float(os.environ.get("SOAK_EXC_P", "0.01"))
 p_crash = float(os.environ.get("SOAK_CRASH_P", "0.008"))
 p_hang = float(os.environ.get("SOAK_HANG_P", "0.004"))
 p_qstall = float(os.environ.get("SOAK_QSTALL_P", "0.0"))
+p_cwedge = float(os.environ.get("SOAK_CWEDGE_P", "0.0"))
+save_every = int(os.environ.get("SOAK_SAVE_EVERY", "0"))
 total = int(os.environ.get("SOAK_STEPS", "100000"))
 ckpt = os.environ["SOAK_CKPT"]
 rng = random.Random(f"{cycle}:{rank}:{os.getpid()}")
+
+save_store = None
+if save_every and rank == 0:
+    from tpu_resiliency.store.client import store_from_env
+    save_store = store_from_env(timeout=10.0)
+
+
+def store_save(step):
+    '''A store-backed "save": chunked marker writes, each riding the
+    unified retry policy in the store client; the whole commit retried
+    under the same policy — mid-save store kills must not lose a save.'''
+    from tpu_resiliency.utils.retry import Retrier, RetryPolicy
+    print(f"soak[{rank}] save start at step {step}", flush=True)
+    r = Retrier("soak_save", RetryPolicy(max_attempts=None, base_delay=0.5,
+                                         max_delay=3.0, deadline=60.0))
+    while True:
+        try:
+            for i in range(8):
+                save_store.set(f"soakckpt/{step}/{i}", str(step))
+                time.sleep(0.08)
+            save_store.set(f"soakckpt/{step}/commit", "1")
+            break
+        except Exception as exc:
+            r.backoff(exc)
+    print(f"soak[{rank}] save done at step {step}", flush=True)
 
 quorum_kw = {}
 if os.environ.get("SOAK_QUORUM") == "1":
@@ -100,7 +137,10 @@ def run(call_wrapper=None):
     for step in range(start, total):
         call_wrapper.ping()
         client.send_heartbeat()
+        record_dispatch("soak_allreduce")   # at-abort fingerprint feed
         time.sleep(0.03)
+        if save_every and save_store is not None and step and step % save_every == 0:
+            store_save(step)
         r = rng.random()
         if r < p_crash:
             print(f"soak[{rank}] crash at step {step}", flush=True); os._exit(41)
@@ -116,6 +156,14 @@ def run(call_wrapper=None):
         if r < p_qstall and quorum_kw:
             print(f"soak[{rank}] quorum stall at step {step}", flush=True)
             while True:     # ping-less python loop: quorum trips, raise lands
+                time.sleep(0.02)
+        r -= p_qstall
+        if r < p_cwedge and quorum_kw:
+            # wedged-collective injection: the collective was DISPATCHED
+            # (it's in the tail) and this rank now parks "inside" it —
+            # the ladder's fingerprint rung must name soak_allreduce
+            print(f"soak[{rank}] collective wedge at step {step}", flush=True)
+            while True:
                 time.sleep(0.02)
         if call_wrapper.state.active_rank == 0:
             write_progress_iteration(ckpt, step + 1)
@@ -134,20 +182,35 @@ def _free_port() -> int:
 
 
 class StoreChaos(threading.Thread):
-    """Kill and restart the external control plane at random intervals."""
+    """Kill and restart the external control plane — at random intervals,
+    or (``trigger`` given) the moment the trigger fires, so kills can be
+    TARGETED inside a save window (store-outage-mid-save)."""
 
-    def __init__(self, spawn_fn, min_s: float, max_s: float, down_s: float):
+    def __init__(self, spawn_fn, min_s: float, max_s: float, down_s: float,
+                 trigger=None):
         super().__init__(daemon=True)
         self.spawn_fn = spawn_fn
         self.min_s, self.max_s, self.down_s = min_s, max_s, down_s
+        self.trigger = trigger
         self.proc = spawn_fn()
         self.kills = 0
         self._halt = threading.Event()
         self.rng = random.Random(0xC4A05)
 
+    def _wait_for_next_kill(self) -> bool:
+        """True when a kill is due; False when halting."""
+        if self.trigger is None:
+            return not self._halt.wait(self.rng.uniform(self.min_s, self.max_s))
+        while not self._halt.is_set():
+            if self.trigger():
+                return True
+            if self._halt.wait(0.2):
+                break
+        return False
+
     def run(self):
         while not self._halt.is_set():
-            if self._halt.wait(self.rng.uniform(self.min_s, self.max_s)):
+            if not self._wait_for_next_kill():
                 break
             try:
                 os.kill(self.proc.pid, signal.SIGKILL)
@@ -221,6 +284,14 @@ def main() -> None:
     p.add_argument("--crash-p", type=float, default=0.008)
     p.add_argument("--hang-p", type=float, default=0.004)
     p.add_argument("--qstall-p", type=float, default=0.006)
+    p.add_argument("--cwedge-p", type=float, default=0.004,
+                   help="wedged-collective injection probability "
+                        "(quorum-armed runs only)")
+    p.add_argument("--save-every", type=int, default=0,
+                   help="steps between rank-0 store-backed saves (0=off)")
+    p.add_argument("--store-kill-mid-save", action="store_true",
+                   help="target store kills INSIDE save windows; asserts "
+                        "every started save still completes")
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
     p.add_argument("--chaos-store", action="store_true",
@@ -239,6 +310,13 @@ def main() -> None:
         args.seconds = max(args.seconds, 900.0)
         args.chaos_store = True
         args.quorum = True
+        args.store_kill_mid_save = True
+        if not args.save_every:
+            args.save_every = 60
+    if args.store_kill_mid_save:
+        args.chaos_store = True
+        if not args.save_every:
+            args.save_every = 40
 
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
@@ -259,6 +337,8 @@ def main() -> None:
             "SOAK_CRASH_P": str(args.crash_p),
             "SOAK_HANG_P": str(args.hang_p),
             "SOAK_QSTALL_P": str(args.qstall_p if args.quorum else 0.0),
+            "SOAK_CWEDGE_P": str(args.cwedge_p if args.quorum else 0.0),
+            "SOAK_SAVE_EVERY": str(args.save_every),
             "SOAK_QUORUM": "1" if args.quorum else "0",
             "TPURX_PROFILING_FILE": profile,
             "TPURX_FT_ENABLE_DEVICE_HEALTH_CHECK": "0",
@@ -281,6 +361,8 @@ def main() -> None:
         env["TPURX_NATIVE_STORE"] = "1"
 
     chaos = None
+    chunks: list = []   # launcher stdout, drained continuously (shared with
+    # the mid-save trigger, which scans it for save-start markers)
     launch_cmd = [
         sys.executable, "-m", "tpu_resiliency.fault_tolerance.launcher",
         "--nnodes", "1", "--nproc-per-node", str(args.nproc),
@@ -302,8 +384,24 @@ def main() -> None:
                                     stdout=subprocess.DEVNULL,
                                     stderr=subprocess.STDOUT)
 
+        trigger = None
+        if args.store_kill_mid_save:
+            state = {"last": 0, "next_kill_t": 0.0}
+
+            def trigger():
+                # fire INSIDE a save window: a fresh "save start" marker,
+                # rate-limited so some saves also complete undisturbed
+                starts = "".join(chunks).count("] save start at step")
+                now = time.monotonic()
+                if starts > state["last"]:
+                    state["last"] = starts
+                    if starts % 2 == 1 and now >= state["next_kill_t"]:
+                        state["next_kill_t"] = now + 12.0
+                        return True
+                return False
+
         chaos = StoreChaos(spawn_store, *args.store_kill_every,
-                           down_s=args.store_down)
+                           down_s=args.store_down, trigger=trigger)
         time.sleep(2.0)  # let the control plane bind before launchers dial
     else:
         launch_cmd.append("--host-store")
@@ -315,7 +413,6 @@ def main() -> None:
     )
     # drain stdout continuously: a full 64KB pipe would block the launcher
     # and wedge the very run being measured
-    chunks: list = []
 
     def _drain():
         for line in proc.stdout:
@@ -372,6 +469,7 @@ def main() -> None:
         "hangs": out.count("] hang at step"),
         "exceptions": out.count("] exception at step"),
         "quorum_stalls": out.count("] quorum stall at step"),
+        "collective_wedges": out.count("] collective wedge at step"),
     }
     monotone = all(b >= a for a, b in zip(progress_samples, progress_samples[1:]))
     final = progress_samples[-1] if progress_samples else 0
@@ -380,12 +478,39 @@ def main() -> None:
         bounds_ok = False
     if outer_ms and not (med(outer_ms) <= args.outer_bound_ms):
         bounds_ok = False
+    inner_faults = (injected["exceptions"] + injected["quorum_stalls"]
+                    + injected["collective_wedges"])
     # faults were injected -> the matching ring must actually have run
     rings_ok = (
-        (injected["exceptions"] + injected["quorum_stalls"] == 0 or inner_ms)
+        (inner_faults == 0 or inner_ms)
         and (injected["crashes"] + injected["hangs"] == 0 or cycles >= 1)
     )
-    ok = bool(monotone and final > 0 and bounds_ok and rings_ok)
+    # abort-ladder stage outcomes from the profiling stream: every inner
+    # trip runs the ladder, whose fingerprint rung must have released
+    stage_outcomes: dict = {}
+    for ev in events:
+        if ev.get("event") == "abort_stage":
+            key = f"{ev.get('stage')}/{ev.get('outcome')}"
+            stage_outcomes[key] = stage_outcomes.get(key, 0) + 1
+    ladder_ok = (
+        inner_faults == 0 or not inner_ms
+        or stage_outcomes.get("fingerprint/released", 0) >= 1
+    )
+    # store-outage-mid-save: every save that started must have completed
+    # (the unified retry policy rides out the kill); tolerated shortfalls:
+    # one save aborted per worker restart (either ring) plus the single
+    # save the soak's own deadline may cut off in flight
+    saves_started = out.count("] save start at step")
+    saves_done = out.count("] save done at step")
+    saves_ok = True
+    if args.store_kill_mid_save:
+        tolerance = cycles + len(inner_ms) + 1
+        saves_ok = (
+            saves_started >= 1
+            and saves_done >= max(1, saves_started - tolerance)
+        )
+    ok = bool(monotone and final > 0 and bounds_ok and rings_ok
+              and ladder_ok and saves_ok)
     print(
         json.dumps(
             {
@@ -402,8 +527,13 @@ def main() -> None:
                 "inner_detect_to_recover_ms_median": med(inner_ms),
                 "outer_ring_recoveries": len(outer_ms),
                 "outer_detect_to_recover_ms_median": med(outer_ms),
+                "abort_stage_outcomes": stage_outcomes,
+                "saves_started": saves_started,
+                "saves_done": saves_done,
                 "monotone_progress": monotone,
                 "bounds_ok": bounds_ok,
+                "ladder_ok": ladder_ok,
+                "saves_ok": saves_ok,
                 "ok": ok,
             }
         )

@@ -28,10 +28,12 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 from tpu_resiliency.inprocess import (
+    AbortLadder,
     Compose,
     DeviceProbeHealthCheck,
     FaultCounter,
     ShiftRanks,
+    ShrinkMeshStage,
     Wrapper,
 )
 from tpu_resiliency.inprocess.abort import ClearJaxCaches
@@ -40,7 +42,10 @@ from tpu_resiliency.inprocess.abort import ClearJaxCaches
 @Wrapper(
     rank_assignment=ShiftRanks(),
     health_check=Compose(FaultCounter(max_faults=5), DeviceProbeHealthCheck(timeout=30)),
-    abort=ClearJaxCaches(),
+    # the staged abort ladder: the wrapper prepends its fingerprint rung
+    # automatically; each rung runs with its own deadline and recorded
+    # outcome (released / timed_out / escalate) — see docs/inprocess.md
+    abort=AbortLadder(ShrinkMeshStage(), ClearJaxCaches()),
     soft_timeout=20.0,
     hard_timeout=40.0,
 )

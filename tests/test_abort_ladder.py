@@ -1,0 +1,344 @@
+"""Unit tests: staged abort ladder, unified retry policy, dispatch-tail
+fingerprint, and the fingerprint analyzer — the in-process pieces of the
+measured degradation ladder (monitor-kill backstop stays the bottom rung;
+its end-to-end coverage lives in tests/test_layered_restart.py)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.attribution.trace_analyzer import analyze_fingerprints
+from tpu_resiliency.inprocess import Compose
+from tpu_resiliency.inprocess.abort import (
+    ESCALATE,
+    FAILED,
+    RELEASED,
+    SKIPPED,
+    TIMED_OUT,
+    AbortLadder,
+    AbortStage,
+    ClearJaxCaches,
+    EscalateAbort,
+    FingerprintStage,
+    FnStage,
+    ShrinkMeshStage,
+)
+from tpu_resiliency.inprocess.attribution import Interruption, InterruptionRecord
+from tpu_resiliency.inprocess.fingerprint import (
+    DispatchTail,
+    parse_fingerprints,
+    read_tail,
+)
+from tpu_resiliency.telemetry import get_registry
+from tpu_resiliency.utils.retry import (
+    Retrier,
+    RetryExhausted,
+    RetryPolicy,
+    retry_call,
+)
+
+
+class _Stage(AbortStage):
+    def __init__(self, name, fn=None, timeout=None):
+        super().__init__(timeout)
+        self.name = name
+        self.fn = fn or (lambda: None)
+
+    def release(self, state=None):
+        return self.fn()
+
+
+class TestAbortLadder:
+    def test_rung_order_and_outcomes(self):
+        order = []
+        lad = AbortLadder(
+            _Stage("a", lambda: order.append("a")),
+            _Stage("b", lambda: (_ for _ in ()).throw(RuntimeError("boom"))),
+            _Stage("c", lambda: order.append("c")),
+        )
+        lad(None)
+        assert order == ["a", "c"]
+        outcomes = {r.stage: r.outcome for r in lad.last_results}
+        assert outcomes == {"a": RELEASED, "b": FAILED, "c": RELEASED}
+
+    def test_timed_out_stage_is_abandoned_not_fatal(self):
+        release = threading.Event()
+        lad = AbortLadder(
+            _Stage("slow", lambda: release.wait(30), timeout=0.15),
+            _Stage("after"),
+        )
+        t0 = time.monotonic()
+        lad(None)
+        assert time.monotonic() - t0 < 5.0
+        outcomes = {r.stage: r.outcome for r in lad.last_results}
+        assert outcomes["slow"] == TIMED_OUT
+        assert outcomes["after"] == RELEASED  # the ladder kept going
+        release.set()
+
+    def test_escalate_skips_remaining_rungs(self):
+        lad = AbortLadder(
+            _Stage("first"),
+            _Stage("give_up", lambda: (_ for _ in ()).throw(
+                EscalateAbort("no in-process path"))),
+            _Stage("never"),
+        )
+        lad(None)
+        outcomes = {r.stage: r.outcome for r in lad.last_results}
+        assert outcomes == {
+            "first": RELEASED, "give_up": ESCALATE, "never": SKIPPED,
+        }
+
+    def test_plain_callables_and_compose_flatten_into_rungs(self):
+        seen = []
+
+        def plugin_a(state):
+            seen.append("a")
+            return state
+
+        def plugin_b(state):
+            seen.append("b")
+            return state
+
+        lad = AbortLadder(Compose(plugin_a, plugin_b), ClearJaxCaches())
+        assert [s.name for s in lad.stages] == [
+            "plugin_a", "plugin_b", "jax_caches",
+        ]
+        lad(None)
+        assert seen == ["a", "b"]
+        assert all(r.outcome == RELEASED for r in lad.last_results)
+
+    def test_fn_stage_counts_as_plain_plugin_when_called_directly(self):
+        calls = []
+        stage = FnStage(lambda s: calls.append(s), name="legacy")
+        assert stage("x") == "x"  # plugin-compatible direct call
+        assert calls == ["x"]
+
+    def test_take_results_drains_once(self):
+        lad = AbortLadder(_Stage("a"))
+        lad(None)
+        assert len(lad.take_results()) == 1
+        assert lad.take_results() == []
+
+    def test_telemetry_counts_stage_outcomes(self):
+        reg = get_registry()
+        before = reg.value_of(
+            "tpurx_abort_stage_outcomes_total",
+            {"stage": "tele", "outcome": RELEASED},
+        )
+        AbortLadder(_Stage("tele"))(None)
+        after = reg.value_of(
+            "tpurx_abort_stage_outcomes_total",
+            {"stage": "tele", "outcome": RELEASED},
+        )
+        assert after == before + 1
+
+    def test_shrink_stage_gated_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("TPURX_SHRINK_MESH", raising=False)
+        assert not ShrinkMeshStage().applicable()
+        monkeypatch.setenv("TPURX_SHRINK_MESH", "1")
+        assert ShrinkMeshStage().applicable()
+        assert ShrinkMeshStage(enabled=True).applicable()
+
+    def test_fingerprint_stage_gated_until_bound(self):
+        stage = FingerprintStage()
+        assert not stage.applicable()
+        lad = AbortLadder(stage)
+        lad(None)
+        assert lad.last_results[0].outcome == SKIPPED
+
+
+class _FakeOps:
+    def __init__(self):
+        self.published = []
+
+    def record_fingerprint(self, iteration, rank, tail):
+        self.published.append((iteration, rank, list(tail)))
+
+
+class TestFingerprint:
+    def test_ring_wraps_and_keeps_newest(self):
+        tail = DispatchTail(capacity=4)
+        for i in range(9):
+            tail.record(f"op{i}")
+        snap = tail.snapshot()
+        assert [e["op"] for e in snap] == ["op5", "op6", "op7", "op8"]
+        assert snap[-1]["seq"] == 9
+        assert all(e["age_ms"] >= 0 for e in snap)
+
+    def test_shm_tail_cross_attach(self):
+        tail = DispatchTail.create(capacity=4)
+        if tail.name is None:
+            pytest.skip("shm unavailable on this host")
+        tail.record("matmul_step")
+        try:
+            got = read_tail(tail.name)
+            assert [e["op"] for e in got] == ["matmul_step"]
+        finally:
+            tail.close()
+
+    def test_attach_rejects_non_arena(self):
+        from tpu_resiliency.utils.shm import create_shm, unlink_shm
+
+        shm = create_shm(256)
+        try:
+            with pytest.raises(ValueError):
+                DispatchTail.attach(shm.name)
+        finally:
+            unlink_shm(shm)
+            shm.close()
+
+    def test_stage_publishes_tail(self):
+        ops = _FakeOps()
+        tail = DispatchTail(capacity=4)
+        from tpu_resiliency.inprocess import fingerprint as fp
+
+        prev = fp.install_tail(tail)
+        try:
+            tail.record("collective_x")
+            stage = FingerprintStage(ops, rank=3, iteration_fn=lambda: 7)
+            AbortLadder(stage)(None)
+        finally:
+            fp.install_tail(prev)
+        assert len(ops.published) == 1
+        iteration, rank, published = ops.published[0]
+        assert (iteration, rank) == (7, 3)
+        assert published[0]["op"] == "collective_x"
+
+    def test_parse_fingerprints_tolerates_garbage(self):
+        raw = (
+            b'{"rank": 0, "tail": [{"op": "x", "age_ms": 1, "seq": 1}]}\n'
+            b"not json\n"
+            b'{"rank": "bad"}\n'
+            b'{"rank": 1, "tail": []}\n'
+        )
+        got = parse_fingerprints(raw)
+        assert set(got) == {0, 1}
+        assert got[0][0]["op"] == "x"
+        assert parse_fingerprints(None) == {}
+
+    def test_interruption_record_roundtrips_fingerprint(self):
+        rec = InterruptionRecord(
+            rank=2, interruption=Interruption.SOFT_TIMEOUT, message="stall",
+            fingerprint=[{"op": "spin", "age_ms": 1234, "seq": 8}],
+        )
+        back = InterruptionRecord.from_json(rec.to_json())
+        assert back.fingerprint == rec.fingerprint
+        # records without one stay wire-compatible
+        bare = InterruptionRecord.from_json(
+            '{"rank": 0, "interruption": "exception"}'
+        )
+        assert bare.fingerprint == []
+
+
+class TestAnalyzeFingerprints:
+    def test_lagging_rank_named_with_in_flight_op(self):
+        v = analyze_fingerprints({
+            0: [{"op": "all_reduce", "age_ms": 120, "seq": 10}],
+            1: [{"op": "all_reduce", "age_ms": 2500, "seq": 7}],
+            2: [{"op": "all_reduce", "age_ms": 90, "seq": 10}],
+        })
+        assert v.category == "wedged_collective"
+        assert v.culprit_ranks == [1]
+        assert "all_reduce" in v.summary
+
+    def test_divergent_rank_never_dispatched_the_op(self):
+        v = analyze_fingerprints({
+            0: [{"op": "all_reduce", "age_ms": 100, "seq": 10}],
+            1: [{"op": "data_load", "age_ms": 150, "seq": 6}],
+            2: [{"op": "all_reduce", "age_ms": 110, "seq": 10}],
+        })
+        assert v.culprit_ranks == [1]
+        assert "never dispatched" in v.summary
+
+    def test_missing_fingerprint_is_the_culprit(self):
+        v = analyze_fingerprints({
+            0: [{"op": "all_reduce", "age_ms": 100, "seq": 10}],
+            1: [],
+            2: [{"op": "all_reduce", "age_ms": 110, "seq": 10}],
+        })
+        assert v.culprit_ranks == [1]
+        assert "no fingerprint" in v.summary
+
+    def test_no_data_and_uniform_stall(self):
+        assert analyze_fingerprints({0: [], 1: []}).category == "no_data"
+        v = analyze_fingerprints({
+            0: [{"op": "all_reduce", "age_ms": 900, "seq": 5}],
+            1: [{"op": "all_reduce", "age_ms": 1000, "seq": 5}],
+        })
+        assert v.category == "collective_stall"
+        assert v.culprit_ranks == []
+
+
+class TestRetryPolicy:
+    def test_exponential_delays_bounded(self):
+        p = RetryPolicy(base_delay=0.1, max_delay=0.5, multiplier=2.0,
+                        min_delay_fraction=1.0)
+        assert [p.delay(n) for n in (1, 2, 3, 4, 5)] == [
+            0.1, 0.2, 0.4, 0.5, 0.5,
+        ]
+
+    def test_jitter_stays_in_band(self):
+        p = RetryPolicy(base_delay=1.0, multiplier=1.0, min_delay_fraction=0.5)
+        for _ in range(200):
+            assert 0.5 <= p.delay(1) <= 1.0
+
+    def test_retrier_attempt_budget(self):
+        sleeps = []
+        r = Retrier("t_budget", RetryPolicy(max_attempts=3, base_delay=0.01),
+                    sleep=sleeps.append)
+        r.backoff(OSError("1"))
+        r.backoff(OSError("2"))
+        with pytest.raises(RetryExhausted) as ei:
+            r.backoff(OSError("3"))
+        assert len(sleeps) == 2
+        assert isinstance(ei.value.last_exc, OSError)
+        assert ei.value.attempts == 3
+
+    def test_retrier_deadline_clamps_sleep(self):
+        clock = [0.0]
+        sleeps = []
+
+        def fake_sleep(s):
+            sleeps.append(s)
+            clock[0] += s
+
+        r = Retrier(
+            "t_deadline",
+            RetryPolicy(max_attempts=None, base_delay=10.0, max_delay=10.0,
+                        min_delay_fraction=1.0, deadline=4.0),
+            sleep=fake_sleep, clock=lambda: clock[0],
+        )
+        r.backoff()          # clamped to the 4s remaining
+        assert sleeps == [4.0]
+        with pytest.raises(RetryExhausted):
+            r.backoff()      # budget spent
+
+    def test_retry_call_and_telemetry(self):
+        reg = get_registry()
+        before = reg.value_of("tpurx_retry_attempts_total",
+                              {"site": "t_call"})
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "done"
+
+        out = retry_call(
+            flaky, site="t_call",
+            policy=RetryPolicy(max_attempts=5, base_delay=0.001),
+            retry_on=(OSError,),
+        )
+        assert out == "done"
+        after = reg.value_of("tpurx_retry_attempts_total", {"site": "t_call"})
+        assert after == before + 3
+
+    def test_retry_call_propagates_unlisted_exceptions(self):
+        with pytest.raises(ValueError):
+            retry_call(
+                lambda: (_ for _ in ()).throw(ValueError("no")),
+                site="t_prop", policy=RetryPolicy(max_attempts=3),
+                retry_on=(OSError,),
+            )

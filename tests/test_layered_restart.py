@@ -68,6 +68,54 @@ def test_inner_fault_absorbed_by_inprocess_ring(tmp_path):
     # the nested-restarter protocol surfaced the recovery phases
     assert "[NestedRestarter] name=[InProcess] state=handling_start" in proc.stdout
     assert "[NestedRestarter] name=[InProcess] state=completed" in proc.stdout
+    # the abort ladder ran with recorded per-stage outcomes
+    blob = proc.stdout + proc.stderr
+    assert "abort ladder:" in blob
+    assert "fingerprint=released" in blob
+
+
+def test_inner_fault_with_shrink_mesh_stage_enabled(tmp_path):
+    """The opt-in ShrinkMeshStage on the in-process recovery path: with no
+    distributed client it releases by clearing caches+backends, recovery
+    still completes in-process, and the outcome is recorded — the ladder's
+    rung order and gating exercised end to end under the real launcher."""
+    proc = run_layered(tmp_path, "inner", extra_env={"TPURX_SHRINK_MESH": "1"})
+    assert proc.returncode == 0
+    assert proc.stdout.count("ret=done@1") == 2
+    assert "worker failure detected" not in proc.stderr
+    blob = proc.stdout + proc.stderr
+    assert "shrink_mesh=released" in blob
+
+
+def test_stalled_collective_recovered_through_ladder_with_verdict(tmp_path):
+    """The wedged-collective case the ladder absorbs IN-PROCESS: rank 1
+    parks ping-less on a 'collective', the quorum tripwire names the stale
+    rank, every rank's ladder publishes its dispatch tail, and the
+    trace-analyzer verdict cites the in-flight op and the lagging rank
+    from the at-abort fingerprints (VERDICT r5 'do this' #5)."""
+    proc = run_layered(
+        tmp_path, "stall", timeout=240,
+        extra_env={
+            # host ring stays the distant backstop; quorum owns detection
+            "WRAP_SOFT_TIMEOUT": "60", "WRAP_HARD_TIMEOUT": "120",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        },
+    )
+    assert proc.returncode == 0
+    blob = proc.stdout + proc.stderr
+    assert "stalling: parked on a collective" in proc.stdout
+    # detection came from the quorum tripwire, not a host timeout
+    assert "quorum tripwire: heartbeat stale" in blob
+    # both ranks recovered in-process; the launcher never saw a failure
+    assert proc.stdout.count("ret=done@1") == 2
+    assert "worker failure detected" not in proc.stderr
+    # the at-abort fingerprint verdict names the op and the lagging rank
+    assert "abort fingerprint verdict" in blob
+    assert "unified_allreduce" in blob
+    verdict_lines = [
+        l for l in blob.splitlines() if "abort fingerprint verdict" in l
+    ]
+    assert any("culprits=[1]" in l for l in verdict_lines), verdict_lines[:5]
 
 
 def test_outer_fault_escalates_to_launcher(tmp_path):
@@ -105,3 +153,9 @@ def test_wedged_device_call_hard_killed_and_ring_recovers(tmp_path):
     assert proc.stdout.count("cycle=1 ret=done@0") == 2
     # the nested-restarter protocol surfaced the recovery attempt
     assert "[NestedRestarter] name=[InProcess] state=handling_start" in blob
+    # the abort ladder still ran on the wedged rank (its monitor THREAD is
+    # schedulable even while the main thread is stuck in C) and published
+    # the at-abort fingerprint before the hard-kill; the in-flight-op
+    # verdict itself is covered by the stall scenario, where a survivor
+    # runs the restart path (here rank 0 completed before the escalation)
+    assert "abort ladder: fingerprint=released" in blob

@@ -64,14 +64,18 @@ def test_no_reraise_escapes_after_quiesce(ops):
     )
     mon.start()
     try:
-        ops.record_interruption(
-            0,
-            InterruptionRecord(
-                rank=0, interruption=Interruption.EXCEPTION, message="inj"
-            ),
-        )
         caught = False
         try:
+            # the record write sits INSIDE the try: on a loaded 1-core host
+            # the monitor can complete its whole trip while this thread is
+            # still parked in the append's syscall, landing the raise on
+            # the append's own return bytecode
+            ops.record_interruption(
+                0,
+                InterruptionRecord(
+                    rank=0, interruption=Interruption.EXCEPTION, message="inj"
+                ),
+            )
             _busy_bytecode(5.0)
         except RankShouldRestart:
             caught = True

@@ -23,7 +23,7 @@ def test_soak_smoke_chaos_store_and_quorum():
             sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
             "--seconds", "50", "--chaos-store", "--quorum",
             "--store-kill-every", "18", "28",
-            "--exc-p", "0.02", "--qstall-p", "0.012",
+            "--exc-p", "0.02", "--qstall-p", "0.012", "--cwedge-p", "0.008",
             # generous bounds: this is a loaded 1-core CI host; the gate run
             # uses the defaults
             "--inner-bound-ms", "15000", "--outer-bound-ms", "60000",
@@ -39,8 +39,41 @@ def test_soak_smoke_chaos_store_and_quorum():
     assert report["monotone_progress"], report
     # both rings actually exercised
     assert report["inner_ring_recoveries"] >= 1, report
+    # the abort ladder ran on inner trips with recorded stage outcomes
+    assert report["ladder_ok"], report
+    if report["inner_ring_recoveries"]:
+        assert report["abort_stage_outcomes"].get(
+            "fingerprint/released", 0
+        ) >= 1, report
     total_outer_faults = (
         report["injected"]["crashes"] + report["injected"]["hangs"]
     )
     if total_outer_faults:
         assert report["cycles"] >= 1, report
+
+
+def test_soak_smoke_store_outage_mid_save():
+    """The store-outage-mid-save fault class: targeted store kills inside
+    rank 0's store-backed save windows; the unified retry policy must ride
+    the save through the outage (saves_done tracks saves_started)."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "55", "--store-kill-mid-save",
+            "--save-every", "30", "--store-down", "2.0",
+            # isolate the fault class: no random worker faults
+            "--exc-p", "0", "--crash-p", "0", "--hang-p", "0",
+            "--qstall-p", "0", "--cwedge-p", "0",
+            "--inner-bound-ms", "15000", "--outer-bound-ms", "60000",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["saves_started"] >= 1, report
+    assert report["saves_ok"], report
+    assert report["store_kills"] >= 1, report
+    assert report["monotone_progress"], report

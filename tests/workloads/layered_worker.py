@@ -8,14 +8,27 @@ through to the launcher ring.
 Scenario (env LAYERED_SCENARIO):
   inner  — rank 1 raises at wrapper-iteration 0; the in-process ring recovers
            it; the LAUNCHER must see zero worker failures (cycle stays 0).
+           With TPURX_SHRINK_MESH=1 the abort ladder's ShrinkMeshStage runs
+           on the recovery path (no distributed client here, so it releases
+           by clearing caches+backends) — the opt-in rung end to end.
   outer  — rank 1 hard-exits; the in-process ring cannot save a dead process;
            its launcher respawns it and the wrapper group re-forms.
+  stall  — the wedged-COLLECTIVE case the abort ladder absorbs in-process:
+           both ranks record a dispatch of ``unified_allreduce`` every step
+           (the at-abort fingerprint feed); rank 1 stops beating mid-run (a
+           ping-less wait, how a rank parked on a missing participant
+           presents when the interpreter still runs).  The armed quorum
+           tripwire records QUORUM_STALE, every rank's ladder publishes its
+           dispatch tail, the trace-analyzer verdict names the in-flight op
+           and the lagging rank, and the ring restarts in-process — the
+           launcher never sees a failure.
   wedged — rank 1 blocks forever inside a DEVICE program (a jit'd infinite
            while_loop: stuck in PJRT C++ with the GIL released — how a
            collective with a missing participant presents to Python).  The
            async raise cannot land, pings and the watchdog's pending-call
            auto-stamps freeze, so the exec'd monitor process records
-           SOFT_TIMEOUT and then hard-kills at the hard timeout; the
+           SOFT_TIMEOUT (folding in the rank's dispatch tail read from shm
+           post-mortem) and then hard-kills at the hard timeout; the
            launcher ring re-rendezvouses.  Reference layered contract:
            ``inprocess/monitor_process.py:269-288`` (GIL-released hang ->
            kill) + ``inprocess/nested_restarter.py:36-107``.
@@ -29,12 +42,38 @@ sys.path.insert(0, os.environ.get("TPURX_REPO", "/root/repo"))
 
 from tpu_resiliency.fault_tolerance import FaultToleranceConfig, RankMonitorClient
 from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
-from tpu_resiliency.inprocess import ShiftRanks, Wrapper
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper, record_dispatch
 from tpu_resiliency.inprocess.nested_restarter import NestedRestarterCallback
 
 RANK = int(os.environ["TPURX_RANK"])
 CYCLE = int(os.environ["TPURX_CYCLE"])
 SCENARIO = os.environ.get("LAYERED_SCENARIO", "inner")
+# inner/stall recover IN-PROCESS: the healthy rank must not be able to
+# complete the whole fn before the trip -> abort ladder -> restart raise
+# lands on a loaded host (completion would legitimately end the job at
+# iteration 0).  wedged/outer DEPEND on the short run: rank 0 finishing
+# cycle 0 quickly is part of those scenarios' choreography.
+STEPS = int(os.environ.get("LAYERED_STEPS")
+            or (120 if SCENARIO in ("inner", "stall") else 40))
+
+quorum_kw = {}
+if SCENARIO == "stall":
+    # the stall is detected by the on-device quorum tripwire (manual beats:
+    # ping() IS the progress signal, so a ping-less rank reads as stale)
+    import jax
+    import numpy as np
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh
+
+    quorum_kw = dict(
+        quorum_mesh=Mesh(np.array(jax.devices()), ("d",)),
+        quorum_budget_ms=500.0,
+        quorum_interval=0.05,
+        quorum_auto_beat_interval=None,
+        quorum_calibrate=False,
+    )
 
 client = RankMonitorClient(
     FaultToleranceConfig(
@@ -58,15 +97,18 @@ bridge = NestedRestarterCallback(client)
     monitor_thread_interval=0.1,
     heartbeat_interval=0.2,
     sibling_timeout=3.0,
+    **quorum_kw,
 )
 def train(call_wrapper=None):
     it = call_wrapper.iteration
     state = call_wrapper.state
     print(f"train rank={state.active_rank} world={state.active_world_size} "
           f"iter={it} cycle={CYCLE}", flush=True)
-    for step in range(40):
+    for step in range(STEPS):
         call_wrapper.ping()
         client.send_heartbeat()
+        # at-abort fingerprint feed: the step's collective, at dispatch
+        record_dispatch("unified_allreduce")
         time.sleep(0.05)
         if CYCLE == 0 and it == 0 and RANK == 1 and step == 5:
             if SCENARIO == "inner":
@@ -74,6 +116,13 @@ def train(call_wrapper=None):
             if SCENARIO == "outer":
                 print("outer fault: dying for real", flush=True)
                 os._exit(29)
+            if SCENARIO == "stall":
+                print("stalling: parked on a collective, no beats", flush=True)
+                # a ping-less wait: the interpreter still runs (the restart
+                # raise can land) but progress beats stop — the quorum
+                # tripwire must name this rank from the pod-wide age reduce
+                while True:
+                    time.sleep(0.02)
             if SCENARIO == "wedged":
                 print("wedging in a device program", flush=True)
                 import jax
@@ -88,6 +137,9 @@ def train(call_wrapper=None):
                         lambda c: jnp.bool_(True), lambda c: c + 1, x
                     )
                 )
+                # the dispatch lands in the shm tail BEFORE the block: the
+                # monitor process reads it post-mortem for the fingerprint
+                record_dispatch("spin_forever")
                 # never returns: the main thread is blocked inside the PJRT
                 # runtime with the GIL released — pings and pending-call
                 # stamps freeze, async raises cannot land

@@ -166,3 +166,98 @@ def analyze_markers(
         summary=f"all ranks at step {quorum_step}", evidence=evidence,
         should_resume=True,
     )
+
+
+def analyze_fingerprints(
+    tails: Dict[int, Optional[list]],
+    min_lag_ms: float = 400.0,
+) -> AttributionResult:
+    """Name the wedged collective and the lagging rank from at-abort
+    dispatch-tail fingerprints (``{rank: [{"op", "age_ms", "seq"}, ...]}``,
+    as gathered by ``InprocStore.get_fingerprints``).
+
+    The SPMD reading of a wedged collective: every healthy rank dispatched
+    the same program and then *stopped dispatching* — parked inside it
+    waiting for the laggard — so their newest entries share an op name with
+    comparable ages; the culprit either stopped dispatching at least
+    ``min_lag_ms`` before the freshest peer (an absolute gap: detection
+    latency separates the laggard's last dispatch from its peers', and the
+    gap *grows* with host slowness, so the rule is timing-robust) or never
+    reached the op at all (a different newest op, or no tail published —
+    died/wedged before the dump).
+
+    This is the consumer half of the reference's Flight-Recorder pipeline
+    (``attribution/trace_analyzer/fr_attribution.py``): dump at abort,
+    attribute from the dumps.
+    """
+    present = {r: t for r, t in tails.items() if t}
+    missing = sorted(r for r, t in tails.items() if not t)
+    if not present:
+        return AttributionResult(
+            category="no_data", confidence=0.2, culprit_ranks=missing,
+            summary="no rank published an at-abort fingerprint",
+            should_resume=True,
+        )
+    newest = {r: max(t, key=lambda e: e.get("seq", 0)) for r, t in present.items()}
+    ops = Counter(e.get("op", "?") for e in newest.values())
+    wedged_op, op_votes = ops.most_common(1)[0]
+    evidence = [
+        f"r{r}: last_op={e.get('op', '?')} age={e.get('age_ms', 0)}ms "
+        f"seq={e.get('seq', 0)}"
+        for r, e in sorted(newest.items())
+    ][:32]
+    # ranks that never reached the quorum op
+    divergent = sorted(
+        r for r, e in newest.items() if e.get("op", "?") != wedged_op
+    )
+    in_op = {r: e for r, e in newest.items() if e.get("op", "?") == wedged_op}
+    ages = sorted(float(e.get("age_ms", 0)) for e in in_op.values())
+    base_age = ages[0] if ages else 0.0
+    laggards = sorted(
+        r for r, e in in_op.items()
+        if float(e.get("age_ms", 0)) - base_age >= min_lag_ms
+    )
+    if missing and op_votes >= max(1, len(present)):
+        return AttributionResult(
+            category="wedged_collective", confidence=0.85,
+            culprit_ranks=missing,
+            summary=(
+                f"in-flight op '{wedged_op}': ranks {missing} published no "
+                "fingerprint (wedged in the device call or dead) while "
+                f"{sorted(in_op)} are parked in it"
+            ),
+            evidence=evidence, should_resume=True,
+        )
+    if divergent:
+        return AttributionResult(
+            category="wedged_collective", confidence=0.8,
+            culprit_ranks=divergent,
+            summary=(
+                f"in-flight op '{wedged_op}': ranks {divergent} never "
+                f"dispatched it (last ops "
+                f"{[newest[r].get('op') for r in divergent]}) — peers are "
+                "blocked waiting for them"
+            ),
+            evidence=evidence, should_resume=True,
+        )
+    if laggards and len(in_op) > len(laggards):
+        return AttributionResult(
+            category="wedged_collective", confidence=0.85,
+            culprit_ranks=laggards,
+            summary=(
+                f"in-flight op '{wedged_op}': ranks {laggards} stopped "
+                f"dispatching >= {min_lag_ms:.0f}ms before the freshest "
+                f"peer ({base_age:.0f}ms) — the lagging ranks peers are "
+                "stuck on"
+            ),
+            evidence=evidence, should_resume=True,
+        )
+    return AttributionResult(
+        category="collective_stall", confidence=0.5,
+        culprit_ranks=missing,
+        summary=(
+            f"all ranks last dispatched '{wedged_op}' with comparable ages "
+            "— pod-wide stall, no single laggard distinguishable"
+        ),
+        evidence=evidence, should_resume=True,
+    )

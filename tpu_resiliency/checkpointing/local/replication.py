@@ -23,8 +23,16 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...utils.logging import get_logger
+from ...utils.retry import RetryPolicy, Retrier
 
 log = get_logger("local_ckpt.replication")
+
+# Replication sends ride the shared retry policy: a clique peer mid-restart
+# (new port published after its in-process recovery) must be re-resolved and
+# redialed, not declared lost.  Resends are safe — the receive inbox is
+# keyed by (sender, tag) and overwrites, so duplicate delivery is idempotent.
+SEND_POLICY = RetryPolicy(max_attempts=5, base_delay=0.2, max_delay=2.0,
+                          deadline=60.0)
 
 _U64 = struct.Struct("<Q")
 _TAG = struct.Struct("<I")
@@ -146,10 +154,23 @@ class PeerExchange:
         return host, int(port)
 
     def send(self, to_rank: int, tag: int, payload: bytes, timeout: float = 60.0) -> None:
-        host, port = self._peer_addr(to_rank, timeout)
-        with socket.create_connection((host, port), timeout=timeout) as conn:
-            conn.sendall(_U64.pack(self.rank) + _U64.pack(len(payload)) + _TAG.pack(tag))
-            conn.sendall(payload)
+        retrier = Retrier("replication_send",
+                          SEND_POLICY.with_(deadline=timeout))
+        while True:
+            try:
+                # re-resolve per attempt: a restarted peer republishes its
+                # address, and redialing the dead port forever is the exact
+                # divergent-loop behavior the unified policy replaces
+                host, port = self._peer_addr(to_rank, timeout)
+                with socket.create_connection((host, port), timeout=timeout) as conn:
+                    conn.sendall(
+                        _U64.pack(self.rank) + _U64.pack(len(payload))
+                        + _TAG.pack(tag)
+                    )
+                    conn.sendall(payload)
+                return
+            except OSError as exc:
+                retrier.backoff(exc)
 
     def recv(self, from_rank: int, tag: int, timeout: float = 60.0) -> bytes:
         deadline = time.monotonic() + timeout

@@ -25,6 +25,7 @@ import socket
 from typing import Optional
 
 from ..telemetry import counter
+from ..utils.retry import PROBE_POLICY, RetryExhausted, retry_call
 from .base import HealthCheck, HealthCheckResult
 
 ENDPOINT_ENV = "TPURX_NODE_HEALTH_ENDPOINT"
@@ -48,10 +49,15 @@ class NodeHealthDaemonCheck(HealthCheck):
         endpoint: Optional[str] = None,
         timeout: float = 5.0,
         required: bool = False,
+        retry_policy=PROBE_POLICY,
     ):
         self.endpoint = endpoint
         self.timeout = timeout
         self.required = required
+        # a transiently-restarting daemon (node-problem-detector rolling
+        # update) must not read as an unreachable one: probes go through the
+        # shared retry policy, so attempts are telemetry-visible per site
+        self.retry_policy = retry_policy
 
     def _resolve(self) -> Optional[str]:
         return self.endpoint or os.environ.get(ENDPOINT_ENV) or None
@@ -74,7 +80,15 @@ class NodeHealthDaemonCheck(HealthCheck):
                 return HealthCheckResult(False, "no node-health daemon endpoint")
             return HealthCheckResult(True, "no node-health daemon configured (skipped)")
         try:
-            sock = self._connect(target)
+            sock = retry_call(
+                self._connect, target,
+                site="health_daemon_probe", policy=self.retry_policy,
+                retry_on=(OSError,),
+            )
+        except RetryExhausted as exc:
+            _DAEMON_UNREACHABLE.inc()
+            msg = f"health daemon {target} unreachable: {exc.last_exc}"
+            return HealthCheckResult(not self.required, msg)
         except ValueError:
             # malformed endpoint ('unix:/x', missing port): a config mistake,
             # reported under the same required semantics as unreachability —
@@ -82,13 +96,6 @@ class NodeHealthDaemonCheck(HealthCheck):
             return HealthCheckResult(
                 not self.required, f"bad health daemon endpoint {target!r}"
             )
-        except OSError as exc:
-            # unreachable daemon: the reference treats this as a failed check
-            # only when required; otherwise degraded observability, not a
-            # node failure
-            _DAEMON_UNREACHABLE.inc()
-            msg = f"health daemon {target} unreachable: {exc}"
-            return HealthCheckResult(not self.required, msg)
         try:
             sock.sendall(json.dumps({"query": "node_health"}).encode() + b"\n")
             buf = b""

@@ -9,14 +9,29 @@ rings; composes under the in-job launcher ring (SURVEY.md §1).
     def train(call_wrapper=None): ...
 
 TPU re-design notes: the reference's NCCL ``backend.abort()`` has no JAX
-equivalent — the Abort stage here cancels *our* auxiliary engines (checkpoint
-workers, peer exchanges, quorum monitors) and drops compiled-call caches;
-in-flight XLA collectives are bounded by the monitor process's hard-timeout
-kill (a wedged device program cannot be cancelled from Python — the kill ring
-below this one handles it, which is exactly how the rings compose).
+equivalent — Abort here is a staged, measured *ladder* (:class:`AbortLadder`):
+each rung (fingerprint dump, auxiliary-engine teardown, opt-in in-process
+mesh-shrink, cache clear) has its own deadline and a recorded outcome
+(released / timed_out / escalate), and in-flight XLA collectives that no
+rung can release are bounded by the monitor process's hard-timeout kill —
+the backstop below the bottom rung, which is exactly how the rings compose.
 """
 
+from .abort import (
+    AbortCheckpointWorkers,
+    AbortLadder,
+    AbortPeerExchange,
+    AbortQuorumMonitor,
+    AbortStage,
+    ClearJaxCaches,
+    EscalateAbort,
+    FingerprintStage,
+    ShrinkMeshStage,
+    StageResult,
+    default_ladder,
+)
 from .attribution import Interruption, InterruptionRecord
+from .fingerprint import DispatchTail, record_dispatch, snapshot_tail
 from .compose import Compose
 from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
 from .health_check import DeviceProbeHealthCheck, FaultCounterExceeded, FaultCounter
@@ -53,6 +68,20 @@ __all__ = [
     "RankShouldRestart",
     "RestartAbort",
     "HealthCheckError",
+    "AbortLadder",
+    "AbortStage",
+    "StageResult",
+    "EscalateAbort",
+    "FingerprintStage",
+    "ShrinkMeshStage",
+    "AbortCheckpointWorkers",
+    "AbortPeerExchange",
+    "AbortQuorumMonitor",
+    "ClearJaxCaches",
+    "default_ladder",
+    "DispatchTail",
+    "record_dispatch",
+    "snapshot_tail",
     "Compose",
     "MonitorThread",
     "MonitorProcess",

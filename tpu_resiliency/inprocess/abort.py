@@ -1,73 +1,423 @@
-"""Abort plugins: tear down auxiliary engines before a restart.
+"""The staged abort ladder: ordered, measured teardown before a restart.
 
 Reference analog: ``inprocess/abort.py`` — ``AbortTorchDistributed`` aborts
-every NCCL backend in parallel threads.  JAX exposes no collective-abort API
-(SURVEY.md §7 hard part (a)), and in-flight XLA programs cannot be cancelled
-from Python; the design consequence is explicit: the **monitor process's
-hard-timeout kill is the backstop** for wedged device programs, and the
-in-process Abort stage handles what Python *can* release:
+every NCCL backend in parallel threads.  JAX exposes no collective-abort
+API (SURVEY.md §7 hard part (a)) and in-flight XLA programs cannot be
+cancelled from Python, so recovery here is a *degradation ladder* selected
+at fault time from the cheapest viable tier (the Chameleon argument,
+PAPERS.md): each rung is an :class:`AbortStage` with its own deadline and a
+recorded outcome, and the monitor process's hard-timeout kill remains the
+backstop below the bottom rung.
 
+Stage outcomes (telemetry ``tpurx_abort_stage_outcomes_total{stage,outcome}``):
+
+- ``released``  — the stage freed its resources within its deadline;
+- ``timed_out`` — the stage was still blocked at its deadline (its worker
+  thread is abandoned; the monitor-kill backstop covers whatever it held);
+- ``failed``    — the stage raised (logged, ladder continues);
+- ``escalate``  — the stage determined in-process recovery cannot proceed
+  (``EscalateAbort``); remaining rungs are skipped and the fault falls
+  through to the monitor-kill → launcher ring;
+- ``skipped``   — gated off (``applicable()`` false, or after an escalate).
+
+Built-in rungs:
+
+- :class:`FingerprintStage` — publish this rank's dispatch-tail fingerprint
+  (last K dispatched device programs + ages) to the store for attribution —
+  the at-abort analog of the reference's Flight-Recorder dump
+  (``abort.py:127-160``).  Always first: later rungs may block.
 - :class:`AbortCheckpointWorkers` — kill persistent async-ckpt writers
   (reference ``AbortPersistentCheckpointProcesses`` ``:194``).
 - :class:`AbortPeerExchange` — close local-ckpt replication sockets.
 - :class:`AbortQuorumMonitor` — stop the device-quorum tick thread (it would
   otherwise keep dispatching collectives into a broken mesh).
+- :class:`ShrinkMeshStage` — **opt-in, measured**: tear down the
+  ``jax.distributed`` client in-process so the next iteration can re-init
+  over the surviving hosts (see ``benchmarks/mesh_shrink_experiment.py``
+  and the per-JAX-version result matrix in ``docs/inprocess.md``).  A
+  wedged runtime can block the shutdown past any Python control — hence
+  the hard per-stage deadline with automatic fallback to the backstop.
 - :class:`ClearJaxCaches` — drop compiled-executable caches so the next
   iteration re-traces against the new topology when world size changed.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 
 log = get_logger("inproc.abort")
 
+_STAGE_OUTCOMES = counter(
+    "tpurx_abort_stage_outcomes_total",
+    "Abort-ladder stage outcomes per restart",
+    labels=("stage", "outcome"),
+)
+_STAGE_NS = histogram(
+    "tpurx_abort_stage_latency_ns",
+    "Abort-ladder per-stage wall time",
+    labels=("stage",),
+)
+_LADDER_RUNS = counter(
+    "tpurx_abort_ladder_runs_total", "Abort-ladder executions"
+)
 
-class AbortCheckpointWorkers:
-    def __init__(self, *queues):
-        self.queues = queues
+
+class EscalateAbort(Exception):
+    """Raised by a stage to declare in-process recovery non-viable; the
+    ladder stops and the fault falls through to the monitor-kill backstop."""
+
+
+RELEASED = "released"
+TIMED_OUT = "timed_out"
+FAILED = "failed"
+ESCALATE = "escalate"
+SKIPPED = "skipped"
+
+
+@dataclasses.dataclass
+class StageResult:
+    stage: str
+    outcome: str
+    duration_ms: float
+    detail: str = ""
+
+    def brief(self) -> str:
+        return f"{self.stage}={self.outcome}({self.duration_ms:.1f}ms)"
+
+
+class AbortStage:
+    """One rung of the ladder.  Subclasses override :meth:`release` (and
+    optionally :meth:`applicable`).  Stages stay plain callables too, so a
+    bare stage still composes with ``Compose`` and the ``abort=`` plugin
+    slot exactly like the pre-ladder classes did."""
+
+    name = "stage"
+    timeout: float = 5.0
+
+    def __init__(self, timeout: Optional[float] = None):
+        if timeout is not None:
+            self.timeout = timeout
+
+    def applicable(self, state=None) -> bool:
+        return True
+
+    def release(self, state=None) -> Optional[str]:
+        """Free resources; return an optional human detail string."""
+        raise NotImplementedError
 
     def __call__(self, state=None):
+        self.release(state)
+        return state
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(name={self.name!r}, timeout={self.timeout})"
+
+
+class FnStage(AbortStage):
+    """Adapter wrapping a plain ``fn(state)`` plugin as a ladder rung."""
+
+    def __init__(self, fn: Callable, name: Optional[str] = None,
+                 timeout: Optional[float] = None):
+        super().__init__(timeout)
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", None) or type(fn).__name__
+
+    def release(self, state=None) -> Optional[str]:
+        self.fn(state)
+        return None
+
+
+def as_stage(obj, timeout: Optional[float] = None) -> AbortStage:
+    if isinstance(obj, AbortStage):
+        return obj
+    return FnStage(obj, timeout=timeout)
+
+
+class AbortLadder:
+    """Ordered, per-stage-deadlined abort pipeline with recorded outcomes.
+
+    Plugin-compatible: pass an instance as ``Wrapper(abort=...)``.  Each
+    stage runs in a worker thread joined at its deadline — Python cannot
+    cancel the thread, so a timed-out stage is *abandoned* (outcome
+    recorded; the monitor-kill backstop owns whatever it was holding) and
+    the ladder proceeds to the next rung.  ``last_results`` keeps the most
+    recent run for the restart loop's telemetry/logging.
+    """
+
+    def __init__(self, *stages, name: str = "abort"):
+        flat: List[AbortStage] = []
+        for s in stages:
+            # a Compose chain contributed as one argument flattens into rungs
+            inner = getattr(s, "fns", None)
+            if inner is not None and not isinstance(s, AbortStage):
+                flat.extend(as_stage(f) for f in inner)
+            else:
+                flat.append(as_stage(s))
+        self.stages = flat
+        self.name = name
+        self.last_results: List[StageResult] = []
+        self._lock = threading.Lock()
+
+    def _run_stage(self, stage: AbortStage, state) -> StageResult:
+        box = {}
+
+        def body():
+            try:
+                box["detail"] = stage.release(state) or ""
+            except EscalateAbort as exc:
+                box["escalate"] = str(exc)
+            except BaseException as exc:  # noqa: BLE001 - recorded, not fatal
+                box["error"] = exc
+
+        t0 = time.monotonic_ns()
+        worker = threading.Thread(
+            target=body, name=f"tpurx-abort-{stage.name}", daemon=True
+        )
+        worker.start()
+        worker.join(timeout=stage.timeout)
+        dur_ms = (time.monotonic_ns() - t0) / 1e6
+        if worker.is_alive():
+            return StageResult(stage.name, TIMED_OUT, dur_ms,
+                               f"still blocked at {stage.timeout}s deadline")
+        if "escalate" in box:
+            return StageResult(stage.name, ESCALATE, dur_ms, box["escalate"])
+        if "error" in box:
+            log.error("abort stage %s failed: %r", stage.name, box["error"])
+            return StageResult(stage.name, FAILED, dur_ms, repr(box["error"]))
+        return StageResult(stage.name, RELEASED, dur_ms, box.get("detail", ""))
+
+    def __call__(self, state=None):
+        with self._lock:  # one abort episode at a time per wrapper
+            _LADDER_RUNS.inc()
+            results: List[StageResult] = []
+            escalated = False
+            for stage in self.stages:
+                t0 = time.monotonic_ns()
+                if escalated or not self._applicable(stage, state):
+                    res = StageResult(stage.name, SKIPPED, 0.0,
+                                      "after escalate" if escalated else "gated off")
+                else:
+                    res = self._run_stage(stage, state)
+                    _STAGE_NS.labels(stage.name).observe(
+                        time.monotonic_ns() - t0
+                    )
+                    if res.outcome == ESCALATE:
+                        escalated = True
+                _STAGE_OUTCOMES.labels(stage.name, res.outcome).inc()
+                results.append(res)
+            self.last_results = results
+            log.warning("abort ladder: %s", self.summary(results))
+            return state
+
+    @staticmethod
+    def _applicable(stage: AbortStage, state) -> bool:
+        try:
+            return bool(stage.applicable(state))
+        except Exception:  # noqa: BLE001 - a broken gate must not stall the ladder
+            log.exception("abort stage %s applicable() failed; running it",
+                          stage.name)
+            return True
+
+    def take_results(self) -> List[StageResult]:
+        """Drain the latest run's results exactly once (blocks until an
+        in-flight run finishes — bounded by the stages' own deadlines)."""
+        with self._lock:
+            out, self.last_results = self.last_results, []
+            return out
+
+    def summary(self, results: Optional[List[StageResult]] = None) -> str:
+        results = self.last_results if results is None else results
+        return " ".join(r.brief() for r in results) or "(empty)"
+
+    def __repr__(self) -> str:
+        return f"AbortLadder({', '.join(s.name for s in self.stages)})"
+
+
+# -- built-in rungs ---------------------------------------------------------
+
+
+class FingerprintStage(AbortStage):
+    """Publish this rank's dispatch-tail fingerprint to the store so the
+    trace analyzer can name the in-flight collective and the lagging rank
+    (reference: FR dump at abort, ``abort.py:127-160``)."""
+
+    name = "fingerprint"
+    timeout = 2.0
+
+    def __init__(self, ops=None, rank: Optional[int] = None,
+                 iteration_fn: Optional[Callable[[], int]] = None,
+                 timeout: Optional[float] = None):
+        super().__init__(timeout)
+        self.ops = ops
+        self.rank = rank
+        self.iteration_fn = iteration_fn
+
+    def applicable(self, state=None) -> bool:
+        return self.ops is not None and self.rank is not None
+
+    def release(self, state=None) -> Optional[str]:
+        from .fingerprint import snapshot_tail
+
+        tail = snapshot_tail()
+        iteration = (
+            self.iteration_fn() if self.iteration_fn is not None
+            else getattr(state, "iteration", 0) or 0
+        )
+        self.ops.record_fingerprint(iteration, self.rank, tail)
+        return f"{len(tail)} entries"
+
+
+class AbortCheckpointWorkers(AbortStage):
+    name = "ckpt_workers"
+    timeout = 10.0
+
+    def __init__(self, *queues, timeout: Optional[float] = None):
+        super().__init__(timeout)
+        self.queues = queues
+
+    def release(self, state=None) -> Optional[str]:
+        n = 0
         for q in self.queues:
             try:
                 q.abort()
+                n += 1
             except Exception:  # noqa: BLE001
                 log.exception("failed aborting checkpoint queue")
-        return state
+        return f"{n}/{len(self.queues)} queues"
 
 
-class AbortPeerExchange:
-    def __init__(self, *exchanges):
+class AbortPeerExchange(AbortStage):
+    name = "peer_exchange"
+    timeout = 5.0
+
+    def __init__(self, *exchanges, timeout: Optional[float] = None):
+        super().__init__(timeout)
         self.exchanges = exchanges
 
-    def __call__(self, state=None):
+    def release(self, state=None) -> Optional[str]:
+        n = 0
         for ex in self.exchanges:
             try:
                 ex.close()
+                n += 1
             except Exception:  # noqa: BLE001
                 log.exception("failed closing peer exchange")
-        return state
+        return f"{n}/{len(self.exchanges)} exchanges"
 
 
-class AbortQuorumMonitor:
-    def __init__(self, *monitors):
+class AbortQuorumMonitor(AbortStage):
+    name = "quorum_monitor"
+    timeout = 8.0
+
+    def __init__(self, *monitors, timeout: Optional[float] = None):
+        super().__init__(timeout)
         self.monitors = monitors
 
-    def __call__(self, state=None):
+    def release(self, state=None) -> Optional[str]:
+        n = 0
         for m in self.monitors:
             try:
                 m.stop()
+                n += 1
             except Exception:  # noqa: BLE001
                 log.exception("failed stopping quorum monitor")
-        return state
+        return f"{n}/{len(self.monitors)} monitors"
 
 
-class ClearJaxCaches:
-    def __call__(self, state=None):
+class ShrinkMeshStage(AbortStage):
+    """Opt-in, measured in-process mesh-shrink (SURVEY §7(a)).
+
+    Tears down the ``jax.distributed`` client and compiled caches *inside
+    the process* so the next restart iteration can re-init at the surviving
+    world size without a respawn.  Whether the re-init half actually works
+    is a per-JAX-version property — measured by
+    ``benchmarks/mesh_shrink_experiment.py`` and recorded in
+    ``docs/inprocess.md`` — so this rung is gated:
+
+    - opt-in via constructor or ``TPURX_SHRINK_MESH=1``;
+    - a hard ``timeout`` (a wedged runtime can block ``shutdown()`` in C++
+      past any Python control) after which the outcome records
+      ``timed_out`` and the fault falls through to the monitor-kill
+      backstop — the ladder's automatic fallback, exercised by
+      ``tests/test_layered_restart.py``.
+    """
+
+    name = "shrink_mesh"
+    timeout = 20.0
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 timeout: Optional[float] = None):
+        super().__init__(timeout)
+        if enabled is None:
+            enabled = os.environ.get("TPURX_SHRINK_MESH", "0") == "1"
+        self.enabled = enabled
+
+    def applicable(self, state=None) -> bool:
+        return self.enabled
+
+    def release(self, state=None) -> Optional[str]:
+        import jax
+        from jax._src import distributed as jax_dist
+
+        detail = []
+        state_obj = getattr(jax_dist, "global_state", None)
+        initialized = (
+            state_obj is not None
+            and getattr(state_obj, "client", None) is not None
+        )
+        if initialized:
+            jax.distributed.shutdown()
+            detail.append("distributed client shut down")
+        else:
+            detail.append("no distributed client")
+        jax.clear_caches()
+        # the full reset (measured by benchmarks/mesh_shrink_experiment.py):
+        # clearing compiled caches is NOT enough — jax.distributed refuses
+        # re-init while backends are live, so the backends must go too
         try:
-            import jax
+            import jax.extend.backend as jeb  # lazy submodule
 
-            jax.clear_caches()
-        except Exception:  # noqa: BLE001
-            log.exception("jax.clear_caches failed")
-        return state
+            jeb.clear_backends()
+            detail.append("caches+backends cleared")
+        except Exception as exc:  # noqa: BLE001 - version-dependent API
+            detail.append(f"caches cleared (clear_backends: {exc!r})")
+        # reset the bootstrap helper so the next iteration's initialize
+        # plugin may re-init at the surviving world size
+        try:
+            from ..parallel import distributed as dist_mod
+
+            dist_mod._initialized = False
+        except Exception:  # noqa: BLE001 - helper is optional
+            pass
+        return "; ".join(detail)
+
+
+class ClearJaxCaches(AbortStage):
+    name = "jax_caches"
+    timeout = 5.0
+
+    def release(self, state=None) -> Optional[str]:
+        import jax
+
+        jax.clear_caches()
+        return None
+
+
+def default_ladder(ops=None, rank: Optional[int] = None,
+                   iteration_fn: Optional[Callable[[], int]] = None,
+                   *extra_stages) -> AbortLadder:
+    """The standard rung order: fingerprint first (later rungs may block),
+    engine teardown, opt-in mesh-shrink, cache clear."""
+    return AbortLadder(
+        FingerprintStage(ops, rank, iteration_fn),
+        *extra_stages,
+        ShrinkMeshStage(),
+        ClearJaxCaches(),
+    )

@@ -23,16 +23,22 @@ class InterruptionRecord:
     interruption: Interruption
     message: str = ""
     origin_rank: int = -1   # who recorded it (-1 = self)
+    # at-abort collective fingerprint: the rank's last K dispatched device
+    # programs + ages ([{"op", "age_ms", "seq"}, ...]); attached by the
+    # faulting rank itself, or post-mortem by its monitor process when the
+    # rank is wedged in a device call (see inprocess/fingerprint.py)
+    fingerprint: list = dataclasses.field(default_factory=list)
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "rank": self.rank,
-                "interruption": self.interruption.value,
-                "message": self.message,
-                "origin_rank": self.origin_rank,
-            }
-        )
+        d = {
+            "rank": self.rank,
+            "interruption": self.interruption.value,
+            "message": self.message,
+            "origin_rank": self.origin_rank,
+        }
+        if self.fingerprint:
+            d["fingerprint"] = self.fingerprint
+        return json.dumps(d)
 
     @classmethod
     def from_json(cls, raw) -> "InterruptionRecord":
@@ -42,4 +48,5 @@ class InterruptionRecord:
             interruption=Interruption(d["interruption"]),
             message=d.get("message", ""),
             origin_rank=d.get("origin_rank", -1),
+            fingerprint=d.get("fingerprint", []),
         )

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Iterator
 
 
 class Compose:
@@ -10,15 +10,36 @@ class Compose:
 
     (The reference applies rightmost-first for its ABC chains; here the
     pipeline reads in execution order, which is what every call site wants.)
+
+    Nested ``Compose`` instances flatten, and the chain is iterable — so an
+    :class:`~tpu_resiliency.inprocess.abort.AbortLadder` built from a
+    ``Compose`` argument sees the individual plugins as rungs (each gets its
+    own deadline and recorded outcome) instead of one opaque callable.
+
+    For the ``abort=`` plugin slot specifically, prefer ``AbortLadder``
+    directly: ``Compose`` runs plugins inline with no per-stage timeout, so
+    one blocked plugin stalls the whole chain.
     """
 
     def __init__(self, *fns: Callable):
-        self.fns = fns
+        flat: list = []
+        for fn in fns:
+            if isinstance(fn, Compose):
+                flat.extend(fn.fns)
+            else:
+                flat.append(fn)
+        self.fns = tuple(flat)
 
     def __call__(self, arg):
         for fn in self.fns:
             arg = fn(arg)
         return arg
+
+    def __iter__(self) -> Iterator[Callable]:
+        return iter(self.fns)
+
+    def __len__(self) -> int:
+        return len(self.fns)
 
     def __repr__(self) -> str:
         return f"Compose({', '.join(repr(f) for f in self.fns)})"

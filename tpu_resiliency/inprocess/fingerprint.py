@@ -1,0 +1,223 @@
+"""At-abort collective fingerprint: the last K dispatched device programs.
+
+Reference analog: NVRx dumps PyTorch Flight-Recorder NCCL traces at abort
+time (``inprocess/abort.py:127-160``, ``TORCH_FR_BUFFER_SIZE``) so
+attribution sees *which collective* was in flight.  JAX has no per-collective
+recorder, but the dispatch boundary is observable from Python: every
+instrumented jitted call records its name + dispatch stamp into a tiny ring
+(the :class:`DispatchTail`), and at abort each rank publishes the tail —
+op names plus ages — to the store for
+:func:`tpu_resiliency.attribution.trace_analyzer.analyze_fingerprints`.
+
+Two properties drive the layout:
+
+- **Readable while the owner is wedged.**  The tail lives in a named
+  shared-memory ring (same trick as the straggler op rings,
+  ``native/op_ring.c``): a rank blocked inside a device program with the
+  GIL released cannot publish anything, so its *monitor process* attaches
+  the segment post-mortem and folds the tail into the SOFT/HARD_TIMEOUT
+  interruption record — the wedged rank's fingerprint survives its wedge.
+- **µs-scale record.**  ``record()`` is two struct packs and a memoryview
+  copy; it sits on the dispatch path of every instrumented step.
+
+Concurrency: single writer (the training thread), any number of readers.
+Entries are written body-first, sequence-last; a reader that observes a
+torn entry (seq mismatch on re-read) drops it — the fingerprint is a
+diagnostic, losing the newest entry beats locking the dispatch path.
+
+Feeding the tail: the straggler :class:`OpCollector` records every wrapped
+dispatch automatically; workloads without the collector call
+:func:`record_dispatch` directly (one line per jitted step).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from ..utils.shm import attach_shm, create_shm, unlink_shm
+
+log = get_logger("inproc.fingerprint")
+
+MAGIC = b"TPUFPT01"
+NAME_LEN = 48
+DEFAULT_CAPACITY = 8
+
+_HEADER = struct.Struct("<8sII")              # magic, capacity, reserved
+_ENTRY = struct.Struct(f"<Qq{NAME_LEN}s")     # seq, stamp_ms, name
+HEADER_SIZE = _HEADER.size
+ENTRY_SIZE = _ENTRY.size
+
+
+def arena_size(capacity: int) -> int:
+    return HEADER_SIZE + capacity * ENTRY_SIZE
+
+
+class DispatchTail:
+    """Shm-backed ring of the last K dispatched device programs.
+
+    ``shm=None`` falls back to a process-local bytearray (same layout, no
+    cross-process readability) — used when shm creation fails or for plain
+    in-process snapshots.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, shm=None,
+                 owner: bool = False, _buf=None):
+        self.capacity = capacity
+        self._shm = shm
+        self._owner = owner
+        if _buf is not None:
+            self._buf = _buf
+        elif shm is not None:
+            self._buf = shm.buf
+        else:
+            self._buf = memoryview(bytearray(arena_size(capacity)))
+        self.name = shm.name if shm is not None else None
+        self._seq = 0
+        self._lock = threading.Lock()
+        if owner or shm is None:
+            _HEADER.pack_into(self._buf, 0, MAGIC, capacity, 0)
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def create(cls, capacity: int = DEFAULT_CAPACITY) -> "DispatchTail":
+        """Shared-memory tail (monitor-process-readable); falls back to a
+        heap tail when the host can't allocate shm."""
+        try:
+            shm = create_shm(arena_size(capacity))
+        except OSError as exc:
+            log.warning("dispatch tail shm unavailable (%s); monitor "
+                        "post-mortem fingerprints disabled", exc)
+            return cls(capacity)
+        return cls(capacity, shm=shm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "DispatchTail":
+        shm = attach_shm(name)
+        magic, capacity, _ = _HEADER.unpack_from(shm.buf, 0)
+        if magic != MAGIC:
+            shm.close()
+            raise ValueError(f"shm {name} is not a dispatch-tail arena")
+        return cls(capacity, shm=shm, owner=False)
+
+    # -- writer ------------------------------------------------------------
+
+    def record(self, name: str, stamp_ms: Optional[int] = None) -> None:
+        """Record one dispatched program (called at dispatch, before any
+        block).  ~µs: two packs and a slot copy."""
+        if stamp_ms is None:
+            stamp_ms = int(time.time() * 1000)
+        raw = name.encode(errors="replace")[: NAME_LEN - 1]
+        with self._lock:
+            seq = self._seq + 1
+            off = HEADER_SIZE + ((seq - 1) % self.capacity) * ENTRY_SIZE
+            # body first, seq last: readers treat a seq/body mismatch as torn
+            _ENTRY.pack_into(self._buf, off, 0, stamp_ms, raw)
+            _ENTRY.pack_into(self._buf, off, seq, stamp_ms, raw)
+            self._seq = seq
+
+    # -- readers -----------------------------------------------------------
+
+    def snapshot(self, now_ms: Optional[int] = None) -> List[dict]:
+        """Entries oldest→newest: ``[{"op", "age_ms", "seq"}, ...]``."""
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
+        out = []
+        for i in range(self.capacity):
+            off = HEADER_SIZE + i * ENTRY_SIZE
+            seq, stamp_ms, raw = _ENTRY.unpack_from(self._buf, off)
+            if seq == 0:
+                continue
+            # torn-write check: the slot for seq must still hold seq
+            seq2, _, _ = _ENTRY.unpack_from(self._buf, off)
+            if seq2 != seq:
+                continue
+            out.append({
+                "op": raw.split(b"\x00", 1)[0].decode(errors="replace"),
+                "age_ms": max(0, now_ms - stamp_ms),
+                "seq": int(seq),
+            })
+        out.sort(key=lambda e: e["seq"])
+        return out
+
+    def close(self) -> None:
+        if self._shm is None:
+            return
+        self._buf = None
+        if self._owner:
+            unlink_shm(self._shm)
+        try:
+            self._shm.close()
+        except BufferError:
+            # pinned by an in-flight reader: keep the object alive so its
+            # __del__ doesn't retry close() and spray "Exception ignored"
+            # tracebacks at interpreter exit — process teardown unmaps
+            _LEAKED_SHM.append(self._shm)
+        self._shm = None
+
+
+# segments whose mmap stayed pinned at close (see DispatchTail.close)
+_LEAKED_SHM: list = []
+
+
+# -- process-global tail (one per rank) -------------------------------------
+
+_global_tail = DispatchTail()
+_global_lock = threading.Lock()
+
+
+def install_tail(tail: DispatchTail) -> DispatchTail:
+    """Swap the process-global tail (the wrapper installs an shm-backed one
+    so the monitor process can read it).  Returns the previous tail."""
+    global _global_tail
+    with _global_lock:
+        prev, _global_tail = _global_tail, tail
+    return prev
+
+
+def get_tail() -> DispatchTail:
+    return _global_tail
+
+
+def record_dispatch(name: str) -> None:
+    """Record one dispatched device program into this rank's tail.  Wire it
+    at the dispatch boundary: the straggler ``OpCollector`` calls it for
+    every wrapped callable; uninstrumented workloads call it directly."""
+    _global_tail.record(name)
+
+
+def snapshot_tail(now_ms: Optional[int] = None) -> List[dict]:
+    return _global_tail.snapshot(now_ms)
+
+
+def read_tail(shm_name: str, now_ms: Optional[int] = None) -> List[dict]:
+    """Attach + snapshot + detach (monitor-process post-mortem read)."""
+    tail = DispatchTail.attach(shm_name)
+    try:
+        return tail.snapshot(now_ms)
+    finally:
+        tail.close()
+
+
+def parse_fingerprints(raw: Optional[bytes]) -> Dict[int, List[dict]]:
+    """Decode the store's at-abort fingerprint log (one JSON object per
+    line: ``{"rank": r, "tail": [...]}``); later lines win per rank."""
+    import json
+
+    out: Dict[int, List[dict]] = {}
+    if not raw:
+        return out
+    for line in raw.decode(errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+            out[int(obj["rank"])] = list(obj.get("tail", []))
+        except (ValueError, KeyError, TypeError):
+            continue
+    return out

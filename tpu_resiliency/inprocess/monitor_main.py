@@ -30,15 +30,46 @@ from .store_ops import InprocStore
 log = get_logger("monitor_process")
 
 
+def _read_fptail(fptail_name: Optional[str]) -> list:
+    """Post-mortem fingerprint: the rank's last K dispatched programs, read
+    from its named-shm dispatch tail — the rank itself may be wedged inside
+    a device call and unable to publish anything."""
+    if not fptail_name:
+        return []
+    try:
+        from .fingerprint import read_tail
+
+        return read_tail(fptail_name)
+    except (OSError, ValueError) as exc:
+        log.warning("monitor: cannot read dispatch tail %s: %s",
+                    fptail_name, exc)
+        return []
+
+
 def _record(ops: InprocStore, rank: int, iteration: int,
-            kind: Interruption, msg: str) -> None:
+            kind: Interruption, msg: str, fptail_name: Optional[str] = None) -> None:
     try:
         ops.record_interruption(
             iteration,
-            InterruptionRecord(rank=rank, interruption=kind, message=msg),
+            InterruptionRecord(rank=rank, interruption=kind, message=msg,
+                               fingerprint=_read_fptail(fptail_name)),
         )
     except Exception as exc:  # noqa: BLE001
         log.error("monitor: failed to record interruption: %s", exc)
+
+
+def _publish_fingerprint(ops: InprocStore, rank: int, iteration: int,
+                         fptail_name: Optional[str]) -> None:
+    """Mirror the post-mortem tail into the iteration's at-abort fingerprint
+    log — the wedged rank cannot run its own FingerprintStage, so the
+    monitor dumps on its behalf (the Flight-Recorder-at-abort analog)."""
+    tail = _read_fptail(fptail_name)
+    if not tail:
+        return
+    try:
+        ops.record_fingerprint(iteration, rank, tail)
+    except Exception as exc:  # noqa: BLE001
+        log.error("monitor: failed to publish fingerprint: %s", exc)
 
 
 def run_monitor(
@@ -51,6 +82,7 @@ def run_monitor(
     hard_timeout: float,
     interval: float,
     termination_grace: float,
+    fptail_name: Optional[str] = None,
 ) -> None:
     ops = InprocStore(store, group)
     shared.mark_ready()
@@ -61,7 +93,7 @@ def run_monitor(
         if not _pid_alive(parent_pid):
             log.error("monitor: rank %s (pid %s) died", rank, parent_pid)
             _record(ops, rank, iteration, Interruption.TERMINATED,
-                    "process died")
+                    "process died", fptail_name)
             ops.mark_terminated(rank)
             return
         if not shared.enabled:
@@ -75,7 +107,8 @@ def run_monitor(
                 rank, age, hard_timeout,
             )
             _record(ops, rank, iteration, Interruption.HARD_TIMEOUT,
-                    f"no progress {age:.1f}s")
+                    f"no progress {age:.1f}s", fptail_name)
+            _publish_fingerprint(ops, rank, iteration, fptail_name)
             ops.mark_terminated(rank)
             _terminate_process(parent_pid, termination_grace)
             return
@@ -86,7 +119,8 @@ def run_monitor(
                     rank, age, soft_timeout,
                 )
                 _record(ops, rank, iteration, Interruption.SOFT_TIMEOUT,
-                        f"no progress {age:.1f}s")
+                        f"no progress {age:.1f}s", fptail_name)
+                _publish_fingerprint(ops, rank, iteration, fptail_name)
                 soft_reported_at = time.time()
         else:
             soft_reported_at = None
@@ -102,6 +136,8 @@ def main(argv=None) -> int:
     p.add_argument("--hard-timeout", type=float, default=90.0)
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--termination-grace", type=float, default=5.0)
+    p.add_argument("--fptail", default=None,
+                   help="named-shm dispatch tail for post-mortem fingerprints")
     p.add_argument("--store-host", default=None)
     p.add_argument("--store-port", type=int, default=None)
     args = p.parse_args(argv)
@@ -131,7 +167,7 @@ def main(argv=None) -> int:
         run_monitor(
             shared, store, args.group, args.rank, args.parent_pid,
             args.soft_timeout, args.hard_timeout, args.interval,
-            args.termination_grace,
+            args.termination_grace, fptail_name=args.fptail,
         )
     finally:
         shared.close()

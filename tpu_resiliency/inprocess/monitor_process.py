@@ -208,6 +208,7 @@ class MonitorProcess:
         interval: float = 1.0,
         termination_grace: float = 5.0,
         shared_state: Optional[MonitorSharedState] = None,
+        fptail_name: Optional[str] = None,
     ):
         self.store_factory = store_factory
         self.group = group
@@ -218,6 +219,10 @@ class MonitorProcess:
         self.termination_grace = termination_grace
         self.shared = shared_state or MonitorSharedState.create()
         self._owns_shared = shared_state is None
+        # named-shm dispatch tail: lets the monitor fold the rank's last K
+        # dispatched programs into SOFT/HARD_TIMEOUT records even when the
+        # rank is wedged in a device call (at-abort fingerprint)
+        self.fptail_name = fptail_name
         if timestamp is not None:
             # A legacy mp.Value timestamp the caller keeps writing would be
             # INVISIBLE to the exec'd monitor (it reads the shm slot), and
@@ -246,6 +251,8 @@ class MonitorProcess:
             "--interval", str(self.interval),
             "--termination-grace", str(self.termination_grace),
         ]
+        if self.fptail_name:
+            cmd += ["--fptail", self.fptail_name]
         if endpoint is not None:
             cmd += ["--store-host", endpoint[0], "--store-port", str(endpoint[1])]
         else:

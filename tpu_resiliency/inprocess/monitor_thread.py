@@ -93,6 +93,10 @@ class MonitorThread:
         self._raise_lock = threading.Lock()
         self._trip_ns: Optional[int] = None
         self.tripped = threading.Event()
+        # set once the abort ladder/plugin has RUN (tripped only means the
+        # trip was observed — with staged abort the duties take real time,
+        # and the wrapper must not tear the monitor down under them)
+        self.abort_done = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"tpurx-inproc-monitor-thread-{iteration}", daemon=True
         )
@@ -128,6 +132,7 @@ class MonitorThread:
                 self.abort_fn()
             except Exception:  # noqa: BLE001
                 log.exception("abort plugin failed")
+        self.abort_done.set()
         # raise into the main thread until the wrapper acknowledges — first
         # raise immediately (a 0.5s pre-wait would put a flat half-second on
         # every detect->restart latency), then re-raise every 0.5s (fixed
@@ -187,5 +192,6 @@ class MonitorThread:
     def stop(self) -> None:
         self._stop.set()
         self.mark_caught()
+        self.abort_done.set()  # unblock waiters on a never-tripped monitor
         self._thread.join(timeout=5)
         self.ops.store.close()

@@ -56,6 +56,41 @@ class InprocStore:
             if line.strip()
         ]
 
+    # -- at-abort fingerprints ---------------------------------------------
+
+    def k_fingerprints(self, iteration: int) -> str:
+        return f"{self.ns}/iter/{iteration}/fingerprints"
+
+    def record_fingerprint(self, iteration: int, rank: int, tail) -> None:
+        """Append this rank's dispatch-tail fingerprint (last K dispatched
+        programs + ages) for the iteration — the at-abort analog of the
+        reference's Flight-Recorder dump (``abort.py:127-160``)."""
+        import json
+
+        self.store.append(
+            self.k_fingerprints(iteration),
+            json.dumps({"rank": rank, "tail": list(tail)}) + "\n",
+        )
+
+    def get_fingerprints(self, iteration: int):
+        from .fingerprint import parse_fingerprints
+
+        return parse_fingerprints(
+            self.store.try_get(self.k_fingerprints(iteration))
+        )
+
+    def wait_fingerprints(
+        self, iteration: int, n: int, timeout: float
+    ):
+        """Best-effort gather: poll until >= n ranks published or timeout;
+        returns whatever arrived (attribution must never block recovery)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            got = self.get_fingerprints(iteration)
+            if len(got) >= n or time.monotonic() >= deadline:
+                return got
+            time.sleep(0.05)
+
     # -- terminated ranks --------------------------------------------------
 
     def mark_terminated(self, rank: int) -> None:

@@ -28,7 +28,9 @@ from ..store.client import StoreClient, store_from_env
 from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
+from .abort import AbortLadder, FingerprintStage, ShrinkMeshStage, as_stage
 from .attribution import Interruption, InterruptionRecord
+from .fingerprint import DispatchTail, install_tail, snapshot_tail
 from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
 from .monitor_process import MonitorProcess
 from .monitor_thread import MonitorThread
@@ -105,6 +107,9 @@ class Wrapper:
         # letting low-jitter hosts detect in ~3ms instead of flooring at 5
         quorum_min_budget_ms: float = 2.0,
         quorum_native_beat: bool = False,
+        # at-abort fingerprint gather budget before the restart proceeds
+        # (0 disables the verdict log; publication still happens)
+        fingerprint_wait: float = 1.0,
     ):
         self.store_factory = store_factory or store_from_env
         self.group = group
@@ -136,6 +141,7 @@ class Wrapper:
         self.quorum_auto_beat_interval = quorum_auto_beat_interval
         self.quorum_native_beat = quorum_native_beat
         self.quorum_calibrate = quorum_calibrate
+        self.fingerprint_wait = fingerprint_wait
 
     def __call__(self, fn: Callable) -> Callable:
         def wrapped(*args, **kwargs):
@@ -167,6 +173,9 @@ class CallWrapper:
         self.watchdog: Optional[ProgressWatchdog] = None
         self.monitor_process: Optional[MonitorProcess] = None
         self.quorum = None  # QuorumTripwire when wrapper.quorum_mesh is set
+        self.ladder: Optional[AbortLadder] = None
+        self._tail: Optional[DispatchTail] = None
+        self._prev_tail: Optional[DispatchTail] = None
         self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
         # stamp of the last fault, cleared when the restarted fn re-enters
         self._restart_started_ns: Optional[int] = None
@@ -230,6 +239,11 @@ class CallWrapper:
         serve_from_env_once()  # per-rank scrape endpoint, when env asks
         self._store = self.w.store_factory()
         self.ops = InprocStore(self._store, self.w.group)
+        # shm-backed dispatch tail: the monitor process reads it post-mortem
+        # when this rank wedges in a device call (at-abort fingerprint)
+        self._tail = DispatchTail.create()
+        self._prev_tail = install_tail(self._tail)
+        self.ladder = self._build_ladder()
         # the monitor process is exec'd (never forked — the parent is
         # JAX-threaded) and reads the watchdog stamps through a named-shm
         # slot the watchdog writes into
@@ -255,6 +269,7 @@ class CallWrapper:
                 hard_timeout=self.w.hard_timeout,
                 interval=self.w.monitor_process_interval,
                 shared_state=shared,
+                fptail_name=self._tail.name if self._tail else None,
             ).start()
         self.ops.initial_barrier(
             self.state.initial_rank, self.state.initial_world_size,
@@ -274,6 +289,11 @@ class CallWrapper:
             self.monitor_process.shared.close()
         if self._store:
             self._store.close()
+        if self._tail is not None:
+            if self._prev_tail is not None:
+                install_tail(self._prev_tail)
+            self._tail.close()
+            self._tail = None
 
     # -- restart loop ------------------------------------------------------
 
@@ -439,6 +459,7 @@ class CallWrapper:
                         rank=state.initial_rank,
                         interruption=Interruption.EXCEPTION,
                         message=repr(fault_exc),
+                        fingerprint=snapshot_tail(),
                     ),
                 )
             else:
@@ -452,8 +473,25 @@ class CallWrapper:
             )
             self.watchdog.ping()
             # let the monitor thread finish abort duties (the trip flow runs
-            # independently of the raise loop the finally already silenced)
-            monitor.tripped.wait(timeout=w.last_call_wait + 5.0)
+            # independently of the raise loop the finally already silenced);
+            # with the staged ladder those duties take real time, so wait on
+            # the explicit completion handshake, not just the trip marker —
+            # stopping the monitor mid-ladder would abandon rungs
+            if monitor.tripped.wait(timeout=w.last_call_wait + 5.0):
+                monitor.abort_done.wait(
+                    timeout=sum(s.timeout for s in self.ladder.stages) + 5.0
+                )
+            # the ladder already counted stage outcomes in telemetry; emit
+            # them into the profiling stream too so cross-process gates
+            # (chaos soak) can assert rung behavior from the JSONL
+            for res in self.ladder.take_results():
+                record_event(
+                    ProfilingEvent.ABORT_STAGE,
+                    iteration=iteration, rank=state.initial_rank,
+                    stage=res.stage, outcome=res.outcome,
+                    duration_ms=round(res.duration_ms, 3),
+                )
+            self._fingerprint_verdict(iteration, survivors)
             monitor.stop()
             if sibling:
                 sibling.stop()
@@ -506,10 +544,69 @@ class CallWrapper:
 
     # -- helpers -----------------------------------------------------------
 
+    def _build_ladder(self) -> AbortLadder:
+        """Normalize the ``abort=`` plugin into the staged ladder.
+
+        A user-provided :class:`AbortLadder` is used as-is (its unbound
+        :class:`FingerprintStage`, if any, is bound to this wrapper's store
+        ops); a plain callable becomes one rung between the fingerprint
+        dump and the opt-in mesh-shrink; ``None`` still gets the
+        fingerprint + shrink rungs — publication must not depend on the
+        user remembering to configure it.
+        """
+        fp = FingerprintStage(
+            self.ops, self.state.initial_rank, lambda: self.state.iteration
+        )
+        user = self.w.abort
+        if isinstance(user, AbortLadder):
+            bound = False
+            for stage in user.stages:
+                if isinstance(stage, FingerprintStage):
+                    # (re)bind to THIS wrapper: user ladders hold unbound
+                    # stages, and a Wrapper reused across CallWrappers must
+                    # not publish through a closed store client
+                    stage.ops = self.ops
+                    stage.rank = self.state.initial_rank
+                    stage.iteration_fn = lambda: self.state.iteration
+                    bound = True
+            if not bound:
+                user.stages.insert(0, fp)
+            return user
+        stages = [fp]
+        if user is not None:
+            # generous rung deadline for unknown user plugins: the old
+            # Compose path had none at all
+            stages.append(as_stage(user, timeout=30.0))
+        stages.append(ShrinkMeshStage())
+        return AbortLadder(*stages)
+
     def _abort_fn(self) -> None:
-        if self.w.abort:
-            with self.atomic_lock:  # never abort inside a user atomic section
-                self.w.abort(self.state.freeze())
+        with self.atomic_lock:  # never abort inside a user atomic section
+            self.ladder(self.state.freeze())
+
+    def _fingerprint_verdict(self, iteration: int, survivors) -> None:
+        """Best-effort at-abort attribution: gather the ranks' fingerprints
+        and log which collective was in flight and who lagged.  Bounded by
+        ``fingerprint_wait``; never blocks or fails the restart."""
+        if self.w.fingerprint_wait <= 0:
+            return
+        try:
+            tails = self.ops.wait_fingerprints(
+                iteration, n=len(survivors), timeout=self.w.fingerprint_wait
+            )
+            for r in survivors:
+                tails.setdefault(r, [])
+            if not any(tails.values()):
+                return
+            from ..attribution.trace_analyzer import analyze_fingerprints
+
+            verdict = analyze_fingerprints(tails)
+            log.warning(
+                "abort fingerprint verdict: category=%s culprits=%s — %s",
+                verdict.category, verdict.culprit_ranks, verdict.summary,
+            )
+        except Exception:  # noqa: BLE001 - attribution never blocks recovery
+            log.exception("fingerprint verdict failed")
 
     def _reserve_wait(self, iteration: int) -> str:
         """INACTIVE spare: park until the job completes or a fault restarts

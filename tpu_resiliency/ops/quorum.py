@@ -177,7 +177,15 @@ def make_quorum_fn(
     def run(local_stamps_ms):
         now = now_stamp_ms()
         local = np.asarray(local_stamps_ms, dtype=np.int64).reshape(n_local)
-        ages = ((now - local) % _WRAP).astype(np.int32)
+        ages = (now - local) % _WRAP
+        # future == fresh (same rule as QuorumMonitor._current_stamp): a
+        # stamp a few ms ahead of our pre-read `now` (NTP skew across
+        # processes; a concurrent native beater) folds to ~2^31 — without
+        # this clamp one such tick reads as a 24.8-day-stale heartbeat and
+        # trips a spurious pod-wide restart (in identify mode it saturates
+        # the 15-bit cap, same false trip).  A genuinely stale stamp past
+        # the half-wrap horizon would have tripped eons earlier.
+        ages = np.where(ages > _WRAP // 2, 0, ages).astype(np.int32)
         if identify:
             ages = pack_age_device(ages, local_idx)
         if single_process:

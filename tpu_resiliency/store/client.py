@@ -20,6 +20,12 @@ import time
 from typing import List, Optional, Sequence
 
 from ..telemetry import counter, histogram
+from ..utils.retry import (
+    CONNECT_POLICY,
+    ROUNDTRIP_POLICY,
+    Retrier,
+    RetryExhausted,
+)
 from .protocol import Op, Status, itob
 
 _U32 = struct.Struct("<I")
@@ -115,20 +121,21 @@ class StoreClient:
     # -- connection --------------------------------------------------------
 
     def _connect(self, connect_timeout: float) -> None:
-        deadline = time.monotonic() + connect_timeout
-        last_exc: Optional[Exception] = None
-        while time.monotonic() < deadline:
+        r = Retrier("store_connect", CONNECT_POLICY, deadline=connect_timeout)
+        while True:
             try:
                 sock = socket.create_connection((self.host, self.port), timeout=5.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = sock
                 return
             except OSError as exc:
-                last_exc = exc
-                time.sleep(min(0.25, max(0.0, deadline - time.monotonic())))
-        raise StoreError(
-            f"could not connect to store at {self.host}:{self.port}: {last_exc}"
-        )
+                try:
+                    r.backoff(exc)
+                except RetryExhausted as give_up:
+                    raise StoreError(
+                        f"could not connect to store at "
+                        f"{self.host}:{self.port}: {give_up.last_exc}"
+                    ) from give_up
 
     def clone(self) -> "StoreClient":
         return StoreClient(self.host, self.port, timeout=self.timeout)
@@ -174,7 +181,7 @@ class StoreClient:
             for a in args:
                 payload.append(_U32.pack(len(a)))
                 payload.append(a)
-            attempt = 0
+            retrier = None  # lazily built: the happy path allocates nothing
             while True:
                 sent = False
                 try:
@@ -201,10 +208,21 @@ class StoreClient:
                             f"store op {op.name} connection lost after send; "
                             f"not retrying non-idempotent op: {exc}"
                         ) from exc
-                    attempt += 1
-                    if attempt > self._retries:
-                        raise StoreError(f"store op {op.name} failed: {exc}") from exc
-                    time.sleep(0.2 * attempt)
+                    if retrier is None:
+                        # +1: max_attempts counts FAILURES before giving up,
+                        # and `retries` means retries-after-first-try
+                        retrier = Retrier(
+                            "store_roundtrip",
+                            ROUNDTRIP_POLICY.with_(
+                                max_attempts=self._retries + 1
+                            ),
+                        )
+                    try:
+                        retrier.backoff(exc)
+                    except RetryExhausted as give_up:
+                        raise StoreError(
+                            f"store op {op.name} failed: {exc}"
+                        ) from give_up
                     self._connect(10.0)
 
     def _drop_socket(self) -> None:

@@ -431,7 +431,12 @@ class OpCollector:
         label = name or getattr(fn, "__name__", repr(fn))
         op_idx = self.arena.intern(label)
 
+        from ..inprocess.fingerprint import record_dispatch
+
         def collected(*args, **kwargs):
+            # at-abort fingerprint feed: name + dispatch stamp into the
+            # rank's dispatch tail (µs; read post-mortem when wedged)
+            record_dispatch(label)
             profiling = self._profile_due()
             if profiling:
                 return self._profiled_call(fn, label, args, kwargs)

@@ -42,6 +42,7 @@ class ProfilingEvent(str, enum.Enum):
     INPROCESS_INTERRUPTED = "inprocess_interrupted"
     INPROCESS_RESTART_STARTED = "inprocess_restart_started"
     INPROCESS_RESTART_COMPLETED = "inprocess_restart_completed"
+    ABORT_STAGE = "abort_stage"  # one per abort-ladder rung, with outcome
     # Health
     HEALTH_CHECK_STARTED = "health_check_started"
     HEALTH_CHECK_COMPLETED = "health_check_completed"
