@@ -27,6 +27,18 @@ runs a periodic store-backed "save" (chunked marker writes through the
 unified retry policy) and the chaos thread kills the store inside the
 save window — the gate asserts every started save still completed.
 
+With ``--corrupt-blob {bitflip,truncate}`` the soak switches to the
+checkpoint-integrity campaign: every rank runs a real
+``LocalCheckpointManager`` (sealed blobs, clique replication over TCP),
+saves every few steps, and in cycle 0 rank 0 corrupts EVERY copy of the
+newest committed iteration (``utils.inject_fault.corrupt_checkpoint``)
+then hard-exits.  The restarted gang must ``load(fallback=True)`` its way
+down the ladder: the gate asserts the corrupt blobs were detected AND
+quarantined (``*.corrupt`` debris on disk,
+``tpurx_ckpt_corrupt_detected_total`` > 0 in-process), the restored
+iteration is strictly OLDER than the corrupted one on every rank, and the
+fallback-depth gauge is nonzero.
+
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
 for both rings from those events and ASSERTS bounds, so a regression in
@@ -173,6 +185,87 @@ print(f"soak[{rank}] result={run()}", flush=True)
 """
 
 
+WORKLOAD_LCKPT = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+import numpy as np
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.store.client import store_from_env
+from tpu_resiliency.checkpointing.local.manager import LocalCheckpointManager
+from tpu_resiliency.checkpointing.local.replication import (
+    CliqueReplication, PeerExchange)
+from tpu_resiliency.telemetry import get_registry
+from tpu_resiliency.utils.inject_fault import Fault, corrupt_checkpoint
+
+rank = int(os.environ["TPURX_RANK"])
+world = int(os.environ["TPURX_WORLD_SIZE"])
+cycle = int(os.environ["TPURX_CYCLE"])
+root = os.environ["SOAK_CKPT_ROOT"]
+save_every = int(os.environ.get("SOAK_LCKPT_EVERY", "10"))
+corrupt_step = int(os.environ.get("SOAK_CORRUPT_STEP", "35"))
+mode = os.environ.get("SOAK_CORRUPT_MODE", "bitflip")
+total = int(os.environ.get("SOAK_STEPS", "100000"))
+
+
+def metric_sum(name):
+    m = get_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(v.get("value", 0.0) for _l, v in m._sample_rows())
+
+
+client = RankMonitorClient(); client.init_workload_monitoring()
+store = store_from_env(timeout=15.0)
+ex = PeerExchange(store, rank, namespace=f"soaklc-c{cycle}")
+repl = CliqueReplication(ex, world, replication_factor=min(2, world))
+mgr = LocalCheckpointManager(
+    os.path.join(root, f"n{rank}"), rank, world, store=store,
+    replication=repl, keep_last=8, peer_timeout=30.0,
+    store_namespace=f"localckpt/c{cycle}",
+)
+
+
+def make_tree(step):
+    return {"w": np.full((4096,), float(step), dtype=np.float32),
+            "step": np.int64(step),
+            "rank_marker": np.array([rank], dtype=np.int32)}
+
+
+start = 0
+if mgr.find_latest() is not None:
+    tree, it = mgr.load(make_tree(0), fallback=True)
+    depth = int(get_registry().get("tpurx_ckpt_fallback_depth").value)
+    assert int(tree["step"]) == it, (int(tree["step"]), it)
+    assert int(tree["rank_marker"][0]) == rank, "restored ANOTHER rank's data"
+    import glob as glob_mod
+    debris = len(glob_mod.glob(
+        os.path.join(root, f"n{rank}", "**", "*.corrupt"), recursive=True))
+    print(f"soaklc[{rank}] restored iter={it} depth={depth} "
+          f"corrupt={int(metric_sum('tpurx_ckpt_corrupt_detected_total'))} "
+          f"quarantined={int(metric_sum('tpurx_ckpt_quarantined_total'))} "
+          f"debris={debris}",
+          flush=True)
+    start = it + 1
+else:
+    print(f"soaklc[{rank}] fresh start (no checkpoint)", flush=True)
+
+for step in range(start, total):
+    client.send_heartbeat()
+    time.sleep(0.05)
+    if step and step % save_every == 0:
+        mgr.save(make_tree(step), iteration=step, is_async=False)
+        print(f"soaklc[{rank}] saved iter={step}", flush=True)
+    if cycle == 0 and rank == 0 and step == corrupt_step:
+        mutated = corrupt_checkpoint(root, Fault(mode))
+        its = sorted({os.path.basename(os.path.dirname(p)) for p in mutated})
+        print(f"soaklc[{rank}] corrupted newest mode={mode} "
+              f"files={len(mutated)} iters={','.join(its)}", flush=True)
+        time.sleep(0.3)
+        os._exit(41)
+print(f"soaklc[{rank}] result=done", flush=True)
+"""
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -292,6 +385,11 @@ def main() -> None:
     p.add_argument("--store-kill-mid-save", action="store_true",
                    help="target store kills INSIDE save windows; asserts "
                         "every started save still completes")
+    p.add_argument("--corrupt-blob", choices=("bitflip", "truncate"),
+                   help="checkpoint-integrity campaign: corrupt every copy "
+                        "of the newest local-checkpoint iteration mid-run; "
+                        "the restarted gang must fallback-restore the "
+                        "next-oldest valid iteration")
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
     p.add_argument("--chaos-store", action="store_true",
@@ -321,7 +419,7 @@ def main() -> None:
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
     with open(wl_path, "w") as f:
-        f.write(WORKLOAD)
+        f.write(WORKLOAD_LCKPT if args.corrupt_blob else WORKLOAD)
     ckpt = os.path.join(workdir, "progress.txt")
     profile = os.path.join(workdir, "profile.jsonl")
     journal = os.path.join(workdir, "store.journal")
@@ -351,6 +449,16 @@ def main() -> None:
             "JAX_PLATFORMS": "cpu",
         }
     )
+    if args.corrupt_blob:
+        env.update({
+            "SOAK_CKPT_ROOT": os.path.join(workdir, "lckpt"),
+            "SOAK_CORRUPT_MODE": args.corrupt_blob,
+            "SOAK_LCKPT_EVERY": "10",
+            "SOAK_CORRUPT_STEP": "35",
+            # barriers/replication pause heartbeats briefly; keep the kill
+            # threshold clear of normal collective latency
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "10.0",
+        })
     if args.quorum:
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -509,8 +617,56 @@ def main() -> None:
             saves_started >= 1
             and saves_done >= max(1, saves_started - tolerance)
         )
-    ok = bool(monotone and final > 0 and bounds_ok and rings_ok
-              and ladder_ok and saves_ok)
+    # checkpoint-integrity campaign (--corrupt-blob): the corrupt blobs must
+    # be detected + quarantined and EVERY rank must fallback-restore an
+    # iteration strictly older than the corrupted one
+    ckpt_report: dict = {}
+    ckpt_ok = True
+    if args.corrupt_blob:
+        import glob as glob_mod
+        import re as re_mod
+
+        corrupted = re_mod.findall(
+            r"soaklc\[\d+\] corrupted newest mode=\S+ files=(\d+) "
+            r"iters=iter_(\d+)", out)
+        restores = [
+            tuple(int(x) for x in m)
+            for m in re_mod.findall(
+                r"soaklc\[(\d+)\] restored iter=(\d+) depth=(\d+) "
+                r"corrupt=(\d+) quarantined=(\d+) debris=(\d+)", out)
+        ]
+        # end-of-run debris is best-effort (keep_last pruning legitimately
+        # reclaims quarantined iter dirs); the restore-time debris count in
+        # each marker is the authoritative check
+        end_debris = glob_mod.glob(
+            os.path.join(workdir, "lckpt", "**", "*.corrupt"), recursive=True)
+        corrupted_iter = int(corrupted[0][1]) if corrupted else None
+        fb = [r for r in restores if r[2] >= 1]
+        ckpt_ok = bool(
+            corrupted and int(corrupted[0][0]) >= 1
+            and fb
+            and {r[0] for r in fb} == set(range(args.nproc))
+            and all(it < corrupted_iter for _r, it, _d, _c, _q, _f in fb)
+            and all(c >= 1 and q >= 1 and f >= 1
+                    for _r, _it, _d, c, q, f in fb)
+        )
+        ckpt_report = {
+            "corrupt_blob": args.corrupt_blob,
+            "corrupted_iter": corrupted_iter,
+            "restores": restores,
+            "fallback_restores": fb,
+            "quarantine_debris_at_exit": len(end_debris),
+            "ckpt_ok": ckpt_ok,
+        }
+        # the lckpt workload tracks progress through checkpoint iterations,
+        # not the progress file — those checks don't apply
+        monotone = True
+        final = max((r[1] for r in restores), default=0)
+    if args.corrupt_blob:
+        ok = bool(ckpt_ok and cycles >= 1)
+    else:
+        ok = bool(monotone and final > 0 and bounds_ok and rings_ok
+                  and ladder_ok and saves_ok)
     print(
         json.dumps(
             {
@@ -534,6 +690,7 @@ def main() -> None:
                 "bounds_ok": bounds_ok,
                 "ladder_ok": ladder_ok,
                 "saves_ok": saves_ok,
+                **ckpt_report,
                 "ok": ok,
             }
         )

@@ -1,0 +1,590 @@
+"""End-to-end checkpoint integrity: verified chunks, corrupt-shard
+quarantine with peer re-election, and the fallback restore ladder.
+
+Covers the full trust-boundary matrix: frame footer unit behavior, shard
+digest verification on the global (async) path, own-blob quarantine +
+peer retrieval, corrupt-holder re-election (a corrupt holder is never the
+restore source), the cross-rank validity round gating the fallback ladder,
+find_latest edge cases (empty holdings, quarantined iterations, keep_last
+pruning racing a fallback load), the background scrubber, per-peer
+exchange deadlines, and the checkpoint-corruption fault classes."""
+
+import glob
+import os
+import shutil
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpointing import integrity
+from tpu_resiliency.checkpointing.integrity import (
+    CheckpointCorruptError,
+    chunk_crcs,
+    combine_crcs,
+    crc32,
+    read_verified_blob,
+    read_verified_shard,
+    seal,
+    verify_blob,
+)
+from tpu_resiliency.checkpointing.local.manager import LocalCheckpointManager
+from tpu_resiliency.checkpointing.local.replication import (
+    CliqueReplication,
+    PeerExchange,
+)
+from tpu_resiliency.checkpointing.local.state_dict import TensorAwareTree
+from tpu_resiliency.store import StoreClient
+from tpu_resiliency.utils.inject_fault import Fault, corrupt_checkpoint
+
+
+def make_tree(rank, seed=0):
+    k = jax.random.PRNGKey(seed * 100 + rank)
+    return {
+        "w": jax.random.normal(k, (8, 4)),
+        "step": np.int64(seed),
+        "rank_marker": np.array([rank], dtype=np.int32),
+    }
+
+
+def _bitflip(path, off=64):
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _run_ranks(world, fn):
+    errors, results = [], {}
+
+    def wrap(rank):
+        try:
+            results[rank] = fn(rank)
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            errors.append((rank, exc))
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+# -- frame footer -------------------------------------------------------------
+
+
+class TestFrameFooter:
+    def test_seal_verify_roundtrip(self):
+        payload = b"hello checkpoint" * 100
+        sealed = seal(payload)
+        verify_blob(sealed)  # no raise
+        assert integrity.unseal(sealed).tobytes() == payload
+
+    def test_bitflip_detected(self):
+        sealed = bytearray(seal(b"x" * 4096))
+        sealed[100] ^= 0x01
+        with pytest.raises(CheckpointCorruptError, match="crc mismatch"):
+            verify_blob(bytes(sealed))
+
+    def test_truncation_detected(self):
+        sealed = seal(b"y" * 4096)
+        with pytest.raises(CheckpointCorruptError, match="truncated|footer"):
+            verify_blob(sealed[: len(sealed) // 2])
+
+    def test_unsealed_blob_rejected(self):
+        with pytest.raises(CheckpointCorruptError, match="footer"):
+            verify_blob(b"no footer here" * 10)
+
+    def test_footer_transparent_to_from_bytes(self):
+        tree = make_tree(0, seed=4)
+        sealed = TensorAwareTree.from_tree(tree).to_bytes()  # seals by default
+        verify_blob(sealed)
+        rebuilt = TensorAwareTree.from_bytes(sealed).to_tree_like(tree)
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt["w"]), np.asarray(tree["w"])
+        )
+        # zero-copy parse works on sealed blobs too
+        rebuilt2 = TensorAwareTree.from_bytes(sealed, copy=False).to_tree_like(tree)
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt2["w"]), np.asarray(tree["w"])
+        )
+
+    def test_unsealed_serialization_still_available(self):
+        tree = make_tree(0)
+        raw = TensorAwareTree.from_tree(tree).to_bytes(seal=False)
+        with pytest.raises(CheckpointCorruptError):
+            verify_blob(raw)
+        TensorAwareTree.from_bytes(raw)  # parses fine
+
+
+class TestChunkDigests:
+    def test_combine_is_order_defined(self):
+        crcs = [crc32(b"a"), crc32(b"b"), crc32(b"c")]
+        assert combine_crcs(crcs) != combine_crcs(list(reversed(crcs)))
+        assert combine_crcs(crcs) == combine_crcs(list(crcs))
+
+    def test_chunk_crcs_granularity(self):
+        data = os.urandom(10_000)
+        crcs = chunk_crcs(data, 4096)
+        assert len(crcs) == 3
+        assert crcs[0] == crc32(data[:4096])
+        assert crcs[2] == crc32(data[8192:])
+
+    def test_read_verified_shard_spans(self, tmp_path):
+        data = os.urandom(9000)
+        path = str(tmp_path / "shard.bin")
+        with open(path, "wb") as f:
+            f.write(data)
+        spans = [
+            (0, 4096, crc32(data[:4096])),
+            (4096, 4904, crc32(data[4096:])),
+        ]
+        composed = combine_crcs([c for _o, _l, c in spans])
+        out = read_verified_shard(
+            path, nbytes=9000, crc=composed, chunks=spans
+        )
+        assert out == data
+        # bitflip inside span 1 -> error names the span offset
+        _bitflip(path, off=5000)
+        with pytest.raises(CheckpointCorruptError, match="offset 4096"):
+            read_verified_shard(path, nbytes=9000, crc=composed, chunks=spans)
+
+    def test_read_verified_shard_truncation_and_gaps(self, tmp_path):
+        data = os.urandom(5000)
+        path = str(tmp_path / "s.bin")
+        with open(path, "wb") as f:
+            f.write(data)
+        with pytest.raises(CheckpointCorruptError, match="truncated"):
+            read_verified_shard(path, nbytes=6000)
+        gap_spans = [(0, 1000, crc32(data[:1000])), (2000, 3000, crc32(data[2000:]))]
+        with pytest.raises(CheckpointCorruptError, match="tile"):
+            read_verified_shard(path, nbytes=5000, chunks=gap_spans)
+
+    def test_legacy_shard_without_digests_passes(self, tmp_path):
+        path = str(tmp_path / "legacy.bin")
+        with open(path, "wb") as f:
+            f.write(b"z" * 100)
+        assert read_verified_shard(path, nbytes=100) == b"z" * 100
+
+
+# -- global (async) path ------------------------------------------------------
+
+
+def test_load_checkpoint_detects_shard_corruption(tmp_path):
+    from tpu_resiliency.checkpointing import AsyncCheckpointer, load_checkpoint
+    from tpu_resiliency.checkpointing.async_ckpt.checkpointer import (
+        CachedMetadataReader,
+    )
+
+    tree = {"w": jax.device_put(np.arange(50000, dtype=np.float32))}
+    d = str(tmp_path / "g1")
+    ckpt = AsyncCheckpointer()
+    try:
+        ckpt.save(tree, d, extra_metadata={"iteration": 1})
+        stats = ckpt.last_drain_stats
+        assert stats["digest"] and stats["crc_chunks"] >= 1
+        assert stats["crc_ns"] > 0
+        restored = load_checkpoint(d, tree, reader=CachedMetadataReader())
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(tree["w"])
+        )
+        shard = sorted(glob.glob(os.path.join(d, "process_0", "*.bin")))[0]
+        _bitflip(shard, off=777)
+        with pytest.raises(CheckpointCorruptError, match="corrupt chunk"):
+            load_checkpoint(d, tree, reader=CachedMetadataReader())
+    finally:
+        ckpt.close()
+
+
+def test_digest_off_save_is_legacy_readable(tmp_path):
+    from tpu_resiliency.checkpointing import AsyncCheckpointer, load_checkpoint
+    from tpu_resiliency.checkpointing.async_ckpt.writer import read_metadata
+
+    tree = {"w": jax.device_put(np.arange(1000, dtype=np.float32))}
+    d = str(tmp_path / "g2")
+    ckpt = AsyncCheckpointer(digest=False)
+    try:
+        ckpt.save(tree, d, extra_metadata={"iteration": 1})
+        assert ckpt.last_drain_stats["digest"] is False
+        meta = read_metadata(d)
+        assert all("crc" not in s and "chunks" not in s for s in meta["shards"])
+        restored = load_checkpoint(d, tree)
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.asarray(tree["w"])
+        )
+    finally:
+        ckpt.close()
+
+
+# -- local manager: quarantine, re-election, fallback ladder ------------------
+
+
+def _mk_member(tmp_path, store_port, rank, world, ns, factor=2, **kw):
+    store = StoreClient("127.0.0.1", store_port, timeout=15.0)
+    ex = PeerExchange(store, rank, namespace=ns)
+    repl = CliqueReplication(ex, world, replication_factor=factor)
+    mgr = LocalCheckpointManager(
+        str(tmp_path / f"node{rank}"), rank, world, store=store,
+        replication=repl, **kw,
+    )
+    return store, ex, mgr
+
+
+def test_own_blob_corrupt_quarantined_then_peer_restore(store_server, tmp_path):
+    """A rank whose own blob rotted quarantines it and restores from the
+    clique replica — with the quarantine debris left for post-mortem."""
+    world = 2
+
+    def phase1(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "qi1")
+        try:
+            mgr.save(make_tree(rank, seed=3), iteration=7, is_async=False)
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+    own = str(tmp_path / "node1" / "default" / "iter_7" / "rank_1.tpurx")
+    _bitflip(own)
+
+    def phase2(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "qi2")
+        try:
+            tree, it = mgr.load(make_tree(rank), iteration=7)
+            return int(np.asarray(tree["rank_marker"])[0]), it
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, phase2)
+    assert results[1] == (1, 7)  # restored its own data from the peer replica
+    assert os.path.exists(own + ".corrupt")
+    assert not os.path.exists(own + ".done")
+
+
+def test_corrupt_holder_never_restore_source(store_server, tmp_path):
+    """Re-election: the elected holder's copy is corrupt — it is quarantined
+    and the plan re-runs, restoring from the NEXT valid holder.  A corrupt
+    holder must never be the restore source."""
+    world = 3
+
+    def phase1(rank):
+        store, ex, mgr = _mk_member(
+            tmp_path, store_server.port, rank, world, "qe1", factor=3
+        )
+        try:
+            mgr.save(make_tree(rank, seed=5), iteration=2, is_async=False)
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+    # rank 2 loses its disk; the FIRST-elected holder (rank 0) has a rotten
+    # copy of rank 2's data — only rank 1's replica is valid
+    shutil.rmtree(tmp_path / "node2" / "default")
+    corrupt_copy = str(tmp_path / "node0" / "default" / "iter_2" / "rank_2.tpurx")
+    _bitflip(corrupt_copy)
+
+    def phase2(rank):
+        store, ex, mgr = _mk_member(
+            tmp_path, store_server.port, rank, world, "qe2", factor=3,
+            peer_timeout=30.0,
+        )
+        try:
+            tree, it = mgr.load(make_tree(rank), fallback=True)
+            return int(np.asarray(tree["rank_marker"])[0]), it
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, phase2)
+    assert results[2] == (2, 2), results  # correct data, newest iteration
+    assert os.path.exists(corrupt_copy + ".corrupt")
+
+
+def test_fallback_restores_next_oldest_valid(store_server, tmp_path):
+    """Every copy of the newest iteration is corrupt -> the ladder restores
+    the next-oldest iteration on all ranks and exports the depth."""
+    world = 2
+
+    def phase1(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "fb1")
+        try:
+            for it in (1, 2, 3):
+                mgr.save(make_tree(rank, seed=it), iteration=it, is_async=False)
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+    for blob in glob.glob(str(tmp_path / "node*" / "default" / "iter_3" / "*.tpurx")):
+        _bitflip(blob)
+
+    def phase2(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "fb2")
+        try:
+            tree, it = mgr.load(make_tree(rank), fallback=True)
+            return it, int(np.asarray(tree["step"]))
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, phase2)
+    for rank in range(world):
+        assert results[rank] == (2, 2), results
+    # every corrupted blob was quarantined
+    debris = glob.glob(str(tmp_path / "node*" / "default" / "iter_3" / "*.corrupt"))
+    assert len(debris) == 4  # 2 nodes x 2 blobs (factor 2)
+    from tpu_resiliency.telemetry import get_registry
+
+    assert get_registry().get("tpurx_ckpt_fallback_depth").value >= 1
+    corrupt = get_registry().get("tpurx_ckpt_corrupt_detected_total")
+    assert sum(v.get("value", 0) for _l, v in corrupt._sample_rows()) >= 1
+
+
+def test_fallback_disabled_raises_on_corrupt_newest(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "solo"), 0, 1, store=store)
+    try:
+        for it in (1, 2):
+            mgr.save(make_tree(0, seed=it), iteration=it, is_async=False)
+        _bitflip(mgr._blob_path(2, 0))
+        with pytest.raises(CheckpointCorruptError, match="validity"):
+            mgr.load(make_tree(0))  # fallback defaults off
+        # fallback walks to iteration 1
+        tree, it = mgr.load(make_tree(0), fallback=True)
+        assert it == 1
+    finally:
+        store.close()
+
+
+# -- find_latest edge cases (satellite) ---------------------------------------
+
+
+def test_find_latest_rank_with_empty_holdings(store_server, tmp_path):
+    """A rank with an empty disk publishes empty holdings; coverage then
+    depends entirely on replicas elsewhere."""
+    world = 2
+
+    def phase1(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "eh1")
+        try:
+            mgr.save(make_tree(rank, seed=1), iteration=4, is_async=False)
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+    shutil.rmtree(tmp_path / "node1" / "default")
+
+    def phase2(rank):
+        store, ex, mgr = _mk_member(tmp_path, store_server.port, rank, world, "eh2")
+        try:
+            return mgr.find_latest()
+        finally:
+            ex.close()
+            store.close()
+
+    # factor-2 clique: node0 still holds BOTH blobs -> coverage stays full
+    results = _run_ranks(world, phase2)
+    assert results == {0: 4, 1: 4}
+
+    # without replication nobody covers rank 1 -> no candidate
+    def solo(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / "bare" / f"n{rank}"), rank, world, store=store,
+            store_namespace="eh3",
+        )
+        try:
+            if rank == 0:
+                mgr.save(make_tree(0, seed=1), iteration=9, is_async=False)
+            return mgr.find_latest()
+        finally:
+            store.close()
+
+    assert _run_ranks(world, solo) == {0: None, 1: None}
+
+
+def test_quarantined_iteration_excluded_from_coverage(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "q"), 0, 1, store=store)
+    try:
+        for it in (1, 2):
+            mgr.save(make_tree(0, seed=it), iteration=it, is_async=False)
+        assert mgr.find_latest() == 2
+        _bitflip(mgr._blob_path(2, 0))
+        assert not mgr.verify_iteration(2)  # quarantines
+        assert mgr._holdings() == {1: [0]}
+        assert mgr.find_latest() == 1
+    finally:
+        store.close()
+
+
+def test_keep_last_pruning_races_fallback_load(store_server, tmp_path):
+    """Holdings in the store still advertise an iteration whose dir was
+    pruned on every rank (cleanup raced the gather).  The validity round
+    re-publishes the truth and the ladder falls through to the survivor."""
+    world = 2
+
+    def phase1(rank):
+        store, ex, mgr = _mk_member(
+            tmp_path, store_server.port, rank, world, "pr1", keep_last=10
+        )
+        try:
+            for it in (1, 2, 3):
+                mgr.save(make_tree(rank, seed=it), iteration=it, is_async=False)
+            # simulate keep_last pruning that raced: dir gone, stale store
+            # holdings still claim it (no republish)
+            shutil.rmtree(mgr._iter_dir(3))
+        finally:
+            ex.close()
+            store.close()
+
+    _run_ranks(world, phase1)
+
+    def phase2(rank):
+        store, ex, mgr = _mk_member(
+            tmp_path, store_server.port, rank, world, "pr2", keep_last=10
+        )
+        try:
+            # re-publish STALE holdings claiming iter 3 still exists, as the
+            # racing window would have it
+            import json
+
+            stale = {str(it): [0, 1] for it in (1, 2, 3)}
+            store.set(f"localckpt/holdings/{rank}", json.dumps(stale))
+            tree, it = mgr.load(make_tree(rank), fallback=True)
+            return it
+        finally:
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, phase2)
+    assert results == {0: 2, 1: 2}, results
+
+
+# -- scrubber -----------------------------------------------------------------
+
+
+def test_scrubber_quarantines_rot(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(str(tmp_path / "sc"), 0, 1, store=store)
+    try:
+        for it in (1, 2):
+            mgr.save(make_tree(0, seed=it), iteration=it, is_async=False)
+        _bitflip(mgr._blob_path(2, 0))
+        assert mgr.scrub_once() == 1
+        assert mgr.find_latest() == 1
+        assert os.path.exists(mgr._blob_path(2, 0) + ".corrupt")
+        # clean sweep finds nothing further
+        assert mgr.scrub_once() == 0
+    finally:
+        store.close()
+
+
+def test_scrubber_thread_lifecycle(store_server, tmp_path):
+    store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+    mgr = LocalCheckpointManager(
+        str(tmp_path / "sct"), 0, 1, store=store, scrub_interval=0.05
+    )
+    try:
+        mgr.save(make_tree(0, seed=1), iteration=1, is_async=False)
+        _bitflip(mgr._blob_path(1, 0))
+        deadline = 10.0
+        import time
+
+        t0 = time.monotonic()
+        while os.path.exists(mgr._blob_path(1, 0)) and time.monotonic() - t0 < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(mgr._blob_path(1, 0) + ".corrupt")
+    finally:
+        mgr.stop_scrubber()
+        store.close()
+
+
+# -- per-peer exchange deadline (satellite) -----------------------------------
+
+
+def test_execute_plan_deadline_bounds_dead_holder(store_server):
+    """A recv from a holder that never sends surfaces as TimeoutError within
+    the PLAN deadline — even with several pending receives — instead of
+    blocking for the sum of sequential per-recv timeouts."""
+    import time
+
+    store = StoreClient("127.0.0.1", store_server.port, timeout=10.0)
+    ex = PeerExchange(store, 0, namespace="ddl")
+    repl = CliqueReplication(ex, 2)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            repl.execute_plan([], [(1, 7), (1, 8), (1, 9)], timeout=1.5)
+        assert time.monotonic() - t0 < 4.0  # one shared deadline, not 3x
+    finally:
+        ex.close()
+        store.close()
+
+
+# -- fault classes (satellite) ------------------------------------------------
+
+
+class TestCorruptionFaults:
+    def _layout(self, tmp_path):
+        root = tmp_path / "faults"
+        for it in (1, 2):
+            d = root / "n0" / "default" / f"iter_{it}"
+            os.makedirs(d)
+            blob = seal(os.urandom(2048))
+            for r in (0, 1):
+                p = d / f"rank_{r}.tpurx"
+                p.write_bytes(blob)
+                (d / f"rank_{r}.tpurx.done").write_text("ok")
+        return str(root)
+
+    def test_bitflip_targets_newest_and_crc_catches(self, tmp_path):
+        root = self._layout(tmp_path)
+        mutated = corrupt_checkpoint(root, Fault.CKPT_BITFLIP)
+        assert len(mutated) == 2
+        assert all("iter_2" in p for p in mutated)
+        for p in mutated:
+            with pytest.raises(CheckpointCorruptError):
+                read_verified_blob(p)
+        # iter_1 untouched
+        read_verified_blob(os.path.join(
+            root, "n0", "default", "iter_1", "rank_0.tpurx"))
+
+    def test_truncate_caught_by_length(self, tmp_path):
+        root = self._layout(tmp_path)
+        mutated = corrupt_checkpoint(root, Fault.CKPT_TRUNCATE)
+        for p in mutated:
+            with pytest.raises(CheckpointCorruptError):
+                read_verified_blob(p)
+
+    def test_torn_index_cuts_local_footer(self, tmp_path):
+        root = self._layout(tmp_path)
+        mutated = corrupt_checkpoint(root, Fault.CKPT_TORN_INDEX)
+        assert mutated
+        for p in mutated:
+            with pytest.raises(CheckpointCorruptError, match="footer|truncated"):
+                read_verified_blob(p)
+
+    def test_torn_index_global_metadata(self, tmp_path):
+        import json
+
+        root = tmp_path / "g"
+        pdir = root / "ck" / "process_0"
+        os.makedirs(pdir)
+        (pdir / "shard_0_0.bin").write_bytes(os.urandom(512))
+        meta = root / "ck" / "metadata.json"
+        meta.write_text(json.dumps({"format": "tpurx-ckpt-v1", "shards": []}))
+        mutated = corrupt_checkpoint(str(root), Fault.CKPT_TORN_INDEX)
+        assert mutated == [str(meta)]
+        with pytest.raises(json.JSONDecodeError):
+            json.loads(meta.read_text())

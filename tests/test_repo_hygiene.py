@@ -113,6 +113,49 @@ def test_no_bare_print_in_library_modules():
     )
 
 
+def test_no_raw_binary_reads_in_checkpointing_modules():
+    """Checkpoint payload bytes must only enter the process through the
+    verifying readers (``checkpointing/integrity.py``): any
+    ``open(..., "rb")`` elsewhere under ``tpu_resiliency/checkpointing/``
+    is a trust-boundary bypass — the exact unguarded-read pattern this
+    repo's corrupt-shard quarantine exists to eliminate.  AST-based like
+    the bare-print ban (strings/comments can't false-positive)."""
+    allowlist = {"tpu_resiliency/checkpointing/integrity.py"}
+    offenders = []
+    for rel, path in _library_sources():
+        if not rel.startswith("tpu_resiliency/checkpointing/"):
+            continue
+        if rel in allowlist:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open"
+            ):
+                continue
+            mode = None
+            if len(node.args) >= 2:
+                mode = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and "r" in mode.value
+                and "b" in mode.value
+            ):
+                offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        f"raw binary reads of checkpoint data outside the verifying reader "
+        f"(use integrity.read_verified_blob / read_verified_shard): "
+        f"{offenders}"
+    )
+
+
 def _declared_metric_names():
     """(name, rel, lineno) for every registry-constructor call with a
     literal first argument anywhere in the package."""

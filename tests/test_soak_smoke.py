@@ -52,6 +52,35 @@ def test_soak_smoke_chaos_store_and_quorum():
         assert report["cycles"] >= 1, report
 
 
+def test_soak_smoke_corrupt_blob_fallback_restore():
+    """The checkpoint-integrity campaign: every copy of the newest local
+    checkpoint is bit-flipped mid-run and the gang hard-restarts; the
+    restarted ranks must detect + quarantine the corruption and
+    fallback-restore the next-oldest valid iteration on all ranks."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "45", "--corrupt-blob", "bitflip",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["ckpt_ok"], report
+    assert report["corrupted_iter"] is not None, report
+    assert report["cycles"] >= 1, report
+    # every rank fallback-restored an OLDER iteration with nonzero depth,
+    # detected corruption, and left quarantine debris
+    fb = report["fallback_restores"]
+    assert {r[0] for r in fb} == {0, 1}, report
+    for _rank, it, depth, corrupt, quarantined, debris in fb:
+        assert it < report["corrupted_iter"]
+        assert depth >= 1 and corrupt >= 1 and quarantined >= 1 and debris >= 1
+
+
 def test_soak_smoke_store_outage_mid_save():
     """The store-outage-mid-save fault class: targeted store kills inside
     rank 0's store-backed save windows; the unified retry policy must ride

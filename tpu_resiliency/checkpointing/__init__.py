@@ -17,6 +17,12 @@ Reference: ``checkpointing/`` (async_ckpt + local).  TPU re-design:
 
 from .async_ckpt.core import AsyncCallsQueue, AsyncRequest
 from .async_ckpt.checkpointer import AsyncCheckpointer, load_checkpoint
+from .integrity import (
+    CheckpointCorruptError,
+    read_verified_blob,
+    read_verified_shard,
+    verify_blob,
+)
 from .local.state_dict import TensorAwareTree
 from .local.manager import LocalCheckpointManager
 from .local.replication import CliqueReplication
@@ -26,6 +32,10 @@ __all__ = [
     "AsyncRequest",
     "AsyncCheckpointer",
     "load_checkpoint",
+    "CheckpointCorruptError",
+    "read_verified_blob",
+    "read_verified_shard",
+    "verify_blob",
     "TensorAwareTree",
     "LocalCheckpointManager",
     "CliqueReplication",

@@ -141,6 +141,7 @@ class AsyncCheckpointer:
         write_threads: Optional[int] = None,
         stage_mode: Optional[str] = None,
         pool_size: int = 2,
+        digest: Optional[bool] = None,
     ):
         if stage_mode not in (None, "snapshot", "sync"):
             raise ValueError(
@@ -155,6 +156,9 @@ class AsyncCheckpointer:
         self.write_threads = resolve_write_threads(write_threads)
         self.stage_mode = stage_mode
         self.pool_size = pool_size
+        # chunk-digest recording in the drain (None = env TPURX_CKPT_DIGEST,
+        # default on); per-save override via async_save(digest=...)
+        self.digest = digest
         if process_index is None:
             try:
                 import jax
@@ -182,6 +186,7 @@ class AsyncCheckpointer:
         extra_metadata: Optional[Dict] = None,
         save_id: Optional[str] = None,
         stage_mode: Optional[str] = None,
+        digest: Optional[bool] = None,
     ) -> int:
         """Snapshot + hand off to the stager (default), or stage inline
         (``stage_mode="sync"``).  Returns a monotonic save ticket.  Call
@@ -220,10 +225,13 @@ class AsyncCheckpointer:
             finalize_fns.append(
                 lambda: self._merger.finalize(ckpt_dir, job.staged, extra, save_id)
             )
+        if digest is None:
+            digest = self.digest
         req = AsyncRequest(
             async_fn=write_process_shards_streamed,
             async_fn_args=(
                 ckpt_dir, self.process_index, self.write_threads, save_id, sig,
+                digest,
             ),
             finalize_fns=finalize_fns,
             cleanup_fns=[lambda: self._release_job(job)],
@@ -384,6 +392,14 @@ class AsyncCheckpointer:
         only after staging AND writing finish, so the queue sees both.)"""
         return self.queue.num_unfinalized_calls
 
+    @property
+    def last_drain_stats(self) -> Dict[str, Any]:
+        """Drain accounting the worker reported for the most recently
+        finalized save (bytes_written / shards / drain_ns / crc_ns /
+        crc_chunks / digest) — the write-side digest cost is ``crc_ns``,
+        the number the bench's verify-overhead gate watches."""
+        return self.queue.last_call_stats or {}
+
     def drain_progress(self) -> Tuple[int, int]:
         """(bytes_written, bytes_total) across in-flight saves, as reported
         by the worker through the drain-progress pipe frames.  Monotonic per
@@ -439,6 +455,24 @@ class _MetadataMerger:
         if verified and self._cache_key == key and self._cache_shards is not None:
             all_shards = self._cache_shards
             self.reuse_hits += 1
+            # The cached merge covers the content-INDEPENDENT geometry (the
+            # plan signature vouches for it).  Content digests change every
+            # save — refresh them from this save's process indices, or the
+            # reused metadata would vouch for the PREVIOUS save's bytes.
+            fresh = {
+                (idx["process_index"], s["leaf_idx"], s["shard_idx"]): s
+                for idx in indices
+                for s in idx["shards"]
+            }
+            for s in all_shards:
+                src = fresh.get(
+                    (s["process_index"], s["leaf_idx"], s["shard_idx"])
+                )
+                for k in ("crc", "chunks"):
+                    if src is not None and k in src:
+                        s[k] = src[k]
+                    else:
+                        s.pop(k, None)
         else:
             if not verified:
                 log.warning(

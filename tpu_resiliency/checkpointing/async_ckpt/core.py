@@ -106,7 +106,7 @@ class _PipeWorker:
             # stderr inherited: worker tracebacks surface in trainer logs
             start_new_session=False,
         )
-        self.results: Dict[int, Tuple[Optional[str], float]] = {}
+        self.results: Dict[int, Tuple[Optional[str], float, Optional[dict]]] = {}
         self.progress: Dict[int, Tuple[int, int]] = {}  # call -> (written, total)
         self._cv = threading.Condition()
         # the trainer thread schedules while the stager thread streams items:
@@ -133,9 +133,9 @@ class _PipeWorker:
                 with self._cv:
                     self.progress[call_idx] = (written, total)
                 continue
-            _, call_idx, err, dur = frame  # "done"
+            _, call_idx, err, dur, *rest = frame  # "done" (+stats since v2)
             with self._cv:
-                self.results[call_idx] = (err, dur)
+                self.results[call_idx] = (err, dur, rest[0] if rest else None)
                 self._cv.notify_all()
         with self._cv:
             self._cv.notify_all()
@@ -215,6 +215,7 @@ class PersistentAsyncCaller:
         self._worker: Optional[_PipeWorker] = None
         self._inflight: Dict[int, bool] = {}
         self._failed: Dict[int, str] = {}
+        self._stats: Dict[int, Optional[dict]] = {}
 
     def _ensure_worker(self) -> _PipeWorker:
         if self._worker is None or not self._worker.alive:
@@ -244,12 +245,13 @@ class PersistentAsyncCaller:
         with self._worker._cv:
             done = list(self._worker.results.items())
             self._worker.results.clear()
-        for call_idx, (err, dur) in done:
+        for call_idx, (err, dur, stats) in done:
             self._inflight.pop(call_idx, None)
             if err is not None:
                 self._failed[call_idx] = err
                 log.error("async checkpoint call %s failed: %s", call_idx, err)
             else:
+                self._stats[call_idx] = stats
                 log.debug("async call %s finished in %.2fs", call_idx, dur)
         if not self._worker.alive and self._inflight:
             for idx in list(self._inflight):
@@ -262,6 +264,11 @@ class PersistentAsyncCaller:
 
     def error(self, call_idx: int) -> Optional[str]:
         return self._failed.get(call_idx)
+
+    def stats(self, call_idx: int) -> Optional[dict]:
+        """The completed call's reported stats dict (drain accounting), if
+        the called fn returned one."""
+        return self._stats.get(call_idx)
 
     def wait(self, call_idx: int, timeout: float = 600.0) -> None:
         deadline = time.monotonic() + timeout
@@ -295,6 +302,7 @@ class TemporalAsyncCaller:
     def __init__(self):
         self._workers: Dict[int, _PipeWorker] = {}
         self._failed: Dict[int, str] = {}
+        self._stats: Dict[int, Optional[dict]] = {}
 
     def schedule(self, call_idx: int, fn: Callable, args: Tuple) -> None:
         worker = _PipeWorker()
@@ -320,9 +328,11 @@ class TemporalAsyncCaller:
             return True
         with worker._cv:
             if call_idx in worker.results:
-                err, _ = worker.results.pop(call_idx)
+                err, _dur, stats = worker.results.pop(call_idx)
                 if err is not None:
                     self._failed[call_idx] = err
+                else:
+                    self._stats[call_idx] = stats
                 worker.shutdown(timeout=5)
                 del self._workers[call_idx]
                 return True
@@ -334,6 +344,9 @@ class TemporalAsyncCaller:
 
     def error(self, call_idx: int) -> Optional[str]:
         return self._failed.get(call_idx)
+
+    def stats(self, call_idx: int) -> Optional[dict]:
+        return self._stats.get(call_idx)
 
     def wait(self, call_idx: int, timeout: float = 600.0) -> None:
         deadline = time.monotonic() + timeout
@@ -366,6 +379,9 @@ class AsyncCallsQueue:
         self.sync_fn = sync_fn or (lambda call_idx, done: done)
         self._call_idx = 0
         self._pending: List[AsyncRequest] = []
+        # drain accounting of the most recently finalized call (the worker
+        # reports it in the done frame; None for fns that return nothing)
+        self.last_call_stats: Optional[dict] = None
 
     def schedule_async_request(self, req: AsyncRequest) -> int:
         self._call_idx += 1
@@ -453,6 +469,9 @@ class AsyncCallsQueue:
                     fn()
             finally:
                 req.run_cleanup()
+            stats = self.caller.stats(req.call_idx)
+            if stats is not None:
+                self.last_call_stats = stats
             record_event(ProfilingEvent.CHECKPOINT_SAVE_FINALIZED, call_idx=req.call_idx)
             self._pending.pop(0)
             finalized.append(req.call_idx)

@@ -26,7 +26,11 @@ Requests (trainer → worker):
 
 Responses (worker → trainer):
 
-    ("done", call_idx, error_str_or_None, duration_s)
+    ("done", call_idx, error_str_or_None, duration_s, stats_dict_or_None)
+                                     ``stats`` is the called fn's return
+                                     value when it is a dict (the drain
+                                     engine reports bytes/chunks/digest
+                                     accounting this way)
     ("prog", call_idx, bytes_written, bytes_total)   drain progress, emitted
                                      by streamed fns through ``progress_cb``
 
@@ -120,7 +124,7 @@ def main() -> None:
         t0 = time.monotonic()
         try:
             if item_q is None:
-                fn(*args)
+                ret = fn(*args)
             else:
                 def items():
                     while True:
@@ -134,11 +138,12 @@ def main() -> None:
                 def progress(written, total):
                     send(("prog", call_idx, int(written), int(total)))
 
-                fn(*args, items(), progress)
-            send(("done", call_idx, None, time.monotonic() - t0))
+                ret = fn(*args, items(), progress)
+            send(("done", call_idx, None, time.monotonic() - t0,
+                  ret if isinstance(ret, dict) else None))
         except BaseException as exc:  # noqa: BLE001 - report to trainer
             send(("done", call_idx, f"{type(exc).__name__}: {exc}",
-                  time.monotonic() - t0))
+                  time.monotonic() - t0, None))
 
     def spawn(call_idx, fn, args, item_q=None) -> None:
         t = threading.Thread(

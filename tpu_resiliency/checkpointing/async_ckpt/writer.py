@@ -43,6 +43,15 @@ Drain engine (:class:`_WriteEngine`):
   and reports drain progress (bytes written / total) through the worker
   pipe, so the drain starts persisting the first staged shards while later
   leaves are still in flight.
+- **Content digests.**  Every chunk is crc32'd as it is written (the bytes
+  are already in cache, and ``zlib.crc32`` releases the GIL, so the digest
+  hides behind the pool's I/O waits); the per-chunk ``(off, len, crc)``
+  spans plus a composed per-shard digest (``integrity.combine_crcs``) land
+  in the process index and — via the metadata merge — in ``metadata.json``.
+  ``read_leaf`` verifies every shard against them through the verifying
+  reader before a single element reaches a template leaf.  Disable with
+  ``TPURX_CKPT_DIGEST=0`` (or per-save ``digest=False``) for A/B
+  measurement; readers treat digest-less shards as legacy (size check only).
 """
 
 from __future__ import annotations
@@ -55,6 +64,7 @@ import time
 
 from ...telemetry import BYTE_BUCKETS, counter, gauge, histogram
 from ...utils.shm import attach_shm
+from ..integrity import combine_crcs, crc32, read_verified_shard
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -119,7 +129,8 @@ def _fsync_dir(path: str) -> None:
 class _ShardSink:
     """One shard file being assembled from chunks (possibly by many threads)."""
 
-    def __init__(self, pdir: str, payload: Dict[str, Any], use_direct: bool):
+    def __init__(self, pdir: str, payload: Dict[str, Any], use_direct: bool,
+                 digest: bool = True):
         self.payload = payload
         self.nbytes = int(payload["nbytes"])
         self.final = os.path.join(
@@ -129,6 +140,9 @@ class _ShardSink:
         self.shm = None
         self.lock = threading.Lock()
         self.chunks_left = 0           # set by the engine before enqueueing
+        self.digest = digest
+        self.chunk_digests: List[Tuple[int, int, int]] = []  # (off, len, crc)
+        self.crc_ns = 0                # CPU ns spent digesting (stats)
         self.fd_direct = -1
         self.fd_buf = -1
         # the planned direct/buffered split; if the O_DIRECT open later
@@ -168,6 +182,12 @@ class _ShardSink:
         self._ensure_open()
         mv = self.shm.buf[off : off + length]
         try:
+            if self.digest and length:
+                t0 = time.monotonic_ns()
+                c = crc32(mv)
+                with self.lock:
+                    self.chunk_digests.append((off, length, c))
+                    self.crc_ns += time.monotonic_ns() - t0
             if self.fd_direct >= 0 and off < self.aligned_end:
                 fd = self.fd_direct
             else:
@@ -179,13 +199,19 @@ class _ShardSink:
             mv.release()
 
     def complete(self) -> None:
-        """Last chunk landed: one durability pass + atomic rename."""
+        """Last chunk landed: one durability pass + atomic rename; the
+        chunk digests recorded along the way fold into the payload so the
+        process index carries them."""
         self._ensure_open()  # zero-chunk (empty) shards still create a file
         for fd in (self.fd_direct, self.fd_buf):
             if fd >= 0:
                 os.fdatasync(fd)
                 os.close(fd)
         self.fd_direct = self.fd_buf = -1
+        if self.digest:
+            spans = sorted(self.chunk_digests)
+            self.payload["chunks"] = [list(s) for s in spans]
+            self.payload["crc"] = combine_crcs([c for _o, _l, c in spans])
         os.replace(self.tmp, self.final)
         self._close_shm()
 
@@ -225,6 +251,7 @@ class _WriteEngine:
         plan_sig: str,
         progress_cb: Optional[Callable[[int, int], None]] = None,
         chunk_bytes: Optional[int] = None,
+        digest: Optional[bool] = None,
     ):
         self.ckpt_dir = ckpt_dir
         self.process_index = process_index
@@ -232,6 +259,9 @@ class _WriteEngine:
         self.save_id = save_id
         self.plan_sig = plan_sig
         self.chunk_bytes = chunk_bytes or default_chunk_bytes()
+        if digest is None:
+            digest = os.environ.get("TPURX_CKPT_DIGEST", "1") != "0"
+        self.digest = digest
         self.use_direct = os.environ.get("TPURX_CKPT_DIRECT_IO", "1") != "0"
         self.pdir = os.path.join(ckpt_dir, f"process_{process_index}")
         os.makedirs(self.pdir, exist_ok=True)
@@ -266,7 +296,7 @@ class _WriteEngine:
     def add_payload(self, payload: Dict[str, Any]) -> None:
         if not payload.get("shm_name"):
             return  # non-owned: metadata-only entry, nothing to write
-        sink = _ShardSink(self.pdir, payload, self.use_direct)
+        sink = _ShardSink(self.pdir, payload, self.use_direct, self.digest)
         _SHARD_BYTES.observe(sink.nbytes)
         # Chunks never straddle the direct/buffered boundary: the region
         # below ``aligned_end`` splits into block-aligned chunks for the
@@ -292,9 +322,11 @@ class _WriteEngine:
                 self._pending_chunks += 1
             self._cv.notify_all()
 
-    def finish(self) -> None:
+    def finish(self) -> Dict[str, Any]:
         """Wait for every chunk, then commit the per-process index (its
-        atomic rename is the per-process commit) and fsync the directory."""
+        atomic rename is the per-process commit) and fsync the directory.
+        Returns drain stats (bytes/chunks/digest accounting) — the worker
+        reports them back to the trainer in the done frame."""
         with self._cv:
             self._closed = True
             self._cv.notify_all()
@@ -312,6 +344,7 @@ class _WriteEngine:
             "plan_sig": self.plan_sig,
             "write_threads": self.num_threads,
             "chunk_bytes": self.chunk_bytes,
+            "digest": self.digest,
             "shards": [
                 {k: v for k, v in p.items() if k != "shm_name"}
                 for p in self.payloads_done
@@ -330,6 +363,14 @@ class _WriteEngine:
         if self.bytes_written and elapsed_ns:
             _DRAIN_BPS.set(self.bytes_written / (elapsed_ns / 1e9))
         self._report_progress(force=True)
+        return {
+            "bytes_written": self.bytes_written,
+            "shards": len(self.payloads_done),
+            "drain_ns": elapsed_ns,
+            "crc_ns": sum(s.crc_ns for s in self._sinks),
+            "crc_chunks": sum(len(s.chunk_digests) for s in self._sinks),
+            "digest": self.digest,
+        }
 
     def abort(self, exc: Optional[BaseException] = None) -> None:
         with self._cv:
@@ -426,11 +467,13 @@ def write_process_shards(
     save_id: str = "default",
     plan_sig: str = "",
     progress_cb: Optional[Callable[[int, int], None]] = None,
-) -> None:
+    digest: Optional[bool] = None,
+) -> Dict[str, Any]:
     """Worker-process entry (full plan known up-front): write every owned
     shard from shm through the chunk engine, then the per-process index."""
     engine = _WriteEngine(
-        ckpt_dir, process_index, num_threads, save_id, plan_sig, progress_cb
+        ckpt_dir, process_index, num_threads, save_id, plan_sig, progress_cb,
+        digest=digest,
     )
     try:
         owned = [p for p in payloads if p["shm_name"]]
@@ -441,7 +484,7 @@ def write_process_shards(
     except BaseException as exc:
         engine.abort(exc)
         raise
-    engine.finish()
+    return engine.finish()
 
 
 def write_process_shards_streamed(
@@ -450,16 +493,18 @@ def write_process_shards_streamed(
     num_threads: Optional[int],
     save_id: str,
     plan_sig: str,
+    digest: Optional[bool],
     items: Iterable[Tuple[str, Any]],
     progress_cb: Optional[Callable[[int, int], None]] = None,
-) -> None:
+) -> Dict[str, Any]:
     """Worker-process entry (streamed plan): consume ``("plan", total_bytes)``
     then ``("shards", [payload, ...])`` items as the trainer stages them —
     the first shard hits disk while later leaves are still staging.  The
     item iterator raising (stream abort: staging failed trainer-side)
     aborts the engine and re-raises, leaving no committed index."""
     engine = _WriteEngine(
-        ckpt_dir, process_index, num_threads, save_id, plan_sig, progress_cb
+        ckpt_dir, process_index, num_threads, save_id, plan_sig, progress_cb,
+        digest=digest,
     )
     try:
         for kind, value in items:
@@ -473,7 +518,7 @@ def write_process_shards_streamed(
     except BaseException as exc:
         engine.abort(exc)
         raise
-    engine.finish()
+    return engine.finish()
 
 
 def write_metadata(
@@ -513,7 +558,11 @@ def read_metadata(ckpt_dir: str) -> Dict[str, Any]:
 
 
 def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
-    """Assemble a full global array for one leaf from its shards."""
+    """Assemble a full global array for one leaf from its shards.  Every
+    shard file is digest-verified against the index-recorded chunk crcs
+    before any element is placed — a torn or bit-flipped shard raises
+    :class:`..integrity.CheckpointCorruptError` instead of restoring
+    silently-wrong weights."""
     from ...utils.dtypes import from_bytes, resolve_dtype
 
     shards = [s for s in meta["shards"] if s["leaf_idx"] == leaf_idx]
@@ -525,8 +574,14 @@ def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
     covered = np.zeros(global_shape, dtype=bool) if global_shape else None
     for s in shards:
         pdir = os.path.join(ckpt_dir, f"process_{s['process_index']}")
-        with open(os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])), "rb") as f:
-            arr = from_bytes(f.read(), s["dtype"], s["shape"])
+        raw = read_verified_shard(
+            os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])),
+            nbytes=s.get("nbytes"),
+            crc=s.get("crc"),
+            chunks=s.get("chunks"),
+            site="global_shard",
+        )
+        arr = from_bytes(raw, s["dtype"], s["shape"])
         slices = tuple(slice(a, b) for a, b in s["index"])
         out[slices] = arr
         if covered is not None:

@@ -14,7 +14,32 @@ Capability parity with ``BaseCheckpointManager`` / ``LocalCheckpointManager``
   one holder per missing rank and peers push blobs over TCP (reference
   retrieval plan + P2P exchange ``:205-234``).
 
-File layout: <root>/iter_<I>/rank_<R>.tpurx (+ .done marker per blob).
+Integrity (see ``checkpointing/integrity.py``): every blob carries a crc32
+frame footer sealed at serialization time, and every read across a trust
+boundary verifies it —
+
+- ``load`` verifies its own blob before parsing; a corrupt blob is
+  **quarantined** (renamed ``*.corrupt``, ``.done`` dropped, holdings
+  republished) and the rank falls through to peer retrieval;
+- ``_retrieve_from_peers`` verifies on BOTH ends: the elected holder checks
+  each blob before serving (a corrupt one is quarantined and a sentinel is
+  sent so the receiver never blocks), the receiver checks after
+  ``execute_plan``, and a cross-rank verdict round over the KV store decides
+  whether the exchange plan must be **re-run excluding the corrupt/dead
+  holder** (re-election serves a valid replica instead);
+- ``load(fallback=True)`` walks the retained history newest-first: each
+  candidate is gated by a cross-rank **validity round** (every rank verifies
+  the blobs it holds for the candidate, quarantines failures, republishes,
+  and the round passes only if the surviving union still covers every rank)
+  — the restored iteration is the newest one valid everywhere, and the
+  fallback depth is exported (``tpurx_ckpt_fallback_depth``);
+- an opt-in background **scrubber** re-verifies retained iterations during
+  idle time so bit rot is caught while peers still hold replacements, not at
+  restore time.
+
+File layout: <root>/iter_<I>/rank_<R>.tpurx (+ .done marker per blob;
+quarantined blobs keep their bytes as ``rank_<R>.tpurx.corrupt`` for
+post-mortem but never count toward holdings).
 """
 
 from __future__ import annotations
@@ -27,14 +52,36 @@ import threading
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...store.barrier import barrier
+from ...telemetry import counter, gauge
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
+from ..integrity import (
+    CORRUPT_SENTINEL,
+    CheckpointCorruptError,
+    quarantine_blob,
+    read_verified_blob,
+    verify_blob,
+)
 from .replication import CliqueReplication
 from .state_dict import TensorAwareTree
 
 log = get_logger("local_ckpt")
 
 _ITER_RE = re.compile(r"^iter_(\d+)$")
+
+_FALLBACK_DEPTH = gauge(
+    "tpurx_ckpt_fallback_depth",
+    "How many newer candidate iterations the last local restore had to "
+    "skip before finding one valid on every rank (0 = newest was good)",
+)
+_FALLBACK_LOADS = counter(
+    "tpurx_ckpt_fallback_loads_total",
+    "Local restores that fell back past at least one invalid iteration",
+)
+_SCRUB_PASSES = counter(
+    "tpurx_ckpt_scrub_passes_total",
+    "Completed background scrubber sweeps over retained iterations",
+)
 
 
 class LocalCheckpointManager:
@@ -47,6 +94,9 @@ class LocalCheckpointManager:
         replication: Optional[CliqueReplication] = None,
         keep_last: int = 2,
         session: str = "default",
+        peer_timeout: float = 120.0,
+        scrub_interval: Optional[float] = None,
+        store_namespace: str = "localckpt",
     ):
         self.root = os.path.join(root_dir, session)
         self.rank = rank
@@ -54,6 +104,15 @@ class LocalCheckpointManager:
         self.store = store
         self.replication = replication
         self.keep_last = keep_last
+        # bounds ONE peer-retrieval exchange round (election + transfer);
+        # a dead holder surfaces as a timeout feeding re-election instead
+        # of wedging the restore
+        self.peer_timeout = peer_timeout
+        # Store-key namespace for holdings/barriers/verdicts.  Restarted
+        # incarnations should pass a cycle-fenced namespace (e.g.
+        # "localckpt/c3"): barrier and verdict keys from a previous
+        # incarnation must never satisfy this one's collective rounds.
+        self._ns = store_namespace
         os.makedirs(self.root, exist_ok=True)
         self._bg: Optional[threading.Thread] = None
         self._bg_error: Optional[BaseException] = None
@@ -61,6 +120,14 @@ class LocalCheckpointManager:
         # generation counters keep their barrier keys unique per invocation
         self._find_gen = 0
         self._load_gen = 0
+        self._valid_gen = 0
+        self._scrubber: Optional[threading.Thread] = None
+        self._scrub_stop = threading.Event()
+        if scrub_interval is None:
+            env = os.environ.get("TPURX_CKPT_SCRUB_INTERVAL", "")
+            scrub_interval = float(env) if env else None
+        if scrub_interval:
+            self.start_scrubber(scrub_interval)
 
     # -- paths -------------------------------------------------------------
 
@@ -71,19 +138,30 @@ class LocalCheckpointManager:
         return os.path.join(self._iter_dir(iteration), f"rank_{data_rank}.tpurx")
 
     def _holdings(self) -> Dict[int, List[int]]:
-        """{iteration: [data_ranks held locally]} — only committed blobs."""
+        """{iteration: [data_ranks held locally]} — only committed blobs.
+        Quarantined blobs (``*.corrupt``) never match and never count.
+        Directory scans race concurrent cleanup/quarantine from other
+        threads — a vanished entry is simply not a holding."""
         out: Dict[int, List[int]] = {}
-        if not os.path.isdir(self.root):
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
             return out
-        for name in os.listdir(self.root):
+        for name in names:
             m = _ITER_RE.match(name)
             if not m:
                 continue
             iteration = int(m.group(1))
             d = os.path.join(self.root, name)
+            if not os.path.isdir(d):
+                continue
+            try:
+                entries = os.listdir(d)
+            except FileNotFoundError:
+                continue  # cleanup/quarantine deleted it mid-scan
             ranks = [
                 int(f[len("rank_"):-len(".tpurx")])
-                for f in os.listdir(d)
+                for f in entries
                 if f.startswith("rank_") and f.endswith(".tpurx")
                 and os.path.exists(os.path.join(d, f) + ".done")
             ]
@@ -96,10 +174,16 @@ class LocalCheckpointManager:
     def save(self, tree, iteration: int, is_async: bool = True) -> None:
         """Serialize + replicate + write.  With ``is_async`` the file writes
         and holdings publication happen on a background thread; replication
-        (DCN-bound, needs all ranks) stays synchronous."""
+        (DCN-bound, needs all ranks) stays synchronous.
+
+        Blobs are sealed with the integrity footer at serialization time and
+        replica blobs received from clique peers are verified BEFORE being
+        written — a transport-corrupted replica is rejected at save time
+        (while the sender still has the good copy) instead of surfacing as a
+        quarantine at restore time."""
         record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, kind="local", iteration=iteration)
         tat = TensorAwareTree.from_tree(tree, to_host=True)
-        blob = tat.to_bytes()
+        blob = tat.to_bytes()  # sealed: trailing crc32 frame footer
         if self.replication is not None:
             blobs = self.replication.replicate(blob, tag=iteration & 0x3FFFFFFF)
         else:
@@ -109,6 +193,16 @@ class LocalCheckpointManager:
             d = self._iter_dir(iteration)
             os.makedirs(d, exist_ok=True)
             for data_rank, data in blobs.items():
+                if data_rank != self.rank:
+                    try:
+                        verify_blob(data, site="replica_recv")
+                    except CheckpointCorruptError:
+                        log.warning(
+                            "dropping corrupt replica of rank %s at iteration "
+                            "%s (transport corruption; holder keeps serving)",
+                            data_rank, iteration,
+                        )
+                        continue
                 path = self._blob_path(iteration, data_rank)
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
@@ -153,7 +247,7 @@ class LocalCheckpointManager:
         if self.store is None:
             return
         holdings = {str(k): v for k, v in self._holdings().items()}
-        self.store.set(f"localckpt/holdings/{self.rank}", json.dumps(holdings))
+        self.store.set(f"{self._ns}/holdings/{self.rank}", json.dumps(holdings))
 
     def _cleanup(self) -> None:
         iters = sorted(self._holdings())
@@ -161,34 +255,106 @@ class LocalCheckpointManager:
             shutil.rmtree(self._iter_dir(old), ignore_errors=True)
         # reclaim crash debris: iter dirs with no committed blob, but only
         # ones OLDER than a committed iteration — the newest uncommitted dir
-        # may be a save in progress
+        # may be a save in progress.  Both listdir passes race concurrent
+        # deletion (another rank's manager on a shared mount, the scrubber,
+        # or our own background save) and non-dir stray files under root.
         if iters:
             newest_committed = iters[-1]
-            for name in os.listdir(self.root):
+            try:
+                names = os.listdir(self.root)
+            except FileNotFoundError:
+                names = []
+            for name in names:
                 m = _ITER_RE.match(name)
                 if m and int(m.group(1)) < newest_committed:
                     d = os.path.join(self.root, name)
-                    if not any(f.endswith(".done") for f in os.listdir(d)):
+                    if not os.path.isdir(d):
+                        continue
+                    try:
+                        entries = os.listdir(d)
+                    except FileNotFoundError:
+                        continue  # deleted between the scans: nothing to do
+                    if not any(f.endswith(".done") for f in entries):
                         shutil.rmtree(d, ignore_errors=True)
         # holdings changed
         self._publish_holdings()
 
+    # -- integrity: verify / quarantine / scrub ----------------------------
+
+    def _quarantine(self, iteration: int, data_rank: int, site: str) -> None:
+        quarantine_blob(self._blob_path(iteration, data_rank), site=site)
+        self._publish_holdings()
+
+    def verify_iteration(self, iteration: int, site: str = "local_blob") -> bool:
+        """Verify every blob this rank holds for ``iteration``; quarantine
+        failures (and republish holdings).  True iff nothing was corrupt."""
+        local = self._holdings().get(iteration, [])
+        clean = True
+        for data_rank in local:
+            path = self._blob_path(iteration, data_rank)
+            try:
+                read_verified_blob(path, site=site)
+            except (CheckpointCorruptError, OSError) as exc:
+                log.warning(
+                    "iteration %s rank-%s blob failed verification (%s); "
+                    "quarantining", iteration, data_rank, exc,
+                )
+                self._quarantine(iteration, data_rank, site=site)
+                clean = False
+        return clean
+
+    def scrub_once(self) -> int:
+        """One scrub sweep: re-verify every retained blob.  Returns the
+        number of blobs quarantined.  Catching rot while peers still hold
+        replacements is the whole point — at restore time it is too late to
+        re-replicate."""
+        quarantined = 0
+        for iteration in sorted(self._holdings()):
+            if not self.verify_iteration(iteration, site="scrub"):
+                quarantined += 1
+            if self._scrub_stop.is_set():
+                break
+        _SCRUB_PASSES.inc()
+        return quarantined
+
+    def start_scrubber(self, interval_s: float = 300.0) -> None:
+        """Opt-in background integrity scrubber (idle-time re-verification
+        of retained iterations).  Also armed by ``TPURX_CKPT_SCRUB_INTERVAL``
+        or the ``scrub_interval`` constructor knob."""
+        if self._scrubber is not None and self._scrubber.is_alive():
+            return
+        self._scrub_stop.clear()
+
+        def _loop():
+            while not self._scrub_stop.wait(interval_s):
+                try:
+                    self.scrub_once()
+                except Exception:  # noqa: BLE001 - scrubbing is best-effort
+                    log.exception("checkpoint scrub sweep failed")
+
+        self._scrubber = threading.Thread(
+            target=_loop, name="tpurx-ckpt-scrub", daemon=True
+        )
+        self._scrubber.start()
+
+    def stop_scrubber(self) -> None:
+        self._scrub_stop.set()
+        if self._scrubber is not None:
+            self._scrubber.join(timeout=10)
+            self._scrubber = None
+
     # -- find_latest -------------------------------------------------------
 
-    def find_latest(self, gather_timeout: float = 60.0) -> Optional[int]:
-        """Highest iteration whose union of holders covers every rank."""
-        self.wait()
+    def _gather_coverage(self, gather_timeout: float = 60.0) -> Dict[int, Set[int]]:
+        """Collective: publish holdings, fence, and gather every rank's —
+        {iteration: union of held data_ranks}."""
         if self.store is None or self.world_size == 1:
-            local = self._holdings()
-            mine = [
-                it for it, ranks in local.items() if set(range(self.world_size)) <= set(ranks)
-            ]
-            return max(mine) if mine else None
+            return {it: set(ranks) for it, ranks in self._holdings().items()}
         self._publish_holdings()
         gen = self._find_gen
         self._find_gen += 1
         barrier(
-            self.store, f"localckpt/find_latest/{gen}",
+            self.store, f"{self._ns}/find_latest/{gen}",
             self.world_size, timeout=gather_timeout,
         )
         coverage: Dict[int, Set[int]] = {}
@@ -196,7 +362,7 @@ class LocalCheckpointManager:
         # gather them in ONE round trip.  A miss here means the store lost
         # state mid-protocol (e.g. failover to a fresh store) — surface it,
         # the same policy as every post-barrier multi_get in this codebase.
-        keys = [f"localckpt/holdings/{r}" for r in range(self.world_size)]
+        keys = [f"{self._ns}/holdings/{r}" for r in range(self.world_size)]
         raws = self.store.multi_get(keys)
         if raws is None:
             raise RuntimeError(
@@ -206,19 +372,39 @@ class LocalCheckpointManager:
         for raw in raws:
             for it_s, data_ranks in json.loads(raw).items():
                 coverage.setdefault(int(it_s), set()).update(data_ranks)
-        full = [
-            it for it, ranks in coverage.items() if set(range(self.world_size)) <= ranks
-        ]
-        return max(full) if full else None
+        return coverage
+
+    def find_candidates(self, gather_timeout: float = 60.0) -> List[int]:
+        """Fully-covered iterations, newest first — the fallback ladder's
+        rungs.  Collective (one holdings gather round)."""
+        self.wait()
+        coverage = self._gather_coverage(gather_timeout)
+        everyone = set(range(self.world_size))
+        return sorted(
+            (it for it, ranks in coverage.items() if everyone <= ranks),
+            reverse=True,
+        )
+
+    def find_latest(self, gather_timeout: float = 60.0) -> Optional[int]:
+        """Highest iteration whose union of holders covers every rank."""
+        candidates = self.find_candidates(gather_timeout)
+        return candidates[0] if candidates else None
 
     # -- load --------------------------------------------------------------
 
     def _exchange_plan(
-        self, iteration: int, all_holdings: Dict[int, Dict[int, List[int]]]
+        self,
+        iteration: int,
+        all_holdings: Dict[int, Dict[int, List[int]]],
+        excluded: Optional[Set[int]] = None,
     ) -> Tuple[List[Tuple[int, int]], Optional[int]]:
         """Deterministic sender election (reference sender election
         ``strategies.py:142-179``).  Returns (my_sends as (to_rank, data_rank)
-        list, my_source holder rank or None if local)."""
+        list, my_source holder rank or None if local).  ``excluded`` ranks
+        (quarantined or unresponsive holders from a previous exchange round)
+        are never elected to serve OTHERS — a rank reading its own intact
+        blob stays local regardless."""
+        excluded = excluded or set()
         my_sends: List[Tuple[int, int]] = []
         my_source: Optional[int] = None
         for r in range(self.world_size):
@@ -227,45 +413,150 @@ class LocalCheckpointManager:
                 for h, holds in all_holdings.items()
                 if r in holds.get(iteration, [])
             )
-            if not holders:
-                raise FileNotFoundError(
-                    f"iteration {iteration}: no holder for rank {r}'s data"
-                )
             if r in holders:
                 source = None  # r has its own data
             else:
-                source = holders[0]
+                eligible = [h for h in holders if h not in excluded]
+                if not eligible:
+                    raise FileNotFoundError(
+                        f"iteration {iteration}: no eligible holder for rank "
+                        f"{r}'s data (holders={holders}, excluded="
+                        f"{sorted(excluded)})"
+                    )
+                source = eligible[0]
             if r == self.rank:
                 my_source = source
             if source == self.rank:
                 my_sends.append((r, r))
         return my_sends, my_source
 
-    def load(self, template, iteration: Optional[int] = None):
-        """Load (iteration or latest). Returns (tree, iteration)."""
+    def load(
+        self,
+        template,
+        iteration: Optional[int] = None,
+        fallback: bool = False,
+    ):
+        """Load (iteration, latest, or — with ``fallback`` — the newest
+        iteration that is *valid everywhere*).  Returns (tree, iteration).
+
+        Every byte is verified before it is believed: the own-blob path
+        checks the frame footer (corrupt → quarantine → peer retrieval),
+        and peer retrieval verifies on both ends with holder re-election on
+        mismatch.  With ``fallback=False`` (default) a restore whose newest
+        candidate is unrecoverable raises; with ``fallback=True`` the
+        manager walks ``find_candidates`` newest-first, gating each rung on
+        a cross-rank validity round, and restores the first rung valid on
+        all ranks — ``tpurx_ckpt_fallback_depth`` records how far it fell.
+        """
         record_event(ProfilingEvent.CHECKPOINT_LOAD_STARTED, kind="local")
+        depth = 0
         if iteration is None:
-            iteration = self.find_latest()
-            if iteration is None:
-                raise FileNotFoundError("no fully-covered local checkpoint")
+            iteration, blob, depth = self._load_ladder(fallback)
+        else:
+            self.wait()
+            blob = self._obtain_blob(iteration)
+        # zero-copy parse: device_put consumes the views straight out of the
+        # blob; host leaves are copied out by to_tree (views never escape).
+        # The integrity footer is a trailer — offset-based parsing never
+        # touches it, and the blob was verified before we got here.
+        tat = TensorAwareTree.from_bytes(blob, copy=False)
+        tree = tat.to_tree_like(template)
+        _FALLBACK_DEPTH.set(depth)
+        if depth:
+            _FALLBACK_LOADS.inc()
+        record_event(
+            ProfilingEvent.CHECKPOINT_LOAD_COMPLETED, kind="local",
+            iteration=iteration, fallback_depth=depth,
+        )
+        return tree, iteration
+
+    def _load_ladder(self, fallback: bool) -> Tuple[int, bytes, int]:
+        """Walk fully-covered iterations newest-first; each rung is gated by
+        a cross-rank validity round, then actually retrieved (which may
+        itself discover corruption mid-exchange and re-elect or fail the
+        rung).  Returns (iteration, blob, depth)."""
+        tried: Set[int] = set()
+        depth = 0
+        while True:
+            candidates = [it for it in self.find_candidates() if it not in tried]
+            if not candidates:
+                raise FileNotFoundError(
+                    "no valid fully-covered local checkpoint"
+                    + (f" (rejected iterations: {sorted(tried)})" if tried else "")
+                )
+            it = candidates[0]
+            tried.add(it)
+            if not self._validity_round(it):
+                log.warning(
+                    "iteration %s failed the cross-rank validity round%s",
+                    it, "" if fallback else " (fallback disabled)",
+                )
+                if not fallback:
+                    raise CheckpointCorruptError(
+                        f"iteration {it} failed cross-rank validity and "
+                        "fallback is disabled", site="validity_round")
+                depth += 1
+                continue
+            try:
+                return it, self._obtain_blob(it), depth
+            except (CheckpointCorruptError, FileNotFoundError, TimeoutError) as exc:
+                if not fallback:
+                    raise
+                log.warning(
+                    "iteration %s unrecoverable after re-election (%s); "
+                    "falling back", it, exc,
+                )
+                depth += 1
+
+    def _validity_round(self, iteration: int) -> bool:
+        """Cross-rank gate for one fallback rung: every rank verifies the
+        blobs it holds for ``iteration`` (quarantining failures), publishes
+        by republishing holdings, and the rung passes iff the union of
+        SURVIVING holders still covers every rank.  Single-rank managers
+        degrade to the local check."""
+        self.verify_iteration(iteration)
+        if self.store is None or self.world_size == 1:
+            coverage = {it: set(r) for it, r in self._holdings().items()}
+            return set(range(self.world_size)) <= coverage.get(iteration, set())
+        self._publish_holdings()
+        gen = self._valid_gen
+        self._valid_gen += 1
+        barrier(
+            self.store, f"{self._ns}/validity/{gen}", self.world_size,
+            timeout=120.0,
+        )
+        keys = [f"{self._ns}/holdings/{r}" for r in range(self.world_size)]
+        raws = self.store.multi_get(keys)
+        if raws is None:
+            raise RuntimeError(
+                "holdings vanished after the validity barrier (store lost "
+                "state mid-protocol?)"
+            )
+        covered: Set[int] = set()
+        for raw in raws:
+            covered.update(json.loads(raw).get(str(iteration), []))
+        return set(range(self.world_size)) <= covered
+
+    def _obtain_blob(self, iteration: int) -> bytes:
+        """This rank's blob for ``iteration``: the local copy when intact
+        (verified; corrupt → quarantined), else retrieved from peers."""
         path = self._blob_path(iteration, self.rank)
         blob: Optional[bytes] = None
         if os.path.exists(path) and os.path.exists(path + ".done"):
-            with open(path, "rb") as f:
-                blob = f.read()
+            try:
+                blob = read_verified_blob(path, site="local_blob")
+            except CheckpointCorruptError as exc:
+                log.warning(
+                    "own blob for iteration %s corrupt (%s); quarantining "
+                    "and retrieving from peers", iteration, exc,
+                )
+                self._quarantine(iteration, self.rank, site="local_blob")
         if blob is None:
             blob = self._retrieve_from_peers(iteration)
         elif self.store is not None and self.replication is not None:
             # still participate in the exchange plan as a sender
             self._retrieve_from_peers(iteration, have_own=True)
-        # zero-copy parse: device_put consumes the views straight out of the
-        # blob; host leaves are copied out by to_tree (views never escape)
-        tat = TensorAwareTree.from_bytes(blob, copy=False)
-        tree = tat.to_tree_like(template)
-        record_event(
-            ProfilingEvent.CHECKPOINT_LOAD_COMPLETED, kind="local", iteration=iteration
-        )
-        return tree, iteration
+        return blob
 
     def _retrieve_from_peers(self, iteration: int, have_own: bool = False) -> Optional[bytes]:
         if self.store is None or self.replication is None:
@@ -273,33 +564,136 @@ class LocalCheckpointManager:
                 f"rank {self.rank}: no local blob for iteration {iteration} "
                 "and no replication configured"
             )
-        # Republish holdings and fence: a rank restored on a fresh node must
-        # not be elected to serve blobs it no longer has (stale store state).
-        self._publish_holdings()
-        gen = self._load_gen
-        self._load_gen += 1
-        barrier(
-            self.store, f"localckpt/load/{gen}", self.world_size, timeout=120.0
-        )
-        all_holdings: Dict[int, Dict[int, List[int]]] = {}
-        for r in range(self.world_size):
-            raw = self.store.try_get(f"localckpt/holdings/{r}")
-            holdings = json.loads(raw) if raw else {}
-            all_holdings[r] = {int(k): v for k, v in holdings.items()}
-        my_sends, my_source = self._exchange_plan(iteration, all_holdings)
-        sends = []
-        for to_rank, data_rank in my_sends:
-            with open(self._blob_path(iteration, data_rank), "rb") as f:
-                sends.append((to_rank, (iteration & 0x3FFFFFF) | 0x4000000, f.read()))
-        recvs = []
-        if not have_own and my_source is not None:
-            recvs.append((my_source, (iteration & 0x3FFFFFF) | 0x4000000))
-        received = self.replication.execute_plan(sends, recvs)
-        if not have_own and my_source is not None:
-            return received[(my_source, (iteration & 0x3FFFFFF) | 0x4000000)]
-        if have_own:
-            return None
-        # my_source None means our own blob should exist — but it didn't
+        excluded: Set[int] = set()
+        # worst case every holder of our data proves corrupt/dead once
+        for attempt in range(self.world_size + 1):
+            # Republish holdings and fence: a rank restored on a fresh node
+            # (or one that just quarantined a blob) must not be elected to
+            # serve blobs it no longer has.
+            self._publish_holdings()
+            gen = self._load_gen
+            self._load_gen += 1
+            barrier(
+                self.store, f"{self._ns}/load/{gen}", self.world_size,
+                timeout=120.0,
+            )
+            all_holdings: Dict[int, Dict[int, List[int]]] = {}
+            for r in range(self.world_size):
+                raw = self.store.try_get(f"{self._ns}/holdings/{r}")
+                holdings = json.loads(raw) if raw else {}
+                all_holdings[r] = {int(k): v for k, v in holdings.items()}
+            my_sends, my_source = self._exchange_plan(
+                iteration, all_holdings, excluded
+            )
+            # exchange-round tag: iteration + attempt, so a late blob from a
+            # previous round can never satisfy this round's receive
+            tag = 0x40000000 | ((attempt & 0x3F) << 24) | (iteration & 0xFFFFFF)
+            sends = []
+            for to_rank, data_rank in my_sends:
+                path = self._blob_path(iteration, data_rank)
+                try:
+                    # the SENDER checks before serving: never replicate bytes
+                    # this host cannot vouch for
+                    payload = read_verified_blob(path, site="peer_send")
+                except (CheckpointCorruptError, OSError) as exc:
+                    log.warning(
+                        "elected to serve rank %s's iteration-%s blob but it "
+                        "failed verification (%s); quarantining and sending "
+                        "the corrupt sentinel", to_rank, iteration, exc,
+                    )
+                    self._quarantine(iteration, data_rank, site="peer_send")
+                    payload = CORRUPT_SENTINEL
+                sends.append((to_rank, tag, payload))
+            recvs = []
+            if not have_own and my_source is not None:
+                recvs.append((my_source, tag))
+            bad_holder: Optional[int] = None
+            blob: Optional[bytes] = None
+            try:
+                received = self.replication.execute_plan(
+                    sends, recvs, timeout=self.peer_timeout
+                )
+            except TimeoutError as exc:
+                # dead/wedged holder: exclude it and re-elect
+                log.warning(
+                    "peer retrieval round %s timed out (%s); flagging holder "
+                    "%s for re-election", attempt, exc, my_source,
+                )
+                bad_holder = my_source
+            else:
+                if recvs:
+                    blob = received[(my_source, tag)]
+                    if bytes(blob) == CORRUPT_SENTINEL:
+                        bad_holder = my_source
+                        blob = None
+                    else:
+                        try:
+                            # the RECEIVER checks after the exchange: the
+                            # wire and the holder's disk are both untrusted
+                            verify_blob(blob, site="peer_recv")
+                        except CheckpointCorruptError as exc:
+                            log.warning(
+                                "blob received from holder %s failed "
+                                "verification (%s)", my_source, exc,
+                            )
+                            bad_holder = my_source
+                            blob = None
+            # Cross-rank verdict round: any rank flagging its holder forces
+            # a re-run of the exchange plan with that holder excluded.
+            verdicts = self._verdict_round(gen, bad_holder)
+            if bad_holder is None and not verdicts:
+                if have_own:
+                    return None
+                if my_source is None:
+                    # plan says our own blob exists — but _obtain_blob found
+                    # none: holdings raced; surface it
+                    raise FileNotFoundError(
+                        f"rank {self.rank}: expected local blob for "
+                        f"iteration {iteration}"
+                    )
+                assert blob is not None
+                return bytes(blob)
+            # quarantine what WE served if a receiver reported us: transport
+            # corruption counts against the copy we hold (the receiver
+            # re-elects a different holder either way)
+            reported_me = {dr for holder, dr in verdicts if holder == self.rank}
+            for to_rank, data_rank in my_sends:
+                if data_rank in reported_me:
+                    self._quarantine(iteration, data_rank, site="peer_reported")
+            excluded |= {holder for holder, _dr in verdicts}
+            log.warning(
+                "re-running exchange plan for iteration %s excluding "
+                "holders %s", iteration, sorted(excluded),
+            )
         raise FileNotFoundError(
-            f"rank {self.rank}: expected local blob for iteration {iteration}"
+            f"iteration {iteration}: peer retrieval exhausted after "
+            f"{self.world_size + 1} rounds (excluded holders: "
+            f"{sorted(excluded)})"
         )
+
+    def _verdict_round(
+        self, gen: int, bad_holder: Optional[int]
+    ) -> Set[Tuple[int, int]]:
+        """Publish this rank's exchange verdict and gather everyone's.
+        Returns {(bad_holder, complaining_data_rank)} — empty means the
+        round was clean on every rank."""
+        self.store.set(
+            f"{self._ns}/xverdict/{gen}/{self.rank}",
+            json.dumps({"bad_holder": bad_holder}),
+        )
+        barrier(
+            self.store, f"{self._ns}/xvote/{gen}", self.world_size, timeout=120.0
+        )
+        keys = [f"{self._ns}/xverdict/{gen}/{r}" for r in range(self.world_size)]
+        raws = self.store.multi_get(keys)
+        if raws is None:
+            raise RuntimeError(
+                "exchange verdicts vanished after the vote barrier (store "
+                "lost state mid-protocol?)"
+            )
+        out: Set[Tuple[int, int]] = set()
+        for r, raw in enumerate(raws):
+            holder = json.loads(raw).get("bad_holder")
+            if holder is not None:
+                out.add((int(holder), r))
+        return out

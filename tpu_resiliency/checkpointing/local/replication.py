@@ -216,7 +216,9 @@ class CliqueReplication:
     def replicate(self, blob: bytes, tag: int) -> Dict[int, bytes]:
         """Send own blob to clique peers; receive theirs.  ``tag`` must be
         unique per (iteration) — it fences late arrivals from old saves.
-        Returns {rank: blob} including self."""
+        Returns {rank: blob} including self.  The whole round shares ONE
+        deadline: a dead clique peer costs at most ``timeout`` total, not
+        ``timeout`` per peer sequentially."""
         me = self.exchange.rank
         peers = [m for m in self.members() if m != me]
         threads = [
@@ -227,11 +229,14 @@ class CliqueReplication:
         ]
         for t in threads:
             t.start()
+        deadline = time.monotonic() + 120.0
         received = {me: blob}
         for p in peers:
-            received[p] = self.exchange.recv(p, tag, timeout=120.0)
+            received[p] = self.exchange.recv(
+                p, tag, timeout=max(0.0, deadline - time.monotonic())
+            )
         for t in threads:
-            t.join(timeout=120.0)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         return received
 
     def execute_plan(
@@ -242,7 +247,13 @@ class CliqueReplication:
     ) -> Dict[Tuple[int, int], bytes]:
         """Run a retrieval exchange plan (reference ``ExchangePlan``,
         ``group_utils.py``): ``sends`` = (to_rank, tag, blob); ``recvs`` =
-        (from_rank, tag).  Returns received blobs keyed by (from_rank, tag)."""
+        (from_rank, tag).  Returns received blobs keyed by (from_rank, tag).
+
+        ``timeout`` bounds the WHOLE plan from entry: every pending receive
+        draws from one shared deadline, so a dead elected holder surfaces as
+        a TimeoutError naming that peer after at most ``timeout`` seconds —
+        feeding the manager's re-election path — instead of blocking the
+        restore for the sum of sequential per-recv timeouts."""
         threads = [
             threading.Thread(
                 target=self.exchange.send, args=(to, tag, blob), daemon=True
@@ -251,9 +262,17 @@ class CliqueReplication:
         ]
         for t in threads:
             t.start()
+        deadline = time.monotonic() + timeout
         out = {}
         for frm, tag in recvs:
-            out[(frm, tag)] = self.exchange.recv(frm, tag, timeout=timeout)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"rank {self.exchange.rank}: exchange-plan deadline "
+                    f"({timeout}s) exhausted before receiving from {frm} "
+                    f"(tag {tag})"
+                )
+            out[(frm, tag)] = self.exchange.recv(frm, tag, timeout=remaining)
         for t in threads:
-            t.join(timeout=timeout)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
         return out

@@ -179,12 +179,21 @@ class TensorAwareTree:
 
     # -- byte serialization ------------------------------------------------
 
-    def to_bytes(self) -> bytes:
+    def to_bytes(self, seal: bool = True) -> bytes:
         """One serialization pass with no per-array intermediate copy: each
         array's buffer is written straight into the output (``tobytes()``
-        would materialize every leaf twice — 2x peak RAM at GiB scale)."""
+        would materialize every leaf twice — 2x peak RAM at GiB scale).
+
+        With ``seal`` (default) the blob carries the integrity footer
+        (``integrity.FOOTER``: magic + crc32 + payload length) appended as a
+        trailer.  :meth:`from_bytes` parses by offsets and never reads the
+        trailer, so sealed and unsealed blobs parse identically — but every
+        trust boundary (manager load, peer exchange, scrubber) verifies the
+        footer before the bytes are believed."""
         if self.arrays is None:
             raise RuntimeError("cannot serialize a hollow tree")
+        from ..integrity import crc32, footer_bytes
+
         header = {
             "treedef": str(self.treedef),
             "leaf_paths": self.leaf_paths,
@@ -201,6 +210,18 @@ class TensorAwareTree:
             a2 = np.ascontiguousarray(a)
             buf.write(_U64.pack(a2.nbytes))
             buf.write(a2.data)
+        if seal:
+            # running crc over the buffer we just built (one pass, no copy)
+            payload_len = buf.tell()
+            buf.seek(0)
+            c = 0
+            while True:
+                block = buf.read(1 << 24)
+                if not block:
+                    break
+                c = crc32(block, c)
+            buf.seek(payload_len)
+            buf.write(footer_bytes(c, payload_len))
         return buf.getvalue()
 
     @classmethod

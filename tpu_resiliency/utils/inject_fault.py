@@ -16,17 +16,29 @@ Optionally gate on rank: TPURX_FAULT_RANKS=0,3
 Optionally gate on restart cycle: TPURX_FAULT_CYCLES=0 (so a fault fires in
 cycle 0 but the restarted cycle runs clean — the reference's
 ``cycle:infra_rank`` injector shape).
+
+Checkpoint-corruption fault classes (integrity tests / soak ``--corrupt-blob``)
+target the newest committed checkpoint under ``TPURX_FAULT_CKPT_DIR``:
+
+    TPURX_FAULT=bitflip:10     flip one byte mid-payload (crc must catch it)
+    TPURX_FAULT=truncate:10    cut the file short (length check must catch it)
+    TPURX_FAULT=torn_index:10  tear the commit record: a global checkpoint's
+                               metadata.json / process index cut mid-JSON, a
+                               local blob cut inside its footer (torn final
+                               write at commit time)
 """
 
 from __future__ import annotations
 
 import ctypes
 import enum
+import glob
 import os
+import random
 import signal
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 from .logging import get_logger
 
@@ -35,6 +47,7 @@ log = get_logger("inject_fault")
 ENV_FAULT = "TPURX_FAULT"
 ENV_FAULT_RANKS = "TPURX_FAULT_RANKS"
 ENV_FAULT_CYCLES = "TPURX_FAULT_CYCLES"
+ENV_FAULT_CKPT_DIR = "TPURX_FAULT_CKPT_DIR"
 
 
 class Fault(str, enum.Enum):
@@ -46,6 +59,11 @@ class Fault(str, enum.Enum):
     SIGSEGV = "sigsegv"
     EXIT = "exit"            # os._exit(1)
     DEVICE_HANG = "device_hang"  # submit a long-spinning XLA program
+    # checkpoint-corruption classes: mutate the newest committed checkpoint
+    # under TPURX_FAULT_CKPT_DIR (integrity detection must catch them)
+    CKPT_BITFLIP = "bitflip"
+    CKPT_TRUNCATE = "truncate"
+    CKPT_TORN_INDEX = "torn_index"
 
 
 class InjectedException(Exception):
@@ -91,6 +109,93 @@ def _device_hang() -> None:
     jax.block_until_ready(out)  # never returns
 
 
+def _newest_ckpt_targets(root: str) -> List[str]:
+    """Payload files of the NEWEST committed checkpoint under ``root``:
+    every ``rank_*.tpurx`` blob of the highest local ``iter_<N>`` (across
+    all node dirs), or every ``shard_*.bin`` of a global checkpoint dir.
+    Newest-first matters: the fallback ladder's contract is 'corrupt the
+    newest, restore the next-oldest'."""
+    iter_dirs = glob.glob(os.path.join(root, "**", "iter_*"), recursive=True)
+    if iter_dirs:
+        by_iter: dict = {}
+        for d in iter_dirs:
+            try:
+                by_iter.setdefault(int(os.path.basename(d)[len("iter_"):]), []).append(d)
+            except ValueError:
+                continue
+        newest = by_iter[max(by_iter)]
+        return sorted(
+            p
+            for d in newest
+            for p in glob.glob(os.path.join(d, "rank_*.tpurx"))
+            if os.path.exists(p + ".done")
+        )
+    return sorted(
+        glob.glob(os.path.join(root, "**", "shard_*.bin"), recursive=True)
+    )
+
+
+def corrupt_checkpoint(
+    root: str, mode: Fault, rng: Optional[random.Random] = None
+) -> List[str]:
+    """Corrupt the newest committed checkpoint under ``root`` in-place.
+    Returns the mutated paths (empty when nothing committed exists yet).
+
+    - ``CKPT_BITFLIP``: one byte XOR-flipped mid-payload in every target —
+      undetectable without digests, the exact failure crc32 exists for.
+    - ``CKPT_TRUNCATE``: every target cut to ~half — a torn write/partial
+      replica; the length field in the footer/index must catch it.
+    - ``CKPT_TORN_INDEX``: the commit record torn instead of the payload —
+      a global checkpoint's metadata.json (or a process index) cut
+      mid-JSON, a local blob cut 4 bytes into its 20-byte footer.
+    """
+    rng = rng or random.Random()
+    targets = _newest_ckpt_targets(root)
+    if mode == Fault.CKPT_TORN_INDEX:
+        # tear the commit record, not the payload
+        indices = sorted(
+            glob.glob(os.path.join(root, "**", "metadata.json"), recursive=True)
+        ) or sorted(
+            glob.glob(os.path.join(root, "**", "process_*.json"), recursive=True)
+        )
+        if indices:
+            targets = [indices[-1]]
+    mutated = []
+    for path in targets:
+        try:
+            size = os.path.getsize(path)
+            if mode == Fault.CKPT_BITFLIP:
+                if size == 0:
+                    continue
+                off = rng.randrange(size)
+                with open(path, "r+b") as f:
+                    f.seek(off)
+                    b = f.read(1)
+                    f.seek(off)
+                    f.write(bytes([b[0] ^ 0xFF]))
+            elif mode == Fault.CKPT_TRUNCATE:
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+            elif mode == Fault.CKPT_TORN_INDEX:
+                if path.endswith(".json"):
+                    cut = max(1, size // 2)  # mid-JSON: unparseable commit
+                else:
+                    cut = max(0, size - 16)  # 4 bytes into the 20B footer
+                with open(path, "r+b") as f:
+                    f.truncate(cut)
+            else:
+                raise ValueError(f"not a checkpoint-corruption fault: {mode}")
+        except OSError as exc:
+            log.warning("corrupt_checkpoint skipped %s: %s", path, exc)
+            continue
+        log.warning("injected %s into %s", mode.value, path)
+        mutated.append(path)
+    return mutated
+
+
+_CKPT_FAULTS = (Fault.CKPT_BITFLIP, Fault.CKPT_TRUNCATE, Fault.CKPT_TORN_INDEX)
+
+
 def _fire(fault: Fault) -> None:
     log.warning("Injecting fault: %s (pid=%s)", fault.value, os.getpid())
     if fault == Fault.EXC:
@@ -114,6 +219,13 @@ def _fire(fault: Fault) -> None:
         os._exit(1)
     elif fault == Fault.DEVICE_HANG:
         _device_hang()
+    elif fault in _CKPT_FAULTS:
+        root = os.environ.get(ENV_FAULT_CKPT_DIR)
+        if not root:
+            log.warning("%s fault without %s set; skipping",
+                        fault.value, ENV_FAULT_CKPT_DIR)
+            return
+        corrupt_checkpoint(root, fault)
 
 
 def inject_fault(fault: Fault, delay: float = 0.0) -> threading.Thread:
