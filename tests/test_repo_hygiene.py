@@ -118,9 +118,14 @@ def test_no_raw_binary_reads_in_checkpointing_modules():
     verifying readers (``checkpointing/integrity.py``): any
     ``open(..., "rb")`` elsewhere under ``tpu_resiliency/checkpointing/``
     is a trust-boundary bypass — the exact unguarded-read pattern this
-    repo's corrupt-shard quarantine exists to eliminate.  AST-based like
-    the bare-print ban (strings/comments can't false-positive)."""
+    repo's corrupt-shard quarantine exists to eliminate.  The ban also
+    covers the positioned-read primitives the streaming chunk reader is
+    built on (``os.read`` / ``os.pread`` / ``os.preadv`` / ``os.readv``):
+    the parallel restore engine must take its bytes from
+    ``integrity.ChunkReader``, never its own descriptor reads.  AST-based
+    like the bare-print ban (strings/comments can't false-positive)."""
     allowlist = {"tpu_resiliency/checkpointing/integrity.py"}
+    os_read_calls = {"read", "pread", "preadv", "readv"}
     offenders = []
     for rel, path in _library_sources():
         if not rel.startswith("tpu_resiliency/checkpointing/"):
@@ -130,11 +135,18 @@ def test_no_raw_binary_reads_in_checkpointing_modules():
         with open(path) as f:
             tree = ast.parse(f.read(), filename=rel)
         for node in ast.walk(tree):
-            if not (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "open"
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in os_read_calls
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os"
             ):
+                offenders.append(f"{rel}:{node.lineno} (os.{func.attr})")
+                continue
+            if not (isinstance(func, ast.Name) and func.id == "open"):
                 continue
             mode = None
             if len(node.args) >= 2:
@@ -151,8 +163,8 @@ def test_no_raw_binary_reads_in_checkpointing_modules():
                 offenders.append(f"{rel}:{node.lineno}")
     assert not offenders, (
         f"raw binary reads of checkpoint data outside the verifying reader "
-        f"(use integrity.read_verified_blob / read_verified_shard): "
-        f"{offenders}"
+        f"(use integrity.read_verified_blob / read_verified_shard / "
+        f"ChunkReader): {offenders}"
     )
 
 

@@ -19,9 +19,11 @@ from .async_ckpt.core import AsyncCallsQueue, AsyncRequest
 from .async_ckpt.checkpointer import AsyncCheckpointer, load_checkpoint
 from .integrity import (
     CheckpointCorruptError,
+    ChunkReader,
     read_verified_blob,
     read_verified_shard,
     verify_blob,
+    verify_blob_file,
 )
 from .local.state_dict import TensorAwareTree
 from .local.manager import LocalCheckpointManager
@@ -33,9 +35,11 @@ __all__ = [
     "AsyncCheckpointer",
     "load_checkpoint",
     "CheckpointCorruptError",
+    "ChunkReader",
     "read_verified_blob",
     "read_verified_shard",
     "verify_blob",
+    "verify_blob_file",
     "TensorAwareTree",
     "LocalCheckpointManager",
     "CliqueReplication",

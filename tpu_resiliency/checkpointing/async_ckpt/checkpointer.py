@@ -55,11 +55,14 @@ from .core import (  # noqa: F401 - CheckpointSaveError re-exported for callers
     CheckpointSaveError,
     store_sync_fn,
 )
+from ...utils.dtypes import coerce_dtype
 from .staging import StagedTree, plan_signature, shard_payload, stage_pytree
 from .writer import (
+    _RestoreEngine,
     is_committed,
     read_leaf,
     read_metadata,
+    resolve_restore_threads,
     resolve_write_threads,
     write_metadata,
     write_process_shards_streamed,
@@ -516,21 +519,57 @@ class CachedMetadataReader:
 _default_reader = CachedMetadataReader()
 
 
+def _place_leaf(tmpl: Any, arr: np.ndarray, leaf_path: str) -> Any:
+    """Hand one restored leaf to its template slot.  jax templates get the
+    array device_put with the template's sharding — an async dispatch, so
+    placing leaf *i* overlaps whatever leaves are still reading.  The dtype
+    cast is skipped entirely when the checkpoint dtype already matches
+    (``astype`` copies unconditionally; ``coerce_dtype`` does not)."""
+    import jax
+
+    if isinstance(tmpl, jax.Array):
+        if tuple(arr.shape) != tuple(tmpl.shape):
+            raise ValueError(
+                f"leaf {leaf_path}: shape {arr.shape} != "
+                f"template {tmpl.shape}"
+            )
+        return jax.device_put(coerce_dtype(arr, tmpl.dtype), tmpl.sharding)
+    return np.asarray(arr, dtype=getattr(tmpl, "dtype", None))
+
+
 def load_checkpoint(
     ckpt_dir: str,
     template: Any,
     reader: Optional[CachedMetadataReader] = None,
+    threads: Optional[int] = None,
+    serial: bool = False,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> Any:
     """Load into the structure (and shardings) of ``template``.
 
     Template leaves that are jax.Arrays get the restored values placed with
     the template's sharding; numpy/scalar leaves come back as numpy.
+
+    Default is the **parallel verified restore pipeline**: a restore plan
+    computed from ``metadata.json`` (size-bucketed shard read spans with
+    their recorded ``(off, len, crc)`` digests) executed by a reader pool
+    (``threads``, else ``TPURX_CKPT_RESTORE_THREADS``, else write-engine
+    sizing) that preads chunks straight into preallocated aligned leaf
+    buffers — no intermediate whole-shard bytes objects, no ``from_bytes``
+    copy — verifying every chunk's crc32 in-flight and the composed digest
+    per shard.  As each leaf's shards complete, its ``device_put`` is
+    enqueued while the remaining leaves are still reading, so disk read,
+    verify, and H2D transfer pipeline instead of serializing.
+
+    ``serial=True`` keeps the one-leaf-at-a-time reference path (the
+    restore bench's A/B baseline).  ``stats``, if given, is filled with the
+    engine's accounting (``bytes_read`` / ``chunks`` / ``shards`` /
+    ``leaves`` / ``verify_ns`` / ``restore_ns`` / ``threads``).
     """
     if not is_committed(ckpt_dir):
         raise FileNotFoundError(f"no committed checkpoint at {ckpt_dir}")
     meta = (reader or _default_reader).read(ckpt_dir)
 
-    import jax
     import jax.tree_util as jtu
 
     leaves, treedef = jtu.tree_flatten(template)
@@ -539,16 +578,33 @@ def load_checkpoint(
             f"template has {len(leaves)} leaves, checkpoint has "
             f"{len(meta['leaf_paths'])}"
         )
-    out_leaves = []
-    for i, tmpl in enumerate(leaves):
-        arr = read_leaf(ckpt_dir, meta, i)
-        if isinstance(tmpl, jax.Array):
-            if tuple(arr.shape) != tuple(tmpl.shape):
-                raise ValueError(
-                    f"leaf {meta['leaf_paths'][i]}: shape {arr.shape} != "
-                    f"template {tmpl.shape}"
-                )
-            out_leaves.append(jax.device_put(arr.astype(tmpl.dtype), tmpl.sharding))
-        else:
-            out_leaves.append(np.asarray(arr, dtype=getattr(tmpl, "dtype", None)))
+    t0 = time.monotonic_ns()
+    out_leaves: List[Any] = [None] * len(leaves)
+    if serial:
+        for i, tmpl in enumerate(leaves):
+            arr = read_leaf(ckpt_dir, meta, i)
+            out_leaves[i] = _place_leaf(tmpl, arr, meta["leaf_paths"][i])
+        if stats is not None:
+            stats.update(
+                {"threads": 1, "restore_ns": time.monotonic_ns() - t0}
+            )
+        return jtu.tree_unflatten(treedef, out_leaves)
+    engine = _RestoreEngine(
+        ckpt_dir, meta, num_threads=resolve_restore_threads(threads),
+        leaf_indices=range(len(leaves)),
+    )
+    try:
+        while True:
+            idx, payload = engine.ready.get()
+            if idx is None:
+                if payload is not None:
+                    raise payload
+                break
+            out_leaves[idx] = _place_leaf(
+                leaves[idx], payload, meta["leaf_paths"][idx]
+            )
+    finally:
+        engine.close()
+    if stats is not None:
+        stats.update(engine.stats())
     return jtu.tree_unflatten(treedef, out_leaves)

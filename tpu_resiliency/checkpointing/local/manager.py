@@ -29,13 +29,16 @@ boundary verifies it —
   holder** (re-election serves a valid replica instead);
 - ``load(fallback=True)`` walks the retained history newest-first: each
   candidate is gated by a cross-rank **validity round** (every rank verifies
-  the blobs it holds for the candidate, quarantines failures, republishes,
-  and the round passes only if the surviving union still covers every rank)
-  — the restored iteration is the newest one valid everywhere, and the
-  fallback depth is exported (``tpurx_ckpt_fallback_depth``);
+  the blobs it holds for the candidate — on the **threaded verifier**, one
+  streaming pass per held blob run concurrently — quarantines failures,
+  republishes, and the round passes only if the surviving union still covers
+  every rank) — the restored iteration is the newest one valid everywhere,
+  and the fallback depth is exported (``tpurx_ckpt_fallback_depth``);
 - an opt-in background **scrubber** re-verifies retained iterations during
   idle time so bit rot is caught while peers still hold replacements, not at
-  restore time.
+  restore time — through the chunked streaming reader
+  (``integrity.verify_blob_file``), so a sweep's peak memory is one scratch
+  chunk, never a resident copy of the biggest retained blob.
 
 File layout: <root>/iter_<I>/rank_<R>.tpurx (+ .done marker per blob;
 quarantined blobs keep their bytes as ``rank_<R>.tpurx.corrupt`` for
@@ -49,18 +52,21 @@ import os
 import re
 import shutil
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
 from ...store.barrier import barrier
 from ...telemetry import counter, gauge
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
+from ..async_ckpt.writer import resolve_restore_threads
 from ..integrity import (
     CORRUPT_SENTINEL,
     CheckpointCorruptError,
     quarantine_blob,
     read_verified_blob,
     verify_blob,
+    verify_blob_file,
 )
 from .replication import CliqueReplication
 from .state_dict import TensorAwareTree
@@ -287,20 +293,45 @@ class LocalCheckpointManager:
 
     def verify_iteration(self, iteration: int, site: str = "local_blob") -> bool:
         """Verify every blob this rank holds for ``iteration``; quarantine
-        failures (and republish holdings).  True iff nothing was corrupt."""
+        failures (and republish holdings).  True iff nothing was corrupt.
+
+        The checks run on the threaded verifier: streaming crc over each
+        blob (``verify_blob_file`` — one bounded scratch buffer, never a
+        whole-blob read) with one thread per held blob up to the restore
+        pool sizing, so a fallback rung over N held replicas costs one
+        blob's scan time, not N.  Quarantine/republish (store writes) stay
+        on the calling thread."""
         local = self._holdings().get(iteration, [])
-        clean = True
-        for data_rank in local:
-            path = self._blob_path(iteration, data_rank)
+        if not local:
+            return True
+
+        def _check(data_rank: int) -> Optional[BaseException]:
             try:
-                read_verified_blob(path, site=site)
-            except (CheckpointCorruptError, OSError) as exc:
-                log.warning(
-                    "iteration %s rank-%s blob failed verification (%s); "
-                    "quarantining", iteration, data_rank, exc,
+                verify_blob_file(
+                    self._blob_path(iteration, data_rank), site=site
                 )
-                self._quarantine(iteration, data_rank, site=site)
-                clean = False
+                return None
+            except (CheckpointCorruptError, OSError) as exc:
+                return exc
+
+        if len(local) == 1:
+            failures = list(zip(local, [_check(local[0])]))
+        else:
+            workers = min(len(local), resolve_restore_threads(None))
+            with ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="tpurx-ckpt-verify"
+            ) as pool:
+                failures = list(zip(local, pool.map(_check, local)))
+        clean = True
+        for data_rank, exc in failures:
+            if exc is None:
+                continue
+            log.warning(
+                "iteration %s rank-%s blob failed verification (%s); "
+                "quarantining", iteration, data_rank, exc,
+            )
+            self._quarantine(iteration, data_rank, site=site)
+            clean = False
         return clean
 
     def scrub_once(self) -> int:
@@ -310,6 +341,9 @@ class LocalCheckpointManager:
         re-replicate."""
         quarantined = 0
         for iteration in sorted(self._holdings()):
+            # streaming verifier: bounded memory per blob, threaded per
+            # iteration — and rename-race-safe against a concurrent load()
+            # quarantining the same rot (only the rename winner counts)
             if not self.verify_iteration(iteration, site="scrub"):
                 quarantined += 1
             if self._scrub_stop.is_set():
@@ -588,21 +622,39 @@ class LocalCheckpointManager:
             # exchange-round tag: iteration + attempt, so a late blob from a
             # previous round can never satisfy this round's receive
             tag = 0x40000000 | ((attempt & 0x3F) << 24) | (iteration & 0xFFFFFF)
-            sends = []
-            for to_rank, data_rank in my_sends:
-                path = self._blob_path(iteration, data_rank)
+            # the SENDER checks before serving: never replicate bytes this
+            # host cannot vouch for.  Elected to serve several ranks, the
+            # read+verify passes run concurrently (disk + crc parallelize;
+            # quarantine/republish stays on this thread) so a multi-send
+            # round costs one blob's scan, not a sequential sum.
+            def _read_payload(data_rank: int):
                 try:
-                    # the SENDER checks before serving: never replicate bytes
-                    # this host cannot vouch for
-                    payload = read_verified_blob(path, site="peer_send")
+                    return read_verified_blob(
+                        self._blob_path(iteration, data_rank),
+                        site="peer_send",
+                    ), None
                 except (CheckpointCorruptError, OSError) as exc:
+                    return CORRUPT_SENTINEL, exc
+
+            if len(my_sends) > 1:
+                workers = min(len(my_sends), resolve_restore_threads(None))
+                with ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="tpurx-ckpt-send"
+                ) as pool:
+                    payloads = list(
+                        pool.map(_read_payload, [dr for _to, dr in my_sends])
+                    )
+            else:
+                payloads = [_read_payload(dr) for _to, dr in my_sends]
+            sends = []
+            for (to_rank, data_rank), (payload, exc) in zip(my_sends, payloads):
+                if exc is not None:
                     log.warning(
                         "elected to serve rank %s's iteration-%s blob but it "
                         "failed verification (%s); quarantining and sending "
                         "the corrupt sentinel", to_rank, iteration, exc,
                     )
                     self._quarantine(iteration, data_rank, site="peer_send")
-                    payload = CORRUPT_SENTINEL
                 sends.append((to_rank, tag, payload))
             recvs = []
             if not have_own and my_source is not None:

@@ -25,7 +25,8 @@ from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
-from ...utils.dtypes import resolve_dtype
+from ...utils.dtypes import coerce_dtype, resolve_dtype
+from ..coverage import covers
 
 _MAGIC = b"TPURXLC2"
 _U64 = struct.Struct("<Q")
@@ -161,7 +162,11 @@ class TensorAwareTree:
             if isinstance(tmpl, jax.Array):
                 whole = _maybe_whole(meta, shards)
                 if whole is not None:
-                    out.append(jax.device_put(whole.astype(tmpl.dtype), tmpl.sharding))
+                    out.append(
+                        jax.device_put(
+                            coerce_dtype(whole, tmpl.dtype), tmpl.sharding
+                        )
+                    )
                 else:
                     out.append(_assemble_sharded(tmpl, meta, shards))
             else:
@@ -262,16 +267,17 @@ def _maybe_whole(meta: LeafMeta, shards) -> Optional[np.ndarray]:
         index, arr = shards[0]
         if all(a == 0 and b == s for (a, b), s in zip(index, meta.global_shape)):
             return arr
-    # multiple shards that jointly cover everything (single-host resharded)
-    covered = np.zeros(meta.global_shape, dtype=bool)
+    # multiple shards that jointly cover everything (single-host resharded):
+    # coverage is decided from the index boxes alone (interval accounting)
+    # BEFORE allocating — the old boolean mask cost +1 byte per element of
+    # the leaf just to answer yes/no
+    if not covers(meta.global_shape, [index for index, _arr in shards]):
+        return None
     out = np.empty(meta.global_shape, dtype=resolve_dtype(meta.dtype))
     for index, arr in shards:
         slices = tuple(slice(a, b) for a, b in index)
         out[slices] = arr
-        covered[slices] = True
-    if covered.all():
-        return out
-    return None
+    return out
 
 
 def _assemble_sharded(tmpl, meta: LeafMeta, shards):
@@ -288,7 +294,7 @@ def _assemble_sharded(tmpl, meta: LeafMeta, shards):
                 f"stored shards lack index {idx} required by template sharding"
             )
         single_arrays.append(
-            jax.device_put(by_index[idx].astype(tmpl.dtype), shard.device)
+            jax.device_put(coerce_dtype(by_index[idx], tmpl.dtype), shard.device)
         )
         devices.append(shard.device)
     return jax.make_array_from_single_device_arrays(

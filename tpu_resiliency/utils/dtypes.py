@@ -28,3 +28,14 @@ def from_bytes(raw, dtype_name: str, shape) -> np.ndarray:
         .reshape(shape)
         .copy()
     )
+
+
+def coerce_dtype(arr: np.ndarray, dtype) -> np.ndarray:
+    """``astype`` only when it changes anything: ``ndarray.astype`` copies
+    unconditionally, which on the restore path doubled host memory and added
+    a full memcpy per leaf even when the checkpoint dtype already matched
+    the template.  Returns ``arr`` itself on a dtype match."""
+    dt = np.dtype(dtype)
+    if arr.dtype == dt:
+        return arr
+    return arr.astype(dt)
