@@ -347,7 +347,13 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "ckpt1g_restore_verify_ns", "ckpt1g_restore_threads",
         "ckpt1g_restore_ok", "ckpt1g_restore_gate_waived",
         "straggler_collector_overhead_pct",
+        "store_fanin_clients", "store_fanin_shards",
+        "store_fanin_p99_us", "store_fanin_p99_sharded_us",
+        "store_fanin_p50_us", "store_fanin_p50_sharded_us",
+        "store_shard_speedup", "store_fanin_ok", "store_fanin_gate_waived",
+        "store_rdzv_close_ms", "store_rdzv_close_sharded_ms",
         "tm_store_ops", "tm_store_op_p50_us", "tm_store_op_p99_us",
+        "tm_store_shard_ops", "tm_store_shard_failovers", "tm_tree_rounds",
         "tm_ckpt_saves", "tm_ckpt_stage_mb", "tm_restarts",
         "tm_restart_p50_ms", "tm_monitor_trips", "tm_metric_inc_ns",
     ):
@@ -993,6 +999,154 @@ def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
     return out
 
 
+def bench_store_fanin(time_left_fn) -> dict:
+    """Sharded control-plane A/B at simulated 1k-client fan-in.
+
+    K=4 shard servers run as SUBPROCESSES (in-thread asyncio shards would
+    share this interpreter's GIL and measure nothing); the same op stream —
+    each simulated client SETs then TRY_GETs its own key — is driven by a
+    thread pool against (a) one shard and (b) all four via the
+    consistent-hash client.  Reported: client-observed op p50/p99 per arm,
+    the p99 speedup (gate: >=2x with K=4, waived on a 1-core host like the
+    ckpt lanes — one core cannot run four shard event loops in parallel),
+    and the rendezvous round-close latency over each arm (the protocol this
+    control plane exists to serve)."""
+    import threading
+
+    from tpu_resiliency.fault_tolerance.rendezvous import (
+        NodeDesc, RendezvousHost, RendezvousJoiner,
+    )
+    from tpu_resiliency.store.sharding import (
+        ShardedStoreClient, free_port, spawn_shard_subprocess,
+    )
+    from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+    n_shards = 4
+    sim_clients = 1024
+    ops_per_client = 4
+    n_threads = 32
+    shard_env = {"JAX_PLATFORMS": "cpu"}
+    disarm_platform_sitecustomize(shard_env)  # shard procs must not touch TPU
+
+    procs, endpoints = [], []
+    try:
+        for _ in range(n_shards):
+            port = free_port()
+            procs.append(spawn_shard_subprocess(port, env=shard_env))
+            endpoints.append(f"127.0.0.1:{port}")
+
+        def fanin_arm(arm_endpoints, tag) -> list:
+            latencies: list = []
+            lock = threading.Lock()
+            per_thread = sim_clients // n_threads
+
+            def worker(tid):
+                c = ShardedStoreClient(arm_endpoints, timeout=60.0)
+                local = []
+                try:
+                    for cid in range(per_thread):
+                        key = f"fanin/{tag}/{tid}/{cid}"
+                        for op in range(ops_per_client):
+                            t0 = time.perf_counter_ns()
+                            if op % 2 == 0:
+                                c.set(key, b"x" * 64)
+                            else:
+                                c.try_get(key)
+                            local.append(time.perf_counter_ns() - t0)
+                finally:
+                    c.close()
+                with lock:
+                    latencies.extend(local)
+
+            threads = [
+                threading.Thread(target=worker, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return sorted(latencies)
+
+        def quantile(sorted_ns, q):
+            return sorted_ns[min(len(sorted_ns) - 1, int(q * len(sorted_ns)))]
+
+        def rdzv_close_ms(arm_endpoints, n_nodes=32) -> float:
+            # both arms share the live shard fleet: clear the previous
+            # arm's round state so each measures a fresh round 0
+            sweeper = ShardedStoreClient(endpoints, timeout=30.0)
+            for k in sweeper.list_keys("rdzv/"):
+                sweeper.delete(k)
+            sweeper.close()
+            host_client = ShardedStoreClient(arm_endpoints, timeout=120.0)
+            host = RendezvousHost(
+                host_client, min_nodes=n_nodes, max_nodes=n_nodes,
+                settle_time=0.3,
+            )
+            host.bootstrap()
+            host.open_round()
+            clients = [
+                ShardedStoreClient(arm_endpoints, timeout=120.0)
+                for _ in range(n_nodes)
+            ]
+
+            def agent(i):
+                joiner = RendezvousJoiner(
+                    clients[i],
+                    NodeDesc.create(node_id=f"fanin-node-{i}", slots=1),
+                    open_poll_interval=0.02,
+                )
+                try:
+                    joiner.join(timeout=20.0)
+                except Exception:  # noqa: BLE001 - a joiner losing the
+                    pass  # close race only affects itself, not the metric
+
+            threads = [
+                threading.Thread(target=agent, args=(i,), daemon=True)
+                for i in range(n_nodes)
+            ]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            host.close_round_when_ready(timeout=90.0)
+            close_ms = (time.monotonic() - t0) * 1e3
+            for t in threads:
+                t.join(timeout=30)
+            for c in clients:
+                c.close()
+            host_client.close()
+            return close_ms
+
+        single = fanin_arm(endpoints[:1], "single")
+        sharded = fanin_arm(endpoints, "sharded")
+        p99_single = quantile(single, 0.99) / 1e3
+        p99_sharded = quantile(sharded, 0.99) / 1e3
+        speedup = p99_single / max(1e-9, p99_sharded)
+        waived = (os.cpu_count() or 1) < 2 and speedup < 2.0
+        out = {
+            "store_fanin_clients": sim_clients,
+            "store_fanin_shards": n_shards,
+            "store_fanin_p50_us": round(quantile(single, 0.5) / 1e3, 1),
+            "store_fanin_p50_sharded_us": round(quantile(sharded, 0.5) / 1e3, 1),
+            "store_fanin_p99_us": round(p99_single, 1),
+            "store_fanin_p99_sharded_us": round(p99_sharded, 1),
+            "store_shard_speedup": round(speedup, 2),
+            "store_fanin_ok": bool(speedup >= 2.0 or waived),
+        }
+        if waived:
+            out["store_fanin_gate_waived"] = "1-core host"
+        if time_left_fn() > 30:
+            out["store_rdzv_close_ms"] = round(rdzv_close_ms(endpoints[:1]), 1)
+        if time_left_fn() > 30:
+            out["store_rdzv_close_sharded_ms"] = round(
+                rdzv_close_ms(endpoints), 1
+            )
+        return out
+    finally:
+        for p in procs:
+            p.kill()
+
+
 def _telemetry_keys() -> dict:
     """Derive bench keys from the in-process telemetry registry — the same
     series production scrapes from the per-rank exporter, so bench numbers
@@ -1039,6 +1193,15 @@ def _telemetry_keys() -> dict:
             out["tm_store_op_p50_us"] = round(p50 / 1e3, 1)
         if p99 is not None:
             out["tm_store_op_p99_us"] = round(p99 / 1e3, 1)
+    shard_ops = fam_sum("tpurx_store_shard_ops_total")
+    if shard_ops:
+        out["tm_store_shard_ops"] = int(shard_ops)
+        out["tm_store_shard_failovers"] = int(
+            fam_sum("tpurx_store_shard_failovers_total") or 0
+        )
+    tree_rounds = fam_sum("tpurx_tree_rounds_total")
+    if tree_rounds:
+        out["tm_tree_rounds"] = int(tree_rounds)
     saves = fam_sum("tpurx_ckpt_saves_total")
     if saves:
         out["tm_ckpt_saves"] = int(saves)
@@ -1197,6 +1360,14 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional metric, never fatal
                 print(f"bench: straggler collector arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 45:
+            try:
+                _PARTIAL.update(bench_store_fanin(time_left))
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: store fan-in arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
     except _ChildDeadline:
         print("bench: child hit its internal deadline — finalizing from "

@@ -49,7 +49,7 @@ enum Op : uint8_t {
   OP_SET = 1, OP_GET = 2, OP_TRY_GET = 3, OP_ADD = 4, OP_APPEND = 5,
   OP_COMPARE_SET = 6, OP_WAIT = 7, OP_CHECK = 8, OP_DELETE = 9,
   OP_NUM_KEYS = 10, OP_PING = 11, OP_LIST_KEYS = 12, OP_MULTI_SET = 13,
-  OP_MULTI_GET = 14,
+  OP_MULTI_GET = 14, OP_MULTI_TRY_GET = 15,
 };
 
 enum Status : uint8_t {
@@ -537,6 +537,22 @@ void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
       }
       return reply(c, ST_OK, vals);
     }
+    case OP_MULTI_TRY_GET: {
+      // per-key misses: (flag, value) pairs, flag "0" + empty when absent
+      std::vector<std::string> pairs;
+      pairs.reserve(args.size() * 2);
+      for (const auto& k : args) {
+        auto it = data.find(k);
+        if (it == data.end()) {
+          pairs.push_back("0");
+          pairs.push_back("");
+        } else {
+          pairs.push_back("1");
+          pairs.push_back(it->second);
+        }
+      }
+      return reply(c, ST_OK, pairs);
+    }
     default:
       return reply(c, ST_ERROR, {"unknown op"});
   }
@@ -570,7 +586,7 @@ bool try_parse_frame(Conn* c) {
     off += len;
   }
   c->in.erase(0, off);
-  if (op < OP_SET || op > OP_MULTI_GET) {
+  if (op < OP_SET || op > OP_MULTI_TRY_GET) {
     // unparseable stream from here on: drop the connection (matches the
     // Python server's behavior)
     c->closed = true;

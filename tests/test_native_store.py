@@ -69,7 +69,9 @@ def test_multi_and_list(nstore):
     nstore.multi_set({"p/a": b"1", "p/b": b"2", "q/c": b"3"})
     assert sorted(nstore.list_keys("p/")) == [b"p/a", b"p/b"]
     assert nstore.multi_get(["p/a", "q/c"]) == [b"1", b"3"]
-    assert nstore.multi_get(["p/a", "nope"]) is None
+    # per-key miss semantics (matches the asyncio server): absent keys are
+    # None entries, present ones keep their values
+    assert nstore.multi_get(["p/a", "nope"]) == [b"1", None]
     assert nstore.check(["p/a", "p/b"]) is True
     assert nstore.check(["p/a", "zz"]) is False
 

@@ -168,6 +168,97 @@ def test_no_raw_binary_reads_in_checkpointing_modules():
     )
 
 
+def _range_references_world_size(call: ast.Call) -> bool:
+    """True when ``call`` is ``range(...)`` with an argument mentioning
+    ``world_size`` (a Name, an Attribute like ``self.world_size``, or any
+    expression containing one)."""
+    if not (isinstance(call.func, ast.Name) and call.func.id == "range"):
+        return False
+    for arg in call.args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and node.id == "world_size":
+                return True
+            if isinstance(node, ast.Attribute) and node.attr == "world_size":
+                return True
+    return False
+
+
+def test_no_flat_all_ranks_gathers_outside_tree_helper():
+    """Cross-rank gather rounds must route through the reduction tree
+    (``store/tree.py``): a direct all-ranks-to-one gather — reading one
+    store key per rank of the world — makes rank 0 (and the shard owning
+    the round's keys) an O(N) hotspot, the exact pattern the sharded
+    control plane + hierarchical aggregation refactor removed.  AST-based
+    like the rb-read ban; two shapes are banned outside the allowlist:
+
+    - ``store.multi_get([...for r in range(world_size)])`` (and any
+      comprehension over ``range(*world_size*)`` passed to ``multi_get``);
+    - ``store.get/try_get`` calls inside a ``for ... in range(*world_size*)``
+      loop.
+    """
+    allowlist = {
+        # the sanctioned reduction-tree helper itself
+        "tpu_resiliency/store/tree.py",
+        # post-mortem reads of possibly-dead ranks: no collective is
+        # possible, the observer must poll whatever keys exist
+        "tpu_resiliency/attribution/trace_analyzer.py",
+        # single-process emulation moving BULK blob bytes (not control
+        # metadata): funneling payloads through a tree root would
+        # centralize the very bytes replication spreads out
+        "tpu_resiliency/checkpointing/local/ici_replication.py",
+    }
+    store_read_attrs = {"multi_get", "get", "try_get"}
+    offenders = []
+    for rel, path in _library_sources():
+        if rel in allowlist:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            # shape 1: multi_get(<comprehension over range(world_size)>)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "multi_get"
+            ):
+                for arg in node.args:
+                    comps = [
+                        c
+                        for sub in ast.walk(arg)
+                        if isinstance(sub, (ast.ListComp, ast.GeneratorExp, ast.SetComp))
+                        for c in sub.generators
+                    ]
+                    if any(
+                        isinstance(c.iter, ast.Call)
+                        and _range_references_world_size(c.iter)
+                        for c in comps
+                    ):
+                        offenders.append(f"{rel}:{node.lineno} (multi_get)")
+            # shape 2: store reads inside `for r in range(world_size):`
+            if (
+                isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Call)
+                and _range_references_world_size(node.iter)
+            ):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in store_read_attrs
+                        and isinstance(sub.func.value, (ast.Name, ast.Attribute))
+                        and "store" in ast.dump(sub.func.value).lower()
+                    ):
+                        offenders.append(
+                            f"{rel}:{sub.lineno} ({sub.func.attr} in "
+                            f"range(world_size) loop)"
+                        )
+    assert not offenders, (
+        f"flat all-ranks-to-one gather outside store/tree.py (route the "
+        f"round through tree_gather — rank-0 inbound must stay O(fanout)): "
+        f"{offenders}"
+    )
+
+
 def _declared_metric_names():
     """(name, rel, lineno) for every registry-constructor call with a
     literal first argument anywhere in the package."""

@@ -144,7 +144,10 @@ def test_delete_num_keys_list(store):
     assert store.delete("p/x") is False
     assert store.num_keys() == 2
     assert store.multi_get(["p/y", "q/z"]) == [b"2", b"3"]
-    assert store.multi_get(["p/y", "gone"]) is None
+    # per-key miss semantics: absent keys come back as None ENTRIES (the
+    # old all-or-nothing None return could not name the missing key)
+    assert store.multi_get(["p/y", "gone"]) == [b"2", None]
+    assert store.multi_get(["gone", "also-gone"]) == [None, None]
 
 
 def test_prefix_store(store):

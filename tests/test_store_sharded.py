@@ -1,0 +1,552 @@
+"""Sharded control-plane store: routing, failover, journal crash
+consistency, and the shard-kill-mid-round soak smoke."""
+
+import json
+import signal
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.store import (
+    PrefixStore,
+    ShardMap,
+    ShardServerGroup,
+    ShardedStoreClient,
+    StoreClient,
+    StoreServer,
+    barrier,
+    reentrant_barrier,
+    spawn_shard_subprocess,
+    tree_gather,
+)
+from tpu_resiliency.store.barrier import BarrierTimeout
+from tpu_resiliency.store.client import StoreError, StoreTimeout
+from tpu_resiliency.store.sharding import free_port
+from tpu_resiliency.store.tree import combine_json_merge
+from tpu_resiliency.telemetry import get_registry
+
+
+def _counter(name, site):
+    return get_registry().value_of(name, {"site": site}) or 0.0
+
+
+@pytest.fixture
+def shard_group(tmp_path):
+    group = ShardServerGroup(
+        4, journal_base=str(tmp_path / "journal")
+    ).start()
+    yield group
+    group.stop()
+
+
+# -- shard map ----------------------------------------------------------------
+
+
+class TestShardMap:
+    def test_stable_and_total(self):
+        m = ShardMap([("h1", 1), ("h2", 2), ("h3", 3)])
+        for key in (b"a", b"rdzv/active_round", b"barrier/x/count", b"z" * 100):
+            idx = m.shard_for(key)
+            assert 0 <= idx < 3
+            assert m.shard_for(key) == idx  # deterministic
+
+    def test_distribution_reasonably_balanced(self):
+        m = ShardMap([("h", p) for p in range(1, 5)])
+        counts = [0] * 4
+        for i in range(4000):
+            counts[m.shard_for(f"key/{i}".encode())] += 1
+        assert min(counts) > 4000 / 4 * 0.5, counts  # no starved shard
+
+    def test_single_shard_short_circuits(self):
+        m = ShardMap([("h", 1)])
+        assert all(m.shard_for(f"k{i}".encode()) == 0 for i in range(50))
+
+    def test_json_roundtrip(self):
+        m = ShardMap([("127.0.0.1", 1234), ("127.0.0.1", 1235)], vnodes=32)
+        m2 = ShardMap.from_json(m.to_json())
+        assert m2.endpoints == m.endpoints
+        for i in range(100):
+            k = f"key/{i}".encode()
+            assert m.shard_for(k) == m2.shard_for(k)
+
+    def test_remap_moves_fraction_not_all(self):
+        eps = [("h", p) for p in range(1, 5)]
+        m4 = ShardMap(eps)
+        m5 = ShardMap(eps + [("h", 5)])
+        keys = [f"key/{i}".encode() for i in range(2000)]
+        moved = sum(
+            1
+            for k in keys
+            if m4.endpoints[m4.shard_for(k)] != m5.endpoints[m5.shard_for(k)]
+        )
+        # consistent hashing: ~1/5 of keys move, never the bulk
+        assert moved < len(keys) * 0.45, moved
+
+
+# -- sharded client over a live shard fleet ----------------------------------
+
+
+class TestShardedClient:
+    def test_primitive_surface(self, shard_group):
+        c = shard_group.client(timeout=10.0)
+        c.set("a", b"1")
+        assert c.get("a") == b"1"
+        assert c.try_get("missing") is None
+        assert c.add("ctr", 5) == 5
+        assert c.add("ctr", 2) == 7
+        assert c.append("log", b"xy") == 2
+        ok, v = c.compare_set_ex("cas", b"", b"first")
+        assert ok and v == b"first"
+        ok, v = c.compare_set_ex("cas", b"nope", b"second")
+        assert not ok and v == b"first"
+        assert c.delete("a") is True
+        assert c.delete("a") is False
+        assert c.ping() is True
+        c.close()
+
+    def test_keys_actually_spread_over_shards(self, shard_group):
+        c = shard_group.client()
+        c.multi_set({f"spread/{i}": b"v" for i in range(256)})
+        per_shard = []
+        for srv in shard_group.servers:
+            direct = StoreClient("127.0.0.1", srv.port)
+            per_shard.append(len(direct.list_keys("spread/")))
+            direct.close()
+        assert sum(per_shard) == 256
+        assert all(n > 0 for n in per_shard), per_shard
+        # num_keys / list_keys recombine the fleet view
+        assert len(c.list_keys("spread/")) == 256
+        c.close()
+
+    def test_multi_get_per_key_none_across_shards(self, shard_group):
+        c = shard_group.client()
+        c.multi_set({f"m/{i}": str(i).encode() for i in range(16)})
+        keys = [f"m/{i}" for i in range(16)] + ["m/nope", "m/gone"]
+        out = c.multi_get(keys)
+        assert out[:16] == [str(i).encode() for i in range(16)]
+        assert out[16:] == [None, None]
+        c.close()
+
+    def test_wait_and_check_across_shards(self, shard_group):
+        c = shard_group.client(timeout=10.0)
+        keys = [f"w/{i}" for i in range(8)]  # hash over several shards
+        c.multi_set({k: b"1" for k in keys[:-1]})
+        assert c.check(keys[:-1]) is True
+        assert c.check(keys) is False
+
+        def setter():
+            time.sleep(0.2)
+            c2 = shard_group.client()
+            c2.set(keys[-1], b"1")
+            c2.close()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        c.wait(keys, timeout=10.0)
+        t.join()
+        with pytest.raises(StoreTimeout):
+            c.wait(["never/there"], timeout=0.3)
+        c.close()
+
+    def test_prefix_store_and_barriers_over_shards(self, shard_group):
+        ps = PrefixStore("iter/7", shard_group.client(timeout=10.0))
+        ps.set("k", b"v")
+        assert ps.get("k") == b"v"
+        world = 4
+        errors = []
+
+        def member(i):
+            c = shard_group.client(timeout=10.0)
+            try:
+                barrier(c, "sb", world, timeout=10.0)
+                reentrant_barrier(c, "srb", i, world, timeout=10.0)
+                if i == 0:  # re-entry must not deadlock or overflow
+                    reentrant_barrier(c, "srb", i, world, timeout=10.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=member, args=(i,)) for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        ps.close()
+
+    def test_bootstrap_from_seed(self, shard_group):
+        host, port = shard_group.servers[0].host, shard_group.servers[0].port
+        c = ShardedStoreClient.from_bootstrap(host, port, timeout=10.0)
+        assert len(c.endpoints) == 4
+        c.set("boot", b"strapped")
+        assert c.get("boot") == b"strapped"
+        c.close()
+
+    def test_tree_gather_over_sharded_store(self, shard_group):
+        world, results, errors = 12, {}, []
+
+        def run(rank):
+            c = shard_group.client(timeout=15.0)
+            try:
+                results[rank] = tree_gather(
+                    c, rank, world, prefix="sh/t0",
+                    payload=json.dumps({rank: rank * 2}).encode(),
+                    combine=combine_json_merge, timeout=15.0, fanout=3,
+                    broadcast=True, site="test",
+                )
+            except Exception as exc:  # noqa: BLE001
+                errors.append((rank, exc))
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:3]
+        expected = {str(r): r * 2 for r in range(world)}
+        assert all(json.loads(results[r]) == expected for r in range(world))
+
+
+# -- reentrant barrier: O(1) arrival log ------------------------------------
+
+
+class TestReentrantBarrierLog:
+    def test_timeout_names_missing_ranks(self, store):
+        with pytest.raises(BarrierTimeout) as ei:
+            reentrant_barrier(store, "naming", 2, 5, timeout=0.5)
+        assert ei.value.arrived == 1
+        assert ei.value.world_size == 5
+        assert ei.value.missing == [0, 1, 3, 4]
+
+    def test_one_arrival_key_regardless_of_world(self, store):
+        world = 16
+        errors = []
+
+        def member(i, server_port):
+            c = StoreClient("127.0.0.1", server_port)
+            try:
+                reentrant_barrier(c, "o1", i, world, timeout=15.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+            finally:
+                c.close()
+
+        port = store.port
+        threads = [
+            threading.Thread(target=member, args=(i, port)) for i in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        # O(1) keys: one arrival log + one done key — not one key per rank
+        assert sorted(store.list_keys("barrier/o1/")) == [
+            b"barrier/o1/arrivals", b"barrier/o1/done",
+        ]
+
+    def test_survivor_completes_after_arriver_crash(self, store):
+        """A rank dying between its APPEND and the done-set must not wedge
+        the barrier: any waiter completes it from the log on its next poll."""
+        # simulate the crashed completer: its arrival is in the log, but
+        # done was never set
+        store.append("barrier/cw/arrivals", "1,")
+        t0 = time.monotonic()
+        reentrant_barrier(store, "cw", 0, 2, timeout=10.0)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_ranks_subset(self, store):
+        reentrant_barrier(store, "sub", 3, 8, timeout=5.0, ranks=[3])
+        with pytest.raises(BarrierTimeout) as ei:
+            reentrant_barrier(store, "sub2", 3, 8, timeout=0.4, ranks=[3, 5])
+        assert ei.value.missing == [5]
+
+
+# -- failover: shard death mid-op --------------------------------------------
+
+
+class TestShardFailover:
+    def test_mid_wait_shard_sigkill_with_replacement(self, tmp_path):
+        """A WAIT parked on a shard survives SIGKILL + journal-replayed
+        replacement on the same endpoint: the caller sees one (slow) round
+        trip, and the reconnect retries land on the store_connect site."""
+        ports = [free_port(), free_port()]
+        journals = [str(tmp_path / f"j{i}") for i in range(2)]
+        procs = [
+            spawn_shard_subprocess(p, journal=j)
+            for p, j in zip(ports, journals)
+        ]
+        endpoints = [f"127.0.0.1:{p}" for p in ports]
+        try:
+            c = ShardedStoreClient(endpoints, timeout=60.0)
+            victim = c.map.shard_for(b"late/key")
+            released = {}
+
+            def block():
+                try:
+                    c.wait(["late/key"], timeout=45.0)
+                    released["ok"] = True
+                except Exception as exc:  # noqa: BLE001
+                    released["err"] = exc
+
+            t = threading.Thread(target=block)
+            t.start()
+            time.sleep(0.5)  # parked server-side
+            backoffs_before = _counter(
+                "tpurx_retry_backoffs_total", "store_connect"
+            )
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait(timeout=10)
+            time.sleep(1.0)  # dead window: the waiter must back off into it
+            procs[victim] = spawn_shard_subprocess(
+                ports[victim], journal=journals[victim]
+            )
+            setter = ShardedStoreClient(endpoints, timeout=20.0)
+            setter.set("late/key", b"v")
+            t.join(timeout=30)
+            assert released.get("ok"), released
+            assert c.get("late/key", timeout=5.0) == b"v"
+            assert (
+                _counter("tpurx_retry_backoffs_total", "store_connect")
+                > backoffs_before
+            )
+            setter.close()
+            c.close()
+        finally:
+            for p in procs:
+                p.kill()
+
+    def test_mid_cas_shard_sigkill_with_replacement(self, tmp_path):
+        """COMPARE_SET issued into a dead shard succeeds once the journal-
+        replayed replacement is up — one retried round trip, not an error."""
+        port = free_port()
+        journal = str(tmp_path / "jcas")
+        proc = spawn_shard_subprocess(port, journal=journal)
+        try:
+            c = ShardedStoreClient([f"127.0.0.1:{port}"], timeout=30.0)
+            c.set("warm", b"1")  # established socket to the doomed shard
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            proc = spawn_shard_subprocess(port, journal=journal)
+            ok, v = c.compare_set_ex("cas/k", b"", b"claimed")
+            assert ok and v == b"claimed"
+            assert c.get("warm", timeout=5.0) == b"1"  # journal replayed
+            c.close()
+        finally:
+            proc.kill()
+
+    def test_cas_recovery_branches_and_site_label(self, shard_group):
+        """Deterministic recovery semantics: a 'connection lost after send'
+        is retried under the store_cas_failover site; when the replacement
+        already holds ``desired`` the first send is recognized as applied."""
+        c = shard_group.client(timeout=10.0)
+        idx = c._shard_idx("det/k")
+
+        def arm_one_failure():
+            inner = c._clients[idx]
+            orig = inner.compare_set_ex
+            state = {"fired": False}
+
+            def flaky(key, expected, desired):
+                if not state["fired"]:
+                    state["fired"] = True
+                    raise StoreError(
+                        "store op COMPARE_SET connection lost after send; "
+                        "not retrying non-idempotent op: injected"
+                    )
+                return orig(key, expected, desired)
+
+            inner.compare_set_ex = flaky
+
+        attempts_before = _counter(
+            "tpurx_retry_attempts_total", "store_cas_failover"
+        )
+        arm_one_failure()
+        ok, v = c.compare_set_ex("det/k", b"", b"v1")
+        assert ok and v == b"v1"
+        assert (
+            _counter("tpurx_retry_attempts_total", "store_cas_failover")
+            > attempts_before
+        )
+        # applied-before-death branch: the key already holds `desired` when
+        # the client re-inspects — recognized as OUR swap, no re-issue
+        # (a blind re-issue with expected=b"" would CAS_FAIL)
+        c.set("det/k2", b"v2")
+        arm_one_failure_key2 = c._shard_idx("det/k2")
+        inner2 = c._clients[arm_one_failure_key2]
+        orig2 = inner2.compare_set_ex
+        state2 = {"fired": False}
+
+        def flaky2(key, expected, desired):
+            if not state2["fired"]:
+                state2["fired"] = True
+                raise StoreError(
+                    "store op COMPARE_SET connection lost after send: injected"
+                )
+            return orig2(key, expected, desired)
+
+        inner2.compare_set_ex = flaky2
+        ok2, v2 = c.compare_set_ex("det/k2", b"", b"v2")
+        assert ok2 and v2 == b"v2"
+        c.close()
+
+
+# -- journal compaction crash consistency ------------------------------------
+
+
+class TestCompactionCrashConsistency:
+    def test_kill_mid_write_snapshot_loses_nothing(self, tmp_path):
+        """The satellite: die mid-``write_snapshot`` (fault hook: os._exit
+        after N snapshot records), restart from the journal, and every ACKED
+        mutation — including ones acked WHILE the snapshot was being
+        written — replays with no loss and no duplication."""
+        port = free_port()
+        journal = str(tmp_path / "crash.journal")
+        proc = spawn_shard_subprocess(
+            port,
+            journal=journal,
+            journal_max_bytes=2048,  # compaction after ~25 writes
+            env={"TPURX_STORE_TEST_COMPACT_CRASH": "2"},
+        )
+        client = StoreClient("127.0.0.1", port, timeout=5.0, retries=0)
+        acked = {}
+        try:
+            for i in range(500):
+                key = f"k{i}"
+                val = f"v{i}".encode().ljust(64, b"x")
+                client.set(key, val)
+                acked[key] = val
+        except (StoreError, StoreTimeout):
+            pass  # the injected crash severed the connection
+        client.close()
+        proc.wait(timeout=30)
+        assert proc.returncode == 137  # died inside write_snapshot
+        assert len(acked) > 20, "crash fired before compaction?"
+
+        srv = StoreServer(
+            host="127.0.0.1", port=0, journal_path=journal
+        ).start_in_thread()
+        try:
+            c2 = StoreClient("127.0.0.1", srv.port, timeout=10.0)
+            for key, val in acked.items():
+                assert c2.get(key, timeout=5.0) == val, f"lost acked {key}"
+            # no duplicated/fabricated records: the replayed keyspace is the
+            # acked set, plus at most the single in-flight unacked write
+            n = c2.num_keys()
+            assert len(acked) <= n <= len(acked) + 1, (len(acked), n)
+            c2.close()
+        finally:
+            srv.stop()
+
+
+# -- soak smoke: shard kill mid-rendezvous + verdict round --------------------
+
+
+class TestShardKillMidRound:
+    def test_rendezvous_and_verdict_survive_shard_kill(self, tmp_path):
+        """The acceptance gate: SIGKILL one shard during an active
+        rendezvous round, bring up its journal-replayed replacement, and the
+        round closes with every node assigned; then a verdict-style tree
+        round whose leaf payloads predate a second kill completes from the
+        replayed journal.  No caller sees an error — the pod-wide-restart
+        path is never entered."""
+        from tpu_resiliency.fault_tolerance.rendezvous import (
+            NodeDesc,
+            RendezvousHost,
+            RendezvousJoiner,
+            k_join_count,
+        )
+
+        ports = [free_port(), free_port()]
+        journals = [str(tmp_path / f"soak{i}") for i in range(2)]
+        procs = [
+            spawn_shard_subprocess(p, journal=j)
+            for p, j in zip(ports, journals)
+        ]
+        endpoints = [f"127.0.0.1:{p}" for p in ports]
+        n_nodes = 4
+        try:
+            host_client = ShardedStoreClient(endpoints, timeout=90.0)
+            host = RendezvousHost(
+                host_client, min_nodes=n_nodes, max_nodes=n_nodes,
+                settle_time=0.2,
+            )
+            host.bootstrap()
+            round_num = host.open_round()
+            results, errors = {}, []
+
+            def joiner(i):
+                c = ShardedStoreClient(endpoints, timeout=90.0)
+                try:
+                    results[i] = RendezvousJoiner(
+                        c, NodeDesc.create(node_id=f"soak-{i}", slots=1),
+                        open_poll_interval=0.05,
+                    ).join(timeout=60.0)
+                except Exception as exc:  # noqa: BLE001
+                    errors.append((i, exc))
+                finally:
+                    c.close()
+
+            closer = threading.Thread(
+                target=lambda: host.close_round_when_ready(timeout=60.0)
+            )
+            closer.start()
+            early = [
+                threading.Thread(target=joiner, args=(i,)) for i in range(3)
+            ]
+            for t in early:
+                t.start()
+            probe = ShardedStoreClient(endpoints, timeout=30.0)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if (probe.try_get(k_join_count(round_num)) or b"0") == b"3":
+                    break
+                time.sleep(0.05)
+            # kill one shard MID-ROUND (3 joiners parked, round open)
+            procs[1].send_signal(signal.SIGKILL)
+            procs[1].wait(timeout=10)
+            time.sleep(0.5)
+            procs[1] = spawn_shard_subprocess(ports[1], journal=journals[1])
+            late = threading.Thread(target=joiner, args=(3,))
+            late.start()
+            closer.join(timeout=60)
+            for t in early:
+                t.join(timeout=60)
+            late.join(timeout=60)
+            assert not errors, errors
+            assert len(results) == n_nodes
+            assert all(
+                r.role.value == "participant" and r.group_world_size == n_nodes
+                for r in results.values()
+            )
+
+            # verdict-style tree round across a second kill: leaves publish,
+            # the shard dies and is journal-replayed, then the root gathers
+            for rank in (1, 2, 3):
+                tree_gather(
+                    probe, rank, 4, prefix="soak/verdict/0",
+                    payload=json.dumps({rank: {"bad_holder": None}}).encode(),
+                    combine=combine_json_merge, timeout=20.0, fanout=4,
+                    site="test",
+                )
+            procs[1].send_signal(signal.SIGKILL)
+            procs[1].wait(timeout=10)
+            procs[1] = spawn_shard_subprocess(ports[1], journal=journals[1])
+            merged = tree_gather(
+                probe, 0, 4, prefix="soak/verdict/0",
+                payload=json.dumps({0: {"bad_holder": 2}}).encode(),
+                combine=combine_json_merge, timeout=30.0, fanout=4,
+                site="test",
+            )
+            verdicts = {int(r): v for r, v in json.loads(merged).items()}
+            assert set(verdicts) == {0, 1, 2, 3}
+            assert verdicts[0]["bad_holder"] == 2
+            probe.close()
+            host_client.close()
+        finally:
+            for p in procs:
+                p.kill()

@@ -1,0 +1,275 @@
+"""Hierarchical aggregation (store/tree.py): topology, gather semantics,
+and the O(fanout) rank-0 inbound guarantee at a simulated 64-rank job."""
+
+import json
+import threading
+
+import pytest
+
+from tpu_resiliency.store import StoreClient, TreeGatherTimeout, TreeTopology, tree_gather
+from tpu_resiliency.store.tree import combine_int_max, combine_json_merge
+
+
+class CountingStore:
+    """StoreClient wrapper tallying payloads consumed via multi_get — the
+    tree's only inbound-read path, so the tally IS the inbound count."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.inbound_payloads = 0
+
+    def multi_get(self, keys):
+        out = self._inner.multi_get(keys)
+        self.inbound_payloads += sum(1 for v in out if v is not None)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestTopology:
+    def test_heap_shape(self):
+        t = TreeTopology(0, 64, fanout=4)
+        assert t.parent is None
+        assert t.children == [1, 2, 3, 4]
+        t5 = TreeTopology(5, 64, fanout=4)
+        assert t5.parent == 1
+        assert t5.children == [21, 22, 23, 24]
+        leaf = TreeTopology(63, 64, fanout=4)
+        assert leaf.children == []
+        assert leaf.parent == 15
+
+    def test_every_rank_has_consistent_parent(self):
+        for fanout in (2, 4, 16):
+            for world in (1, 2, 5, 64, 100):
+                for r in range(1, world):
+                    t = TreeTopology(r, world, fanout=fanout)
+                    assert r in TreeTopology(t.parent, world, fanout=fanout).children
+
+    def test_depth_logarithmic(self):
+        assert TreeTopology(0, 64, fanout=4).depth() == 0
+        assert TreeTopology(63, 64, fanout=4).depth() == 3
+        assert TreeTopology(63, 64, fanout=16).depth() == 2
+
+
+def _run_tree_round(store_server, world, fanout, broadcast=False, payload_fn=None,
+                    combine=combine_json_merge, timeout=30.0):
+    """Drive one tree round with `world` threads; returns (results, stores)."""
+    results, stores, errors = {}, {}, []
+
+    def run(rank):
+        inner = StoreClient("127.0.0.1", store_server.port, timeout=timeout)
+        c = CountingStore(inner)
+        stores[rank] = c
+        payload = (
+            payload_fn(rank) if payload_fn
+            else json.dumps({rank: f"p{rank}"}).encode()
+        )
+        try:
+            results[rank] = tree_gather(
+                c, rank, world, prefix="t/round/0", payload=payload,
+                combine=combine, timeout=timeout, fanout=fanout,
+                broadcast=broadcast, site="test",
+            )
+        except Exception as exc:  # noqa: BLE001
+            errors.append((rank, exc))
+        finally:
+            inner.close()
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors[:3]
+    return results, stores
+
+
+class TestTreeGather:
+    def test_single_rank(self, store_server):
+        results, _ = _run_tree_round(store_server, 1, 4)
+        assert json.loads(results[0]) == {"0": "p0"}
+
+    def test_gather_merges_all_ranks(self, store_server):
+        world = 10
+        results, _ = _run_tree_round(store_server, world, 3)
+        merged = {int(k): v for k, v in json.loads(results[0]).items()}
+        assert merged == {r: f"p{r}" for r in range(world)}
+        for r in range(1, world):
+            assert results[r] is None
+
+    def test_broadcast_hands_result_to_every_rank(self, store_server):
+        world = 9
+        results, _ = _run_tree_round(store_server, world, 3, broadcast=True)
+        expected = {str(r): f"p{r}" for r in range(world)}
+        for r in range(world):
+            assert json.loads(results[r]) == expected
+
+    def test_int_max_combiner(self, store_server):
+        world = 7
+        results, _ = _run_tree_round(
+            store_server, world, 2, broadcast=True,
+            payload_fn=lambda r: str(r * 11).encode(),
+            combine=combine_int_max,
+        )
+        assert all(int(results[r]) == 66 for r in range(world))
+
+    def test_round_leaves_no_node_keys(self, store_server):
+        _run_tree_round(store_server, 8, 4)
+        c = StoreClient("127.0.0.1", store_server.port)
+        assert c.list_keys("t/round/0/n/") == []
+        c.close()
+
+    def test_timeout_names_missing_subtree(self, store_server):
+        c = StoreClient("127.0.0.1", store_server.port, timeout=5.0)
+        with pytest.raises(TreeGatherTimeout) as ei:
+            tree_gather(
+                c, 0, 4, prefix="t/dead", payload=b"{}",
+                combine=combine_json_merge, timeout=0.4, fanout=4,
+            )
+        # children 1..3 never published; all are named
+        assert ei.value.missing_ranks == [1, 2, 3]
+        c.close()
+
+    def test_rank0_inbound_is_fanout_at_64_ranks(self, store_server):
+        """The acceptance gate: at a simulated 64-rank job the root consumes
+        O(fanout) inbound payloads per round — NOT the flat gather's 63."""
+        world, fanout = 64, 4
+        results, stores = _run_tree_round(store_server, world, fanout)
+        merged = {int(k): v for k, v in json.loads(results[0]).items()}
+        assert len(merged) == world
+        assert stores[0].inbound_payloads == fanout       # O(fanout), not O(N)
+        for rank, c in stores.items():
+            topo = TreeTopology(rank, world, fanout=fanout)
+            assert c.inbound_payloads == len(topo.children) <= fanout
+
+
+class TestRoundsRouteThroughTree:
+    """Telemetry snapshot gather + straggler report rounds + replication
+    validity rounds all run through the reduction tree with O(fanout)
+    rank-0 inbound, at a simulated 64-rank job."""
+
+    def test_telemetry_aggregator_64_ranks(self, store_server):
+        from tpu_resiliency.telemetry.aggregate import CrossRankAggregator
+        from tpu_resiliency.telemetry.registry import Registry
+
+        world, fanout = 64, 4
+        results, stores, errors = {}, {}, []
+
+        def run(rank):
+            reg = Registry(enabled=True)
+            reg.counter("tpurx_t64_total").inc(rank)
+            inner = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+            c = CountingStore(inner)
+            stores[rank] = c
+            try:
+                aggr = CrossRankAggregator(c, rank, world, fanout=fanout)
+                results[rank] = aggr.round(reg, timeout=30.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((rank, exc))
+            finally:
+                inner.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert not errors, errors[:3]
+        agg = results[0]
+        drops = agg["tpurx_t64_total"]["samples"][json.dumps({})]
+        assert drops["sum"] == sum(range(world))
+        assert drops["max_rank"] == world - 1
+        assert stores[0].inbound_payloads == fanout
+        # observers read the republished single-key feed
+        from tpu_resiliency.telemetry.aggregate import read_latest_snapshots
+
+        c = StoreClient("127.0.0.1", store_server.port)
+        latest = read_latest_snapshots(c)
+        assert set(latest) == set(range(world))
+        c.close()
+
+    def test_straggler_report_64_ranks(self, store_server, monkeypatch):
+        from tpu_resiliency.straggler.detector import Detector
+
+        monkeypatch.setenv("TPURX_TREE_FANOUT", "4")
+        world = 64
+        reports, stores, errors = {}, {}, []
+
+        def run(rank):
+            inner = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+            c = CountingStore(inner)
+            stores[rank] = c
+            det = Detector(
+                store=c, rank=rank, world_size=world, always_on=False,
+            )
+            det.initialize()
+            with det.detection_section("step"):
+                pass
+            try:
+                reports[rank] = det.generate_report(timeout=60.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((rank, exc))
+            finally:
+                det.shutdown()
+                inner.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        assert set(reports[0].section_stats) == set(range(world))
+        assert reports[1] is None  # gather_on_rank0 default
+        assert stores[0].inbound_payloads == 4
+
+    def test_replication_validity_round_uses_tree(self, tmp_path, store_server,
+                                                  monkeypatch):
+        """The manager's coverage/validity rounds route through tree_gather
+        (spied), return correct coverage, and rank-0 inbound stays bounded
+        by the fanout."""
+        import tpu_resiliency.checkpointing.local.manager as manager_mod
+        from tpu_resiliency.checkpointing.local.manager import (
+            LocalCheckpointManager,
+        )
+
+        monkeypatch.setenv("TPURX_TREE_FANOUT", "4")
+        calls = []
+        real = manager_mod.tree_gather
+
+        def spy(*args, **kwargs):
+            calls.append(kwargs.get("site"))
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(manager_mod, "tree_gather", spy)
+        world = 8
+        found, errors = {}, []
+
+        def run(rank):
+            import numpy as np
+
+            inner = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+            try:
+                mgr = LocalCheckpointManager(
+                    root_dir=str(tmp_path / f"r{rank}"),
+                    rank=rank,
+                    world_size=world,
+                    store=inner,
+                )
+                mgr.save({"w": np.full(4, rank, np.float32)}, iteration=3)
+                mgr.wait()
+                found[rank] = mgr.find_latest()
+            except Exception as exc:  # noqa: BLE001
+                errors.append((rank, exc))
+            finally:
+                inner.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        assert all(found[r] == 3 for r in range(world))
+        assert calls.count("ckpt_coverage") == world

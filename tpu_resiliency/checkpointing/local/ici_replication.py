@@ -72,19 +72,25 @@ class IciReplication:
         return clique_members(self.rank, self.world_size, self.factor, self.jump)
 
     def _agree_max_len(self, n: int, timeout: float = 60.0) -> int:
-        """All ranks agree on the padded blob length (static shapes)."""
+        """All ranks agree on the padded blob length (static shapes) — a
+        max-reduction over the tree with the result broadcast back."""
+        from ...store.tree import combine_int_max, tree_gather
+
         gen = self._sync_gen
         self._sync_gen += 1
-        prefix = f"ici_repl/len/{gen}"
-        self.store.set(f"{prefix}/r{self.rank}", str(n))
-        barrier(self.store, f"{prefix}/b", self.world_size, timeout=timeout)
-        # one RTT for all lengths (the barrier guarantees presence)
-        raws = self.store.multi_get(
-            [f"{prefix}/r{r}" for r in range(self.world_size)]
+        agreed = tree_gather(
+            self.store,
+            self.rank,
+            self.world_size,
+            prefix=f"ici_repl/len/{gen}",
+            payload=str(n).encode(),
+            combine=combine_int_max,
+            timeout=timeout,
+            broadcast=True,
+            site="ici_len",
+            gc_prefix=f"ici_repl/len/{gen - 2}/" if gen >= 2 else None,
         )
-        if raws is None:
-            raise RuntimeError("length key vanished after agreement barrier")
-        return max(int(raw) for raw in raws)
+        return int(agreed)
 
     def _shift_fn(self, shift: int):
         """Jitted ppermute by `shift` along the process axis (cached)."""

@@ -55,7 +55,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
-from ...store.barrier import barrier
+from ...store.tree import combine_json_merge, tree_gather
 from ...telemetry import counter, gauge
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
@@ -379,33 +379,53 @@ class LocalCheckpointManager:
 
     # -- find_latest -------------------------------------------------------
 
+    def _holdings_payload(self) -> bytes:
+        """This rank's holdings as a one-entry tree payload ``{rank: {iter:
+        [data_ranks]}}`` — merged rank → host → job by the reduction tree."""
+        return json.dumps(
+            {self.rank: {str(k): v for k, v in self._holdings().items()}}
+        ).encode()
+
+    def _holdings_round(
+        self, prefix: str, gen: int, timeout: float, site: str
+    ) -> Dict[int, Dict[int, List[int]]]:
+        """Collective holdings exchange through the reduction tree: every
+        rank contributes its holdings, subtrees merge, rank 0 broadcasts the
+        job-wide map back.  Every rank sees the IDENTICAL merged map (the
+        flat gather this replaces could read per-rank keys at different
+        times), and inbound payloads per node stay O(fanout)."""
+        merged = tree_gather(
+            self.store,
+            self.rank,
+            self.world_size,
+            prefix=f"{prefix}/{gen}",
+            payload=self._holdings_payload(),
+            combine=combine_json_merge,
+            timeout=timeout,
+            broadcast=True,
+            site=site,
+            gc_prefix=f"{prefix}/{gen - 2}/" if gen >= 2 else None,
+        )
+        return {
+            int(r): {int(it): ranks for it, ranks in holdings.items()}
+            for r, holdings in json.loads(merged).items()
+        }
+
     def _gather_coverage(self, gather_timeout: float = 60.0) -> Dict[int, Set[int]]:
-        """Collective: publish holdings, fence, and gather every rank's —
+        """Collective: gather every rank's holdings through the tree —
         {iteration: union of held data_ranks}."""
         if self.store is None or self.world_size == 1:
             return {it: set(ranks) for it, ranks in self._holdings().items()}
         self._publish_holdings()
         gen = self._find_gen
         self._find_gen += 1
-        barrier(
-            self.store, f"{self._ns}/find_latest/{gen}",
-            self.world_size, timeout=gather_timeout,
+        all_holdings = self._holdings_round(
+            f"{self._ns}/tree/find", gen, gather_timeout, "ckpt_coverage"
         )
         coverage: Dict[int, Set[int]] = {}
-        # every rank published (possibly-empty) holdings before the barrier:
-        # gather them in ONE round trip.  A miss here means the store lost
-        # state mid-protocol (e.g. failover to a fresh store) — surface it,
-        # the same policy as every post-barrier multi_get in this codebase.
-        keys = [f"{self._ns}/holdings/{r}" for r in range(self.world_size)]
-        raws = self.store.multi_get(keys)
-        if raws is None:
-            raise RuntimeError(
-                "holdings vanished after the find_latest barrier (store "
-                "lost state mid-protocol?)"
-            )
-        for raw in raws:
-            for it_s, data_ranks in json.loads(raw).items():
-                coverage.setdefault(int(it_s), set()).update(data_ranks)
+        for holdings in all_holdings.values():
+            for it, data_ranks in holdings.items():
+                coverage.setdefault(it, set()).update(data_ranks)
         return coverage
 
     def find_candidates(self, gather_timeout: float = 60.0) -> List[int]:
@@ -555,20 +575,12 @@ class LocalCheckpointManager:
         self._publish_holdings()
         gen = self._valid_gen
         self._valid_gen += 1
-        barrier(
-            self.store, f"{self._ns}/validity/{gen}", self.world_size,
-            timeout=120.0,
+        all_holdings = self._holdings_round(
+            f"{self._ns}/tree/valid", gen, 120.0, "ckpt_validity"
         )
-        keys = [f"{self._ns}/holdings/{r}" for r in range(self.world_size)]
-        raws = self.store.multi_get(keys)
-        if raws is None:
-            raise RuntimeError(
-                "holdings vanished after the validity barrier (store lost "
-                "state mid-protocol?)"
-            )
         covered: Set[int] = set()
-        for raw in raws:
-            covered.update(json.loads(raw).get(str(iteration), []))
+        for holdings in all_holdings.values():
+            covered.update(holdings.get(iteration, []))
         return set(range(self.world_size)) <= covered
 
     def _obtain_blob(self, iteration: int) -> bytes:
@@ -601,21 +613,17 @@ class LocalCheckpointManager:
         excluded: Set[int] = set()
         # worst case every holder of our data proves corrupt/dead once
         for attempt in range(self.world_size + 1):
-            # Republish holdings and fence: a rank restored on a fresh node
-            # (or one that just quarantined a blob) must not be elected to
-            # serve blobs it no longer has.
+            # Re-exchange holdings through the tree: a rank restored on a
+            # fresh node (or one that just quarantined a blob) must not be
+            # elected to serve blobs it no longer has.  The tree's broadcast
+            # hands every rank the SAME merged map, so all exchange plans
+            # are computed from identical state.
             self._publish_holdings()
             gen = self._load_gen
             self._load_gen += 1
-            barrier(
-                self.store, f"{self._ns}/load/{gen}", self.world_size,
-                timeout=120.0,
+            all_holdings = self._holdings_round(
+                f"{self._ns}/tree/load", gen, 120.0, "ckpt_holdings"
             )
-            all_holdings: Dict[int, Dict[int, List[int]]] = {}
-            for r in range(self.world_size):
-                raw = self.store.try_get(f"{self._ns}/holdings/{r}")
-                holdings = json.loads(raw) if raw else {}
-                all_holdings[r] = {int(k): v for k, v in holdings.items()}
             my_sends, my_source = self._exchange_plan(
                 iteration, all_holdings, excluded
             )
@@ -726,26 +734,28 @@ class LocalCheckpointManager:
     def _verdict_round(
         self, gen: int, bad_holder: Optional[int]
     ) -> Set[Tuple[int, int]]:
-        """Publish this rank's exchange verdict and gather everyone's.
-        Returns {(bad_holder, complaining_data_rank)} — empty means the
-        round was clean on every rank."""
-        self.store.set(
-            f"{self._ns}/xverdict/{gen}/{self.rank}",
-            json.dumps({"bad_holder": bad_holder}),
+        """Publish this rank's exchange verdict and gather everyone's
+        through the reduction tree (broadcast: every rank must see the same
+        verdict set to re-run identical exchange plans).  Returns
+        {(bad_holder, complaining_data_rank)} — empty means the round was
+        clean on every rank."""
+        merged = tree_gather(
+            self.store,
+            self.rank,
+            self.world_size,
+            prefix=f"{self._ns}/tree/verdict/{gen}",
+            payload=json.dumps({self.rank: {"bad_holder": bad_holder}}).encode(),
+            combine=combine_json_merge,
+            timeout=120.0,
+            broadcast=True,
+            site="ckpt_verdict",
+            gc_prefix=(
+                f"{self._ns}/tree/verdict/{gen - 2}/" if gen >= 2 else None
+            ),
         )
-        barrier(
-            self.store, f"{self._ns}/xvote/{gen}", self.world_size, timeout=120.0
-        )
-        keys = [f"{self._ns}/xverdict/{gen}/{r}" for r in range(self.world_size)]
-        raws = self.store.multi_get(keys)
-        if raws is None:
-            raise RuntimeError(
-                "exchange verdicts vanished after the vote barrier (store "
-                "lost state mid-protocol?)"
-            )
         out: Set[Tuple[int, int]] = set()
-        for r, raw in enumerate(raws):
-            holder = json.loads(raw).get("bad_holder")
+        for r, verdict in json.loads(merged).items():
+            holder = verdict.get("bad_holder")
             if holder is not None:
-                out.add((int(holder), r))
+                out.add((int(holder), int(r)))
         return out

@@ -37,7 +37,13 @@ def run(
     native: bool = False,
     journal: str | None = None,
     require_equal_slots: bool = True,
+    shards: int = 1,
 ) -> int:
+    if shards > 1:
+        return _run_sharded(
+            host, port, min_nodes, max_nodes, round_timeout, settle_time,
+            journal, require_equal_slots, shards,
+        )
     if native:
         from ..store.native import NativeStoreServer
 
@@ -100,6 +106,86 @@ def run(
         server.stop()
 
 
+def _run_sharded(
+    host: str,
+    port: int,
+    min_nodes: int,
+    max_nodes: int | None,
+    round_timeout: float,
+    settle_time: float,
+    journal: str | None,
+    require_equal_slots: bool,
+    shards: int,
+) -> int:
+    """Host K store shards (consistent-hash keyspace, per-shard journal) +
+    the rendezvous round loop.  Shard 0 binds the advertised ``port`` — the
+    rendezvous bootstrap seed — and the shard map is published there, so
+    agents may either set ``TPURX_STORE_SHARDS`` to the logged endpoint
+    list or call ``ShardedStoreClient.from_bootstrap(addr, port)`` knowing
+    only the seed.  Per-shard journals keep every shard independently
+    journal-replayable: one shard dying mid-restart is a reconnect, not a
+    control-plane loss."""
+    from ..store.server import StoreServer
+    from ..store.sharding import ShardMap, ShardedStoreClient, publish_shard_map
+
+    servers = []
+    for i in range(shards):
+        # deterministic ports (seed+i): the failover contract is same-
+        # endpoint replacement, so a restarted control plane must re-bind
+        # the SAME ports for live clients to reconnect to their shards
+        servers.append(
+            StoreServer(
+                host=host,
+                port=port + i,
+                journal_path=f"{journal}.shard{i}" if journal else None,
+                journal_strip_prefixes=[K_SHUTDOWN.encode()],
+            ).start_in_thread()
+        )
+    endpoints = [f"127.0.0.1:{s.port}" for s in servers]
+    seed = StoreClient("127.0.0.1", servers[0].port)
+    publish_shard_map(seed, ShardMap(endpoints))
+    seed.close()
+    restored = sum(s.replayed_keys for s in servers)
+    if journal and restored:
+        log.info(
+            "control-plane state restored across %d shard journals "
+            "(%d keys): cycle numbering and rendezvous rounds continue",
+            shards, restored,
+        )
+    client = ShardedStoreClient(endpoints, timeout=round_timeout)
+    rdzv = RendezvousHost(
+        client, min_nodes=min_nodes, max_nodes=max_nodes,
+        settle_time=settle_time, require_equal_slots=require_equal_slots,
+    )
+    loop = HostRoundLoop(rdzv, round_timeout)
+    loop.start()
+    log.info(
+        "sharded control plane up: %d shards on %s (seed %s:%s) — set "
+        "TPURX_STORE_SHARDS=%s",
+        shards, host, host, servers[0].port, ",".join(endpoints),
+    )
+    stop = {"flag": False}
+
+    def _sig(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            shutdown = client.try_get(K_SHUTDOWN)
+            if shutdown is not None:
+                log.info("workload shut down: %s", shutdown.decode())
+                time.sleep(5.0)  # linger so late agents observe the flag
+                return 0 if shutdown == b"success" else 1
+            time.sleep(0.5)
+        return 0
+    finally:
+        loop.stop()
+        for s in servers:
+            s.stop()
+
+
 def main(argv=None) -> None:
     setup_logger()
     p = argparse.ArgumentParser(prog="tpurx-control")
@@ -121,6 +207,11 @@ def main(argv=None) -> None:
         "--allow-heterogeneous", action="store_true",
         help="accept nodes with differing worker counts (mixed slot fleets)",
     )
+    p.add_argument(
+        "--shards", type=int, default=1,
+        help="host this many store shards (consistent-hash keyspace, "
+             "per-shard journal); shard 0 binds --port as the bootstrap seed",
+    )
     args = p.parse_args(argv)
     sys.exit(
         run(
@@ -128,6 +219,7 @@ def main(argv=None) -> None:
             args.round_timeout, args.settle_time, native=args.native_store,
             journal=args.journal,
             require_equal_slots=not args.allow_heterogeneous,
+            shards=args.shards,
         )
     )
 

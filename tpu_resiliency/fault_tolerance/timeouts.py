@@ -19,8 +19,19 @@ import json
 import time
 from typing import Callable, Dict, Optional, Sequence
 
-from ..store.barrier import barrier
+from ..store.tree import tree_gather
 from .data import HeartbeatTimeouts, SectionTimeouts
+
+
+def _combine_keywise_max(payloads) -> bytes:
+    """Tree combiner: key-wise max over ``{stat_key: value}`` JSON dicts."""
+    merged: Dict[str, float] = {}
+    for raw in payloads:
+        for k, v in json.loads(
+            raw if isinstance(raw, str) else raw.decode()
+        ).items():
+            merged[k] = max(merged.get(k, float("-inf")), v)
+    return json.dumps(merged).encode()
 
 
 class TimeoutsCalcError(RuntimeError):
@@ -135,17 +146,24 @@ class TimeoutsCalc:
             raise TimeoutsCalcError("need store+rank+world_size or reduce_fn")
         gen = self._sync_gen
         self._sync_gen += 1
-        prefix = f"tc_sync/{namespace}/{gen}" if namespace else f"tc_sync/{gen}"
-        store.set(f"{prefix}/vals/{rank}", json.dumps(vals))
-        barrier(store, f"{prefix}/gather", world_size, timeout=timeout)
-        merged: Dict[str, float] = {}
-        for r in range(world_size):
-            raw = store.get(f"{prefix}/vals/{r}", timeout=timeout)
-            for k, v in json.loads(raw).items():
-                merged[k] = max(merged.get(k, float("-inf")), v)
-        self._load_values(merged)
-        # second barrier so no rank deletes/reuses keys while others read
-        barrier(store, f"{prefix}/done", world_size, timeout=timeout)
+        base = f"tc_sync/{namespace}" if namespace else "tc_sync"
+        # key-wise max over the reduction tree, result broadcast back: every
+        # rank reads O(fanout) inbound payloads, and no read fence is needed
+        # (parents delete child keys they alone consume; the stale result
+        # key is GC'd two generations later)
+        merged_raw = tree_gather(
+            store,
+            rank,
+            world_size,
+            prefix=f"{base}/{gen}",
+            payload=json.dumps(vals).encode(),
+            combine=_combine_keywise_max,
+            timeout=timeout,
+            broadcast=True,
+            site="timeouts",
+            gc_prefix=f"{base}/{gen - 2}/" if gen >= 2 else None,
+        )
+        self._load_values(dict(json.loads(merged_raw)))
 
     # -- timeout derivation ------------------------------------------------
 

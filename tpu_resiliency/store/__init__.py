@@ -22,6 +22,15 @@ from .client import (
 )
 from .server import StoreServer, serve_forever
 from .barrier import barrier, reentrant_barrier, BarrierOverflow, BarrierTimeout
+from .sharding import (
+    ShardMap,
+    ShardServerGroup,
+    ShardedStoreClient,
+    ShardedStoreFactory,
+    publish_shard_map,
+    spawn_shard_subprocess,
+)
+from .tree import TreeGatherTimeout, TreeTopology, tree_gather
 
 __all__ = [
     "StoreClient",
@@ -36,4 +45,13 @@ __all__ = [
     "reentrant_barrier",
     "BarrierOverflow",
     "BarrierTimeout",
+    "ShardMap",
+    "ShardServerGroup",
+    "ShardedStoreClient",
+    "ShardedStoreFactory",
+    "publish_shard_map",
+    "spawn_shard_subprocess",
+    "TreeTopology",
+    "TreeGatherTimeout",
+    "tree_gather",
 ]

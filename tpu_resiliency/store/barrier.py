@@ -7,14 +7,26 @@ loudly on, reference ``store.py:46,206-211``) and a reentrant barrier that a
 rank may safely re-execute after being interrupted mid-barrier (used by the
 in-process restart loop).
 
-Both poll in timeout chunks so a hung peer is reported as BarrierTimeout with
-the set of missing ranks rather than a bare socket timeout.
+Key-traffic discipline (the sharded-store refactor's satellite): both
+barriers keep per-rank traffic O(1).  The counting barrier is one atomic
+ADD + a wait on the single ``done`` key.  The reentrant barrier's arrival
+is one atomic APPEND onto a shared arrival log — duplicates from re-entry
+are deduplicated on read, which is what makes re-execution safe with NO
+per-rank keys and NO atomicity window (the historical per-rank-key variant
+made every waiter wait on N keys: O(N) keys carried in every WAIT request,
+O(N^2) key checks server-side per barrier).  A ``generation`` embeds in the
+keys so a completed barrier name can be reused (callers usually embed a
+round/iteration counter in ``name`` instead).
+
+Both poll in timeout chunks so a hung peer is reported as
+:class:`BarrierTimeout` — which now NAMES the missing ranks (decoded from
+the arrival log) rather than just counting them.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
 from .client import StoreTimeout
 
@@ -24,11 +36,24 @@ class BarrierOverflow(RuntimeError):
 
 
 class BarrierTimeout(TimeoutError):
-    def __init__(self, name: str, arrived: int, world_size: int):
+    def __init__(
+        self,
+        name: str,
+        arrived: int,
+        world_size: int,
+        missing: Optional[List[int]] = None,
+    ):
         self.arrived = arrived
         self.world_size = world_size
+        self.missing = missing
+        detail = ""
+        if missing:
+            shown = missing[:16]
+            more = f" (+{len(missing) - 16} more)" if len(missing) > 16 else ""
+            detail = f"; missing ranks: {shown}{more}"
         super().__init__(
-            f"barrier {name!r} timed out: {arrived}/{world_size} ranks arrived"
+            f"barrier {name!r} timed out: {arrived}/{world_size} ranks "
+            f"arrived{detail}"
         )
 
 
@@ -39,7 +64,11 @@ def barrier(
     timeout: float = 300.0,
     poll_interval: float = 1.0,
 ) -> None:
-    """Counting barrier.  Each participant calls exactly once per `name`."""
+    """Counting barrier.  Each participant calls exactly once per `name`.
+
+    O(1) store traffic per participant: one ADD, then a wait on the single
+    ``done`` key (in ``poll_interval`` chunks so the deadline check runs).
+    """
     count_key = f"barrier/{name}/count"
     done_key = f"barrier/{name}/done"
     arrived = store.add(count_key, 1)
@@ -64,6 +93,12 @@ def barrier(
             continue
 
 
+def _decode_arrivals(raw: Optional[bytes]) -> Set[int]:
+    if not raw:
+        return set()
+    return {int(tok) for tok in raw.decode().split(",") if tok}
+
+
 def reentrant_barrier(
     store,
     name: str,
@@ -71,24 +106,56 @@ def reentrant_barrier(
     world_size: int,
     timeout: float = 300.0,
     ranks: Optional[Sequence[int]] = None,
+    generation: int = 0,
 ) -> None:
-    """Barrier safe to re-execute: arrival is an idempotent per-rank key.
+    """Barrier safe to re-execute: arrival is one atomic APPEND onto a
+    shared log, deduplicated on read.
 
-    A rank interrupted mid-barrier can call again with the same `name` and
-    will not double-count (reference ``store.py:254-321``).  `ranks` narrows
-    the participant set (used when terminated ranks are excluded).
+    A rank interrupted ANYWHERE mid-barrier can call again with the same
+    ``name`` and will not double-count — a duplicate log entry collapses in
+    the dedup, unlike a counter increment (reference ``store.py:254-321``
+    solved this with an idempotent per-rank key, at the cost of every
+    waiter waiting on N keys).  ``ranks`` narrows the participant set (used
+    when terminated ranks are excluded); arrivals from outside it are
+    tolerated and ignored.  Per-rank traffic: one APPEND, at most one
+    completion check, and a wait on the single ``done`` key.
     """
-    participants = list(ranks) if ranks is not None else list(range(world_size))
-    store.set(f"barrier/{name}/arrived/{rank}", b"1")
-    keys = [f"barrier/{name}/arrived/{r}" for r in participants]
+    participants = set(ranks) if ranks is not None else set(range(world_size))
+    gen = f"/g{generation}" if generation else ""
+    arrivals_key = f"barrier/{name}{gen}/arrivals"
+    done_key = f"barrier/{name}{gen}/done"
+
+    new_len = store.append(arrivals_key, f"{rank},")
+    # completion is only possible once the log is at least as long as the
+    # participants' tokens laid end-to-end; below that, skip the read
+    min_len = sum(len(str(r)) + 1 for r in participants)
+    if new_len >= min_len:
+        arrived = _decode_arrivals(store.try_get(arrivals_key))
+        if participants <= arrived:
+            store.set(done_key, b"1")  # idempotent: any completer may set it
+
     deadline = time.monotonic() + timeout
     while True:
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            present = sum(1 for k in keys if store.check([k]))
-            raise BarrierTimeout(name, present, len(participants))
+            arrived = _decode_arrivals(store.try_get(arrivals_key))
+            present = participants & arrived
+            raise BarrierTimeout(
+                name,
+                len(present),
+                len(participants),
+                missing=sorted(participants - arrived),
+            )
         try:
-            store.wait(keys, timeout=min(remaining, 1.0))
+            store.wait([done_key], timeout=min(remaining, 1.0))
             return
         except StoreTimeout:
+            # Re-check completion each poll: the completing appender may
+            # have died between its APPEND and the done-set — any surviving
+            # waiter can finish the job from the log (this is what closes
+            # the crash window a counter-based arrival would leave open).
+            arrived = _decode_arrivals(store.try_get(arrivals_key))
+            if participants <= arrived:
+                store.set(done_key, b"1")
+                return
             continue

@@ -67,6 +67,7 @@ _IDEMPOTENT_OPS = frozenset(
         Op.LIST_KEYS,
         Op.MULTI_SET,
         Op.MULTI_GET,
+        Op.MULTI_TRY_GET,
     }
 )
 
@@ -316,15 +317,22 @@ class StoreClient:
 
     def compare_set(self, key, expected, desired) -> bytes:
         """CAS. expected=b'' means set-if-absent. Returns value after the op."""
+        return self.compare_set_ex(key, expected, desired)[1]
+
+    def compare_set_ex(self, key, expected, desired) -> tuple[bool, bytes]:
+        """CAS exposing whether the swap was APPLIED: ``(True, desired)`` on
+        success, ``(False, current)`` on mismatch.  ``compare_set`` loses the
+        distinction whenever ``desired`` equals the pre-existing value (e.g.
+        idempotent set-if-absent markers), which reentrancy protocols need."""
         status, out = self._roundtrip(
             Op.COMPARE_SET,
             [self._k(key), self._v(expected), self._v(desired)],
             self.timeout,
         )
         if status == Status.OK:
-            return out[0]
+            return True, out[0]
         if status == Status.CAS_FAIL:
-            return out[0]  # current value (b"" if key absent and expected != "")
+            return False, out[0]  # current (b"" if absent and expected != "")
         raise StoreError(f"compare_set({key}) -> {status.name}")
 
     def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
@@ -387,13 +395,20 @@ class StoreClient:
         if status != Status.OK:
             raise StoreError(f"multi_set -> {status.name}")
 
-    def multi_get(self, keys: Sequence) -> Optional[List[bytes]]:
-        status, out = self._roundtrip(Op.MULTI_GET, [self._k(k) for k in keys], self.timeout)
-        if status == Status.KEY_MISS:
-            return None
+    def multi_get(self, keys: Sequence) -> List[Optional[bytes]]:
+        """One round trip for many keys, with **per-key** misses: the result
+        holds ``None`` at each absent key's position (the historical
+        all-or-nothing ``None`` return hid WHICH key was missing, so callers
+        could only report "payload vanished" without a culprit)."""
+        status, out = self._roundtrip(
+            Op.MULTI_TRY_GET, [self._k(k) for k in keys], self.timeout
+        )
         if status != Status.OK:
             raise StoreError(f"multi_get -> {status.name}")
-        return out
+        return [
+            out[i + 1] if out[i] == b"1" else None
+            for i in range(0, len(out), 2)
+        ]
 
 
 class PrefixStore:
@@ -446,6 +461,9 @@ class PrefixStore:
 
     def compare_set(self, key, expected, desired) -> bytes:
         return self._store.compare_set(self._p(key), expected, desired)
+
+    def compare_set_ex(self, key, expected, desired):
+        return self._store.compare_set_ex(self._p(key), expected, desired)
 
     def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
         return self._store.wait([self._p(k) for k in keys], timeout)
@@ -519,7 +537,17 @@ class FailoverStoreClient(StoreClient):
 
 def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
     """Connect using TPURX_STORE_ADDR / TPURX_STORE_PORT env (set by
-    launcher); TPURX_STORE_ENDPOINTS="h1:p1,h2:p2" enables failover."""
+    launcher); TPURX_STORE_SHARDS="h1:p1,h2:p2" selects the sharded client
+    (consistent-hash routing, per-shard failover);
+    TPURX_STORE_ENDPOINTS="h1:p1,h2:p2" enables serial failover."""
+    shards = os.environ.get("TPURX_STORE_SHARDS")
+    if shards:
+        from .sharding import ShardedStoreClient  # local: avoids a cycle
+
+        return ShardedStoreClient(
+            [e.strip() for e in shards.split(",") if e.strip()],
+            timeout=timeout,
+        )
     endpoints = os.environ.get("TPURX_STORE_ENDPOINTS")
     if endpoints:
         return FailoverStoreClient(

@@ -40,6 +40,9 @@ class Op(IntEnum):
     LIST_KEYS = 12   # args: prefix -> all keys with that prefix
     MULTI_SET = 13   # args: k1, v1, k2, v2, ...
     MULTI_GET = 14   # immediate; args: key... -> value per key (KEY_MISS if any absent)
+    MULTI_TRY_GET = 15  # immediate; args: key... -> (b"1", value) per present
+                        # key, (b"0", b"") per absent one — per-key misses
+                        # instead of MULTI_GET's all-or-nothing KEY_MISS
 
 
 class Status(IntEnum):

@@ -187,9 +187,19 @@ class StoreServer:
             return
         rec = self._encode_record(key, value)
         if self._compact_buffer is not None:
-            # a compaction snapshot is being written off-loop; records buffer
-            # in memory and land on the fresh journal right after the swap
+            # A compaction snapshot is being written off-loop.  The record
+            # buffers in memory (it lands on the fresh journal before the
+            # swap) AND is appended to the OLD journal, which stays the
+            # authoritative replay source until the os.replace: a SIGKILL
+            # mid-snapshot must not lose mutations that were acked while the
+            # snapshot was being written.
             self._compact_buffer.append(rec)
+            try:
+                self._journal_file.write(rec)
+                self._journal_file.flush()
+            except (OSError, ValueError):
+                log.exception("journal write failed; disabling journal")
+                self._disable_journal()
             return
         try:
             self._journal_file.write(rec)
@@ -220,27 +230,41 @@ class StoreServer:
         tmp = self.journal_path + ".tmp"
         snapshot = list(self._data.items())
         self._compact_buffer = []
+        # test-only fault hook: die after writing N snapshot records, so the
+        # crash-consistency suite can SIGKILL-equivalent the server exactly
+        # mid-``write_snapshot`` (the soak harness's fault-injection idiom)
+        crash_after = os.environ.get("TPURX_STORE_TEST_COMPACT_CRASH")
 
-        def write_snapshot() -> None:
+        def write_snapshot() -> int:
+            written = 0
             with open(tmp, "wb") as f:
                 for key, value in snapshot:
                     f.write(self._encode_record(key, value))
+                    written += 1
+                    if crash_after is not None and written >= int(crash_after):
+                        f.flush()
+                        os._exit(137)
                 f.flush()
                 os.fsync(f.fileno())
+                return f.tell()
 
         try:
-            await self._loop.run_in_executor(None, write_snapshot)
-            # swap + drain the buffer inline (fast, no disk sync): atomic
-            # with respect to requests on this single-threaded loop
+            snapshot_bytes = await self._loop.run_in_executor(None, write_snapshot)
+            # Complete the NEW journal before it becomes authoritative: the
+            # records acked during the snapshot (buffered above, and already
+            # crash-safe on the old journal) are appended to the snapshot
+            # file BEFORE the swap, so a crash on either side of os.replace
+            # leaves one journal holding every acked mutation.  This runs
+            # inline on the single-threaded loop — atomic wrt requests.
             buffered = b"".join(self._compact_buffer)
+            if buffered:
+                with open(tmp, "ab") as f:
+                    f.write(buffered)
+                    f.flush()
+                    os.fsync(f.fileno())
             self._journal_file.close()
             os.replace(tmp, self.journal_path)
             self._journal_file = open(self.journal_path, "ab")
-            snapshot_bytes = self._journal_file.tell()
-            if buffered:
-                self._journal_file.write(buffered)
-                self._journal_file.flush()
-                self._journal_dirty = True
             self._journal_bytes = self._journal_file.tell()
             # when the live snapshot itself exceeds the cap, compacting on
             # every subsequent mutation would rewrite O(total state) per SET;
@@ -260,13 +284,12 @@ class StoreServer:
                 # size trigger, so chain a follow-up compaction now
                 self._loop.call_soon(self._maybe_rearm_compaction)
         except asyncio.CancelledError:
-            # server stopping mid-snapshot: flush buffered records to the OLD
-            # journal (still open) so acked mutations survive the restart
-            buffered = b"".join(self._compact_buffer or [])
+            # server stopping mid-snapshot: the buffered records were already
+            # appended to the OLD journal (still authoritative) as they
+            # arrived — one fsync and the acked mutations survive the restart
             self._compact_buffer = None
-            if buffered and self._journal_file is not None:
+            if self._journal_file is not None:
                 try:
-                    self._journal_file.write(buffered)
                     self._journal_file.flush()
                     os.fsync(self._journal_file.fileno())
                 except (OSError, ValueError):
@@ -401,6 +424,15 @@ class StoreServer:
                     return encode_response(Status.KEY_MISS, k)
                 vals.append(v)
             return encode_response(Status.OK, *vals)
+        if op == Op.MULTI_TRY_GET:
+            pairs: List[bytes] = []
+            for k in args:
+                v = data.get(k)
+                if v is None:
+                    pairs += [b"0", b""]
+                else:
+                    pairs += [b"1", v]
+            return encode_response(Status.OK, *pairs)
         return encode_response(Status.ERROR, b"unknown op")
 
     # -- connection handling ----------------------------------------------
@@ -526,11 +558,13 @@ def serve_forever(
     port: int,
     journal: Optional[str] = None,
     journal_strip_prefixes: Optional[List[bytes]] = None,
+    journal_max_bytes: int = 64 << 20,
 ) -> None:
     asyncio.run(
         StoreServer(
             host, port, journal_path=journal,
             journal_strip_prefixes=journal_strip_prefixes,
+            journal_max_bytes=journal_max_bytes,
         ).serve_async()
     )
 
@@ -544,6 +578,10 @@ def main() -> None:
         help="on-disk journal path: state survives a store restart",
     )
     parser.add_argument(
+        "--journal-max-bytes", type=int, default=64 << 20,
+        help="journal size that triggers snapshot compaction",
+    )
+    parser.add_argument(
         "--journal-keep-terminal", action="store_true",
         help="replay job-terminal keys (rdzv/shutdown*) too; by default they "
              "are stripped so a restarted store does not instantly terminate "
@@ -553,7 +591,8 @@ def main() -> None:
     signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
     strip = None if args.journal_keep_terminal else [b"rdzv/shutdown"]
     serve_forever(args.host, args.port, journal=args.journal,
-                  journal_strip_prefixes=strip)
+                  journal_strip_prefixes=strip,
+                  journal_max_bytes=args.journal_max_bytes)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,551 @@
+"""Sharded control-plane store: consistent-hash routing + per-shard failover.
+
+A single-process KV store is an O(N) hotspot and a single point of failure
+for every coordination path (rendezvous counts, quorum rounds, telemetry
+gathers, replication verdicts) — exactly the component Guard (PAPERS.md)
+says must scale with the fleet.  This module spreads the keyspace over K
+independent :class:`~tpu_resiliency.store.server.StoreServer` shards:
+
+- :class:`ShardMap` — a consistent-hash ring (crc32 space, ``vnodes``
+  virtual points per shard) mapping every key to one shard.  Adding or
+  removing a shard moves ~1/K of the keyspace, not all of it.
+- :class:`ShardedStoreClient` — the same primitive surface as
+  :class:`~tpu_resiliency.store.client.StoreClient`, routing each op to the
+  owning shard.  Per-key semantics (atomic ADD / COMPARE_SET, blocking
+  GET/WAIT) are preserved because each key lives on exactly one
+  single-threaded shard; multi-key ops (``wait``, ``check``, ``multi_*``,
+  ``list_keys``, ``num_keys``) split per shard and recombine.
+- **Failover contract**: every shard keeps its own journal, and a dead
+  shard's replacement is journal-replayed on the same endpoint.  Idempotent
+  ops ride the base client's reconnect; on top of that the sharded client
+  retries a whole op episode on the ``store_shard_failover`` policy while a
+  replacement comes up, and recovers interrupted COMPARE_SETs by value
+  inspection (``store_cas_failover`` site) — callers see one slow round
+  trip, never an error, for any fault the journal covers.
+- **Bootstrap**: the shard map is published on the seed shard under
+  :data:`SHARD_MAP_KEY`; a client that only knows the rendezvous seed
+  endpoint (``TPURX_STORE_ADDR/PORT``) calls
+  :meth:`ShardedStoreClient.from_bootstrap`.  Launchers set
+  ``TPURX_STORE_SHARDS=h1:p1,h2:p2,...`` to skip the extra hop.
+
+Server side, :class:`ShardServerGroup` hosts K asyncio shards in one
+process (tests, single-host jobs) and :func:`spawn_shard_subprocess` spawns
+one shard as a separate kill-able process (bench fan-in lanes, soak fault
+injection, production one-process-per-core layouts).
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import zlib
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..telemetry import counter, gauge
+from ..utils.logging import get_logger
+from ..utils.retry import Retrier, RetryExhausted, RetryPolicy
+from .client import (
+    _DEFAULT_TIMEOUT,
+    StoreClient,
+    StoreError,
+    StoreTimeout,
+)
+
+log = get_logger("store.sharding")
+
+SHARD_MAP_KEY = "store/shard_map"
+
+# episode-level failover budget while a journal-replayed replacement shard
+# comes up (the base client's own reconnect budget is ~seconds; this rides
+# above it and covers a scheduler-speed respawn)
+FAILOVER_POLICY = RetryPolicy(
+    max_attempts=None, base_delay=0.5, max_delay=5.0, deadline=60.0
+)
+
+_SHARD_OPS = counter(
+    "tpurx_store_shard_ops_total",
+    "KV store ops routed per shard by the sharded client",
+    labels=("shard",),
+)
+_SHARD_FAILOVERS = counter(
+    "tpurx_store_shard_failovers_total",
+    "Op episodes that had to ride out a shard death (reconnect + retry)",
+    labels=("shard",),
+)
+_SHARD_COUNT = gauge(
+    "tpurx_store_shard_count", "Shards in this client's shard map"
+)
+
+
+def _parse_endpoints(endpoints) -> List[Tuple[str, int]]:
+    out = []
+    for e in endpoints:
+        if isinstance(e, str):
+            host, _, port = e.rpartition(":")
+            out.append((host, int(port)))
+        else:
+            host, port = e
+            out.append((host, int(port)))
+    if not out:
+        raise ValueError("need at least one shard endpoint")
+    return out
+
+
+class ShardMap:
+    """Consistent-hash ring over shard endpoints (crc32 space).
+
+    Hashing must be stable across processes and Python versions (builtin
+    ``hash`` is salted), so both ring points and key lookups use crc32.
+    Ring points are keyed by shard INDEX, not endpoint: a shard's identity
+    is its position (which is also what names its journal, ``*.shard<i>``),
+    so a replacement coming up on a different host:port — a restarted
+    control plane re-binding ephemeral ports — keeps the exact same
+    key→shard routing the journals were written under.
+    """
+
+    def __init__(self, endpoints, vnodes: int = 64):
+        self.endpoints = _parse_endpoints(endpoints)
+        self.vnodes = vnodes
+        points: List[Tuple[int, int]] = []
+        for idx in range(len(self.endpoints)):
+            for v in range(vnodes):
+                h = zlib.crc32(f"shard{idx}#{v}".encode())
+                points.append((h, idx))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [i for _, i in points]
+
+    def __len__(self) -> int:
+        return len(self.endpoints)
+
+    def shard_for(self, key: bytes) -> int:
+        """Owning shard index for ``key`` (first ring point clockwise)."""
+        if len(self.endpoints) == 1:
+            return 0
+        h = zlib.crc32(key)
+        i = bisect.bisect_right(self._hashes, h)
+        if i == len(self._hashes):
+            i = 0
+        return self._owners[i]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "endpoints": [f"{h}:{p}" for h, p in self.endpoints],
+                "vnodes": self.vnodes,
+            }
+        )
+
+    @classmethod
+    def from_json(cls, raw) -> "ShardMap":
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        d = json.loads(raw)
+        return cls(d["endpoints"], vnodes=int(d.get("vnodes", 64)))
+
+
+def publish_shard_map(seed_client, shard_map: ShardMap) -> None:
+    """Publish the map on the seed shard so bootstrap-only clients (that
+    know nothing but the rendezvous endpoint) can discover the fleet."""
+    seed_client.set(SHARD_MAP_KEY, shard_map.to_json())
+
+
+class ShardedStoreClient:
+    """Client over K store shards with consistent-hash key routing.
+
+    Duck-typed to :class:`StoreClient`'s public surface; every caller
+    (PrefixStore, barriers, rendezvous, quorum, verdict rounds) works
+    unchanged.  Values ride to whichever single-threaded shard owns the key,
+    so per-key atomicity (ADD, COMPARE_SET) and blocking waits keep their
+    exact single-store semantics.
+    """
+
+    def __init__(
+        self,
+        endpoints,
+        timeout: float = _DEFAULT_TIMEOUT,
+        connect_timeout: float = 60.0,
+        vnodes: int = 64,
+        failover_policy: RetryPolicy = FAILOVER_POLICY,
+    ):
+        self.map = ShardMap(endpoints, vnodes=vnodes)
+        self.endpoints = self.map.endpoints
+        self.timeout = timeout
+        self._connect_timeout = connect_timeout
+        self._failover_policy = failover_policy
+        self._clients: List[Optional[StoreClient]] = [
+            StoreClient(h, p, timeout=timeout, connect_timeout=connect_timeout)
+            for h, p in self.endpoints
+        ]
+        self._shard_ops = [
+            _SHARD_OPS.labels(str(i)) for i in range(len(self.endpoints))
+        ]
+        _SHARD_COUNT.set(len(self.endpoints))
+
+    @classmethod
+    def from_bootstrap(
+        cls, host: str, port: int, timeout: float = _DEFAULT_TIMEOUT, **kwargs
+    ) -> "ShardedStoreClient":
+        """Discover the shard fleet from the seed endpoint: read the
+        published :data:`SHARD_MAP_KEY` (blocking — the launcher publishes
+        it during rendezvous bootstrap) and connect to every shard."""
+        seed = StoreClient(host, port, timeout=timeout)
+        try:
+            raw = seed.get(SHARD_MAP_KEY, timeout=timeout)
+        finally:
+            seed.close()
+        m = ShardMap.from_json(raw)
+        return cls(m.endpoints, timeout=timeout, vnodes=m.vnodes, **kwargs)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _shard_idx(self, key) -> int:
+        k = key.encode() if isinstance(key, str) else bytes(key)
+        return self.map.shard_for(k)
+
+    def _client(self, idx: int) -> StoreClient:
+        c = self._clients[idx]
+        if c is None:
+            host, port = self.endpoints[idx]
+            c = StoreClient(
+                host, port, timeout=self.timeout,
+                connect_timeout=self._connect_timeout,
+            )
+            self._clients[idx] = c
+        return c
+
+    def _reconnect(self, idx: int) -> None:
+        c, self._clients[idx] = self._clients[idx], None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _routed(self, idx: int, fn: Callable[[StoreClient], object]):
+        """Run ``fn`` against shard ``idx``, riding out a shard death.
+
+        The base client already retries transport-level failures of
+        idempotent ops; what lands here as :class:`StoreError` is a shard
+        that stayed dead past that budget.  The failover episode reconnects
+        and re-runs under ``store_shard_failover`` until the journal-replayed
+        replacement accepts, or the policy deadline expires.  ``fn`` must be
+        safe to re-run (idempotent op, or recovery logic like the CAS path).
+        """
+        self._shard_ops[idx].inc()
+        retrier: Optional[Retrier] = None
+        while True:
+            try:
+                return fn(self._client(idx))
+            except StoreTimeout:
+                raise  # caller's budget semantics, not a shard death
+            except StoreError as exc:
+                if retrier is None:
+                    retrier = Retrier(
+                        "store_shard_failover", self._failover_policy
+                    )
+                    _SHARD_FAILOVERS.labels(str(idx)).inc()
+                host, port = self.endpoints[idx]
+                log.warning(
+                    "shard %d (%s:%d) unavailable (%s); waiting for its "
+                    "replacement", idx, host, port, exc,
+                )
+                try:
+                    retrier.backoff(exc)
+                except RetryExhausted as give_up:
+                    raise StoreError(
+                        f"shard {idx} ({host}:{port}) did not come back: "
+                        f"{give_up.last_exc}"
+                    ) from give_up
+                self._reconnect(idx)
+
+    def _by_shard(self, keys: Sequence) -> dict:
+        """{shard_idx: [(position, key), ...]} preserving caller order."""
+        groups: dict = {}
+        for pos, key in enumerate(keys):
+            groups.setdefault(self._shard_idx(key), []).append((pos, key))
+        return groups
+
+    # -- public API (mirrors StoreClient) ----------------------------------
+
+    def clone(self) -> "ShardedStoreClient":
+        return ShardedStoreClient(
+            [f"{h}:{p}" for h, p in self.endpoints],
+            timeout=self.timeout,
+            vnodes=self.map.vnodes,
+            failover_policy=self._failover_policy,
+        )
+
+    def close(self) -> None:
+        for i, c in enumerate(self._clients):
+            if c is not None:
+                c.close()
+                self._clients[i] = None
+
+    def ping(self) -> bool:
+        return all(
+            self._routed(i, lambda c: c.ping())
+            for i in range(len(self.endpoints))
+        )
+
+    def set(self, key, value) -> None:
+        return self._routed(self._shard_idx(key), lambda c: c.set(key, value))
+
+    def get(self, key, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + t
+        idx = self._shard_idx(key)
+
+        def attempt(c: StoreClient) -> bytes:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise StoreTimeout(f"get({key}) timed out after {t}s")
+            return c.get(key, timeout=remaining)
+
+        return self._routed(idx, attempt)
+
+    def try_get(self, key) -> Optional[bytes]:
+        return self._routed(self._shard_idx(key), lambda c: c.try_get(key))
+
+    def add(self, key, amount: int = 1) -> int:
+        # at-most-once like the base client: ADD cannot be blind-resent (a
+        # double-applied arrival is a protocol corruption, not a retry)
+        return self._shard_ops_inc_and_call(
+            self._shard_idx(key), lambda c: c.add(key, amount)
+        )
+
+    def append(self, key, value) -> int:
+        return self._shard_ops_inc_and_call(
+            self._shard_idx(key), lambda c: c.append(key, value)
+        )
+
+    def _shard_ops_inc_and_call(self, idx: int, fn):
+        self._shard_ops[idx].inc()
+        return fn(self._client(idx))
+
+    def compare_set(self, key, expected, desired) -> bytes:
+        return self.compare_set_ex(key, expected, desired)[1]
+
+    def compare_set_ex(self, key, expected, desired) -> Tuple[bool, bytes]:
+        """CAS with failover recovery.
+
+        A connection lost after the request left may or may not have applied
+        the swap.  The journal-replayed replacement holds the truth: re-read
+        the key — if it now holds ``desired``, the first send won (control-
+        plane CAS values are round-fenced, so observing ``desired`` means
+        OUR swap applied); otherwise re-issue the CAS.  Counted under the
+        ``store_cas_failover`` retry site.
+        """
+        idx = self._shard_idx(key)
+        self._shard_ops[idx].inc()
+        retrier: Optional[Retrier] = None
+        while True:
+            try:
+                return self._client(idx).compare_set_ex(key, expected, desired)
+            except StoreTimeout:
+                raise
+            except StoreError as exc:
+                if retrier is None:
+                    retrier = Retrier(
+                        "store_cas_failover", self._failover_policy
+                    )
+                    _SHARD_FAILOVERS.labels(str(idx)).inc()
+                try:
+                    retrier.backoff(exc)
+                except RetryExhausted as give_up:
+                    raise StoreError(
+                        f"compare_set({key}): shard {idx} did not come "
+                        f"back: {give_up.last_exc}"
+                    ) from give_up
+                self._reconnect(idx)
+                try:
+                    current = self._client(idx).try_get(key)
+                except (StoreError, StoreTimeout):
+                    continue  # replacement not up yet: next backoff
+                desired_b = StoreClient._v(desired)
+                if current == desired_b:
+                    return True, desired_b  # the interrupted send applied
+                # not applied: loop re-issues the CAS against live state
+
+    def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        t = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + t
+        for idx, group in self._by_shard(keys).items():
+            group_keys = [k for _pos, k in group]
+
+            def attempt(c: StoreClient, _keys=group_keys) -> None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise StoreTimeout(
+                        f"wait({list(keys)}) timed out after {t}s"
+                    )
+                c.wait(_keys, timeout=remaining)
+
+            self._routed(idx, attempt)
+
+    def check(self, keys: Sequence) -> bool:
+        return all(
+            self._routed(idx, lambda c, _k=[k for _p, k in g]: c.check(_k))
+            for idx, g in self._by_shard(keys).items()
+        )
+
+    def delete(self, key) -> bool:
+        return self._routed(self._shard_idx(key), lambda c: c.delete(key))
+
+    def num_keys(self) -> int:
+        return sum(
+            self._routed(i, lambda c: c.num_keys())
+            for i in range(len(self.endpoints))
+        )
+
+    def list_keys(self, prefix="") -> List[bytes]:
+        out: List[bytes] = []
+        for i in range(len(self.endpoints)):
+            out.extend(self._routed(i, lambda c: c.list_keys(prefix)))
+        return out
+
+    def multi_set(self, items: dict) -> None:
+        for idx, group in self._by_shard(list(items)).items():
+            sub = {k: items[k] for _pos, k in group}
+            self._routed(idx, lambda c, _s=sub: c.multi_set(_s))
+
+    def multi_get(self, keys: Sequence) -> List[Optional[bytes]]:
+        out: List[Optional[bytes]] = [None] * len(keys)
+        for idx, group in self._by_shard(keys).items():
+            vals = self._routed(
+                idx, lambda c, _k=[k for _p, k in group]: c.multi_get(_k)
+            )
+            for (pos, _key), val in zip(group, vals):
+                out[pos] = val
+        return out
+
+
+class ShardedStoreFactory:
+    """Picklable ``() -> ShardedStoreClient`` factory (the sharded analog of
+    :class:`~tpu_resiliency.store.client.StoreFactory` — spawn-safe for
+    subprocess helpers that cannot pickle a lambda)."""
+
+    def __init__(self, endpoints, timeout: float = _DEFAULT_TIMEOUT, **kwargs):
+        self.endpoints = [
+            f"{h}:{p}" for h, p in _parse_endpoints(endpoints)
+        ]
+        self.timeout = timeout
+        self.kwargs = kwargs
+
+    def __call__(self) -> ShardedStoreClient:
+        return ShardedStoreClient(
+            self.endpoints, timeout=self.timeout, **self.kwargs
+        )
+
+
+# -- hosting helpers ---------------------------------------------------------
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """A currently-free TCP port (picked-then-released: a tiny race window
+    that shard spawners accept in exchange for announcing ports up front)."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class ShardServerGroup:
+    """K in-process asyncio shards (tests, single-host control planes).
+
+    Each shard gets its own journal (``<base>.shard<i>``) so any one can be
+    killed and journal-replayed independently.  The shard map is published
+    on shard 0 (the bootstrap seed) once the fleet is listening.
+    """
+
+    def __init__(
+        self,
+        n_shards: int,
+        host: str = "127.0.0.1",
+        journal_base: Optional[str] = None,
+        journal_max_bytes: int = 64 << 20,
+    ):
+        from .server import StoreServer
+
+        if n_shards < 1:
+            raise ValueError("need at least one shard")
+        self.servers = [
+            StoreServer(
+                host=host,
+                port=0,
+                journal_path=(
+                    f"{journal_base}.shard{i}" if journal_base else None
+                ),
+                journal_max_bytes=journal_max_bytes,
+            )
+            for i in range(n_shards)
+        ]
+
+    @property
+    def endpoints(self) -> List[str]:
+        return [f"{s.host}:{s.port}" for s in self.servers]
+
+    def start(self) -> "ShardServerGroup":
+        for s in self.servers:
+            s.start_in_thread()
+        seed = StoreClient(self.servers[0].host, self.servers[0].port)
+        try:
+            publish_shard_map(seed, ShardMap(self.endpoints))
+        finally:
+            seed.close()
+        return self
+
+    def client(self, timeout: float = _DEFAULT_TIMEOUT) -> ShardedStoreClient:
+        return ShardedStoreClient(self.endpoints, timeout=timeout)
+
+    def stop(self) -> None:
+        for s in self.servers:
+            s.stop()
+
+
+def spawn_shard_subprocess(
+    port: int,
+    host: str = "127.0.0.1",
+    journal: Optional[str] = None,
+    journal_max_bytes: Optional[int] = None,
+    env: Optional[dict] = None,
+    connect_timeout: float = 20.0,
+) -> subprocess.Popen:
+    """One shard as a separate OS process (SIGKILL-able fault-injection
+    target; real multi-core parallelism for the bench fan-in lanes).  Blocks
+    until the shard accepts connections."""
+    cmd = [
+        sys.executable, "-m", "tpu_resiliency.store.server",
+        "--host", host, "--port", str(port),
+    ]
+    if journal:
+        cmd += ["--journal", journal]
+    if journal_max_bytes is not None:
+        cmd += ["--journal-max-bytes", str(journal_max_bytes)]
+    proc = subprocess.Popen(
+        cmd,
+        env={**os.environ, **(env or {})},
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + connect_timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"shard subprocess on port {port} exited at startup "
+                f"(rc={proc.returncode})"
+            )
+        try:
+            StoreClient(host, port, connect_timeout=1.0).close()
+            return proc
+        except StoreError:
+            time.sleep(0.05)
+    proc.kill()
+    raise RuntimeError(f"shard subprocess on port {port} never accepted")

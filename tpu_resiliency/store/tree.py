@@ -1,0 +1,210 @@
+"""Hierarchical cross-rank aggregation: rank → host → job reduction trees.
+
+Every coordination round in this repo used to gather all-ranks-to-one: N
+ranks write a payload key, a barrier fences the round, and rank 0 reads all
+N keys.  That makes rank 0's inbound payload count — and the owning store
+shard's fan-in — O(N) per round.  This module replaces the pattern with a
+fanout-ary reduction tree:
+
+- ranks are nodes of a heap-shaped tree (node ``r``'s children are
+  ``fanout*r + 1 .. fanout*r + fanout``); with ``fanout`` set to the ranks-
+  per-host (default 16), the first level collapses host-local payloads
+  (rank → host) and the upper levels reduce host leaders to the job root;
+- leaves publish their payload; every internal node **waits on its
+  children's keys** (the wait IS the round fence — no barrier round
+  needed), reads them in one ``multi_get``, combines them with its own
+  payload, and publishes the partial up;
+- the root's inbound payload count is ``min(fanout, N-1)`` instead of N,
+  and with a sharded store each subtree's keys spread over shards;
+- parents delete their children's keys the moment they are consumed, so a
+  round leaves only the root result behind (reclaimed by the caller's
+  generation GC).
+
+:func:`tree_gather` is the one sanctioned gather primitive — the repo
+hygiene suite bans new direct all-ranks-to-one gather loops outside this
+module (mirroring the raw-rb-read ban in ``checkpointing/``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..telemetry import counter, gauge
+from .client import StoreTimeout
+
+ENV_FANOUT = "TPURX_TREE_FANOUT"
+DEFAULT_FANOUT = 16
+
+_ROUNDS = counter(
+    "tpurx_tree_rounds_total",
+    "Tree-aggregation rounds entered, per call site",
+    labels=("site",),
+)
+_FANIN = gauge(
+    "tpurx_tree_fanin",
+    "Inbound payloads consumed by this rank in the last tree round "
+    "(bounded by the fanout; O(world_size) would mean a regression to "
+    "flat gathers)",
+)
+
+
+def resolve_fanout(fanout: Optional[int] = None) -> int:
+    if fanout is not None:
+        return max(2, int(fanout))
+    return max(2, int(os.environ.get(ENV_FANOUT, str(DEFAULT_FANOUT))))
+
+
+class TreeGatherTimeout(TimeoutError):
+    """A subtree never published: names the missing child ranks so the
+    operator learns WHICH hosts stalled, not just that the round died."""
+
+    def __init__(self, prefix: str, missing_ranks: List[int]):
+        self.prefix = prefix
+        self.missing_ranks = missing_ranks
+        super().__init__(
+            f"tree round {prefix!r}: no payload from child subtree(s) rooted "
+            f"at rank(s) {missing_ranks}"
+        )
+
+
+class TreeTopology:
+    """This rank's position in the fanout-ary reduction tree."""
+
+    def __init__(self, rank: int, world_size: int, fanout: Optional[int] = None):
+        if not 0 <= rank < world_size:
+            raise ValueError(f"rank {rank} outside world of {world_size}")
+        self.rank = rank
+        self.world_size = world_size
+        self.fanout = resolve_fanout(fanout)
+        self.parent: Optional[int] = (
+            None if rank == 0 else (rank - 1) // self.fanout
+        )
+        self.children: List[int] = [
+            c
+            for c in range(
+                self.fanout * rank + 1, self.fanout * rank + self.fanout + 1
+            )
+            if c < world_size
+        ]
+
+    def depth(self) -> int:
+        d, r = 0, self.rank
+        while r > 0:
+            r = (r - 1) // self.fanout
+            d += 1
+        return d
+
+
+def _node_key(prefix: str, rank: int) -> str:
+    return f"{prefix}/n/{rank}"
+
+
+def _result_key(prefix: str) -> str:
+    return f"{prefix}/result"
+
+
+def tree_gather(
+    store,
+    rank: int,
+    world_size: int,
+    prefix: str,
+    payload: bytes,
+    combine: Callable[[Sequence[bytes]], bytes],
+    timeout: float = 60.0,
+    fanout: Optional[int] = None,
+    broadcast: bool = False,
+    site: str = "generic",
+    stats: Optional[dict] = None,
+    gc_prefix: Optional[str] = None,
+) -> Optional[bytes]:
+    """One reduction round over the tree.
+
+    ``prefix`` must be unique per round (callers embed a generation/round
+    counter — the store outlives worker incarnations, and key reuse across
+    rounds is the corruption class round-fencing exists to prevent).
+    ``combine`` reduces a list of payload blobs (this rank's own first, then
+    one per child subtree, ascending child rank) to one blob; it must be
+    associative in the obvious way since children hand up already-combined
+    subtrees.
+
+    Returns the combined payload on rank 0; ``None`` elsewhere — unless
+    ``broadcast`` is set, in which case rank 0 publishes the result under
+    ``{prefix}/result`` and every rank returns it (gather + broadcast ≈
+    allreduce, still O(fanout) inbound per node on the way up).
+
+    ``gc_prefix``: rank 0 deletes keys under this prefix before starting —
+    callers pass the round-minus-2 prefix so result keys (and any keys a
+    crashed round stranded) are reclaimed without a read fence.
+
+    ``stats`` (out-param, same idiom as ``load_checkpoint``): ``inbound``
+    (payload count consumed here), ``children``, ``depth``.
+    """
+    topo = TreeTopology(rank, world_size, fanout)
+    deadline = time.monotonic() + timeout
+    _ROUNDS.labels(site).inc()
+    if rank == 0 and gc_prefix:
+        for k in store.list_keys(gc_prefix):
+            store.delete(k)
+    inbound = 0
+    if topo.children:
+        child_keys = [_node_key(prefix, c) for c in topo.children]
+        try:
+            store.wait(child_keys, timeout=max(0.05, deadline - time.monotonic()))
+        except StoreTimeout:
+            raws = store.multi_get(child_keys)
+            missing = [
+                c for c, raw in zip(topo.children, raws) if raw is None
+            ]
+            raise TreeGatherTimeout(prefix, missing or topo.children) from None
+        raws = store.multi_get(child_keys)
+        missing = [c for c, raw in zip(topo.children, raws) if raw is None]
+        if missing:
+            # present at the wait, gone at the read: the store lost state
+            # mid-protocol (failover to an unjournaled replacement)
+            raise TreeGatherTimeout(prefix, missing)
+        # children consumed: reclaim their keys now (each key has exactly
+        # one reader — this node)
+        for k in child_keys:
+            store.delete(k)
+        inbound = len(raws)
+        combined = combine([payload, *raws])
+    else:
+        combined = payload
+    _FANIN.set(inbound)
+    if stats is not None:
+        stats.update(
+            inbound=inbound, children=list(topo.children), depth=topo.depth()
+        )
+    if rank == 0:
+        if broadcast:
+            store.set(_result_key(prefix), combined)
+        return combined
+    store.set(_node_key(prefix, rank), combined)
+    if broadcast:
+        result = store.get(
+            _result_key(prefix), timeout=max(0.05, deadline - time.monotonic())
+        )
+        if stats is not None:
+            stats["inbound"] = inbound + 1
+        return result
+    return None
+
+
+# -- common combiners --------------------------------------------------------
+
+
+def combine_json_merge(payloads: Sequence[bytes]) -> bytes:
+    """Merge JSON objects key-wise (later wins on collision — payload keys
+    are rank-scoped in every caller, so collisions cannot happen)."""
+    import json
+
+    out: dict = {}
+    for raw in payloads:
+        out.update(json.loads(raw if isinstance(raw, str) else raw.decode()))
+    return json.dumps(out).encode()
+
+
+def combine_int_max(payloads: Sequence[bytes]) -> bytes:
+    return str(max(int(raw) for raw in payloads)).encode()

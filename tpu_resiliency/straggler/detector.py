@@ -14,19 +14,21 @@ Usage:
             for v in report.identify_stragglers():
                 ...
 
-Cross-rank gathering rides the KV store (one payload write per rank per
-round + reads by rank 0 — the reference gathers over NCCL/Gloo,
-``dist_utils.py:85``).  ``gather_on_rank0=False`` gives every rank the full
-report (all ranks read all payloads).
+Cross-rank gathering rides the KV store's reduction tree (``store/tree.py``
+— the reference gathers over NCCL/Gloo, ``dist_utils.py:85``): payloads
+merge rank → host → job, so rank 0's inbound payload count is O(fanout) per
+round.  ``gather_on_rank0=False`` broadcasts the merged report back so
+every rank gets it.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import time
 from typing import Callable, Dict, Optional
 
-from ..store.barrier import barrier
+from ..store.tree import combine_json_merge, tree_gather
 from ..telemetry import counter, gauge
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
@@ -183,43 +185,32 @@ class Detector:
                 {self.rank: device_stats},
             )
 
-        payload = Report.rank_payload(section_stats, device_stats)
-        key = f"straggler/round/{round_idx}/rank/{self.rank}"
-        self.store.set(key, payload)
-        barrier(
-            self.store, f"straggler/round/{round_idx}/gather",
-            self.world_size, timeout=timeout,
+        # Hierarchical gather (rank → host → job): every rank's payload rides
+        # the reduction tree, so rank 0 consumes O(fanout) inbound payloads
+        # per round instead of the flat gather's O(N).  Subtree keys are
+        # deleted by their consuming parent; rank 0 GCs two-rounds-stale
+        # prefixes (covers the broadcast result key and crashed rounds).
+        payload = json.dumps(
+            {self.rank: Report.rank_payload(section_stats, device_stats)}
+        ).encode()
+        merged = tree_gather(
+            self.store,
+            self.rank,
+            self.world_size,
+            prefix=f"straggler/round/{round_idx}",
+            payload=payload,
+            combine=combine_json_merge,
+            timeout=timeout,
+            broadcast=not self.gather_on_rank0,
+            site="straggler",
+            gc_prefix=(
+                f"straggler/round/{round_idx - 2}/" if round_idx >= 2 else None
+            ),
         )
         report = None
-        if not self.gather_on_rank0 or self.rank == 0:
-            # ONE round trip for all ranks' payloads (the barrier above
-            # guarantees presence) — at 256 ranks this is the difference
-            # between 256 RTTs and 1 on the gather path
-            keys = [
-                f"straggler/round/{round_idx}/rank/{r}"
-                for r in range(self.world_size)
-            ]
-            raws = self.store.multi_get(keys)
-            if raws is None:
-                raise RuntimeError(
-                    f"straggler round {round_idx}: payload vanished after "
-                    "the gather barrier"
-                )
-            payloads = {r: raw.decode() for r, raw in enumerate(raws)}
+        if merged is not None:
+            payloads = {int(r): p for r, p in json.loads(merged).items()}
             report = Report.from_payloads(round_idx, payloads)
-        if not self.gather_on_rank0:
-            # everyone reads: fence before cleanup so no reader races a delete
-            barrier(
-                self.store, f"straggler/round/{round_idx}/read",
-                self.world_size, timeout=timeout,
-            )
-        if self.rank == 0:
-            # a multi-day run must not grow the store unboundedly: drop this
-            # round's payloads and barrier keys once consumed
-            for k in self.store.list_keys(f"straggler/round/{round_idx}/"):
-                self.store.delete(k)
-            for k in self.store.list_keys(f"barrier/straggler/round/{round_idx}/"):
-                self.store.delete(k)
         _REPORT_ROUNDS.inc()
         return report
 

@@ -1,10 +1,10 @@
 """Cross-rank metric aggregation over the KV store.
 
-Follows the straggler reporting round pattern (``straggler/reporting.py``
-``rank_payload`` / ``from_payloads``): every rank serializes its registry
-snapshot to one store key per round, a barrier fences the round, rank 0 (or
-``smonsvc`` polling the same keys) reads all payloads in one ``multi_get``
-and reduces them to job-level series:
+Snapshots ride the hierarchical reduction tree (``store/tree.py``): every
+rank serializes its registry snapshot, subtrees merge rank → host → job,
+and rank 0 consumes O(fanout) inbound payloads per round instead of the
+flat all-ranks-to-one gather's O(N).  Rank 0 reduces the merged snapshots
+to job-level series:
 
 - counters / gauges → **sum**, **max** (with the owning rank), **min**;
 - histograms → bucket-wise sums (job-level latency distribution);
@@ -22,10 +22,11 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Tuple
 
-from ..store.barrier import barrier
+from ..store.tree import combine_json_merge, tree_gather
 from .registry import Registry, get_registry
 
 K_PREFIX = "telemetry"
+K_LATEST = f"{K_PREFIX}/latest"
 
 
 def k_rank(round_idx: int, rank: int) -> str:
@@ -158,18 +159,24 @@ def render_job_metrics(aggregated: dict, prefix: str = "") -> str:
 
 
 class CrossRankAggregator:
-    """Collective gather of every rank's snapshot through the KV store.
+    """Collective gather of every rank's snapshot through the reduction
+    tree (``store/tree.py``).
 
     Every rank calls :meth:`round` at the same cadence (e.g. alongside the
     straggler report round).  Rank 0 gets the reduction; other ranks get
-    ``None``.  Round keys are deleted after consumption so multi-day jobs
-    don't grow the store.
+    ``None``.  Subtree keys are deleted by their consuming parent and rank 0
+    GCs two-rounds-stale prefixes, so multi-day jobs don't grow the store.
+    Rank 0 also republishes the merged per-rank snapshots under
+    :data:`K_LATEST` — the single-key observer feed ``smonsvc`` polls.
     """
 
-    def __init__(self, store, rank: int, world_size: int):
+    def __init__(
+        self, store, rank: int, world_size: int, fanout: Optional[int] = None
+    ):
         self.store = store
         self.rank = rank
         self.world_size = world_size
+        self.fanout = fanout
         self._round = 0
 
     def round(
@@ -177,37 +184,36 @@ class CrossRankAggregator:
     ) -> Optional[dict]:
         round_idx = self._round
         self._round += 1
-        self.store.set(k_rank(round_idx, self.rank), rank_payload(registry))
-        barrier(
+        payload = json.dumps(
+            {self.rank: (registry or get_registry()).snapshot()}
+        ).encode()
+        merged = tree_gather(
             self.store,
-            f"{K_PREFIX}/round/{round_idx}/gather",
+            self.rank,
             self.world_size,
+            prefix=f"{K_PREFIX}/round/{round_idx}",
+            payload=payload,
+            combine=combine_json_merge,
             timeout=timeout,
+            fanout=self.fanout,
+            site="telemetry",
+            gc_prefix=(
+                f"{K_PREFIX}/round/{round_idx - 2}/" if round_idx >= 2 else None
+            ),
         )
         if self.rank != 0:
             return None
-        keys = [k_rank(round_idx, r) for r in range(self.world_size)]
-        raws = self.store.multi_get(keys)
-        if raws is None:
-            raise RuntimeError(
-                f"telemetry round {round_idx}: payload vanished after the "
-                "gather barrier"
-            )
-        snapshots = {r: json.loads(raw.decode()) for r, raw in enumerate(raws)}
-        aggregated = aggregate_snapshots(snapshots)
-        for k in self.store.list_keys(f"{K_PREFIX}/round/{round_idx}/"):
-            self.store.delete(k)
-        for k in self.store.list_keys(f"barrier/{K_PREFIX}/round/{round_idx}/"):
-            self.store.delete(k)
-        return aggregated
+        self.store.set(K_LATEST, merged)
+        snapshots = {int(r): snap for r, snap in json.loads(merged).items()}
+        return aggregate_snapshots(snapshots)
 
 
-def read_latest_snapshots(store, world_size: int, round_idx: int) -> Dict[int, dict]:
-    """Non-collective read (``smonsvc`` side): best-effort fetch of whatever
-    ranks have published for ``round_idx`` — absent ranks are skipped."""
-    out: Dict[int, dict] = {}
-    for r in range(world_size):
-        raw = store.try_get(k_rank(round_idx, r))
-        if raw is not None:
-            out[r] = json.loads(raw.decode())
-    return out
+def read_latest_snapshots(store) -> Dict[int, dict]:
+    """Non-collective read (``smonsvc`` side): the merged per-rank snapshots
+    rank 0 republished after its last tree round — one key, one RTT,
+    regardless of world size (the flat poll-every-rank loop this replaces
+    was itself an all-ranks-to-one gather)."""
+    raw = store.try_get(K_LATEST)
+    if raw is None:
+        return {}
+    return {int(r): snap for r, snap in json.loads(raw.decode()).items()}
