@@ -1,76 +1,173 @@
-/* Free-running liveness beater: a pthread stamping wall-clock milliseconds
- * into a caller-owned int64 slot at a fixed interval.
+/* Free-running liveness beater (ABI v3): a pinned pthread stamping
+ * wall-clock NANOSECONDS into a caller-owned int64 slot at a fixed
+ * CLOCK_MONOTONIC cadence, bumping a caller-owned 32-bit generation word
+ * and futex-waking any waiter on every beat.
  *
  * Why native: the Python auto-beat thread's stamp jitter is GIL-scheduling
  * noise — measured p99 ~1 ms on a contended host — and the calibrated
  * detection budget must sit above safety*p99, putting a hard floor of
  * several ms on end-to-end hang detection.  A C thread never touches the
- * GIL, so its p99 is scheduler noise only (tens of µs), unlocking sub-ms
- * budgets for the PROCESS/DEVICE-liveness class of hangs.
+ * GIL; pinned (CPU affinity + best-effort SCHED_FIFO) its p99 is tens of
+ * µs, unlocking sub-ms budgets for the PROCESS/DEVICE-liveness class of
+ * hangs.
  *
  * What it deliberately does NOT prove: interpreter schedulability.  A
  * GIL-wedged interpreter keeps a native beater stamping happily — exactly
  * the hang class the Python beater exists to catch — so callers pair this
  * with the pending-call watchdog ring (progress_watchdog.py), which owns
- * GIL-wedge detection (reference split: ProgressWatchdog auto timestamps
- * vs monitor-process soft/hard kills).
+ * GIL-wedge detection.
  *
- * Contract: the slot must stay valid until tpurx_beat_stop() returns.
- * Stores are a single aligned 64-bit write (atomic on every supported
- * target); readers see either the old or the new stamp, never a tear.
+ * Clock domains (v3 contract, mirrored by ops/quorum.py):
+ * - stamps: CLOCK_REALTIME ns folded into [0, 2^63) — wall clock so every
+ *   process shares the epoch; age math on the Python side is wrap-safe
+ *   mod 2^63 with a future==fresh clamp.
+ * - cadence + jitter: CLOCK_MONOTONIC absolute deadlines — an NTP step can
+ *   neither shorten/stretch the beat interval nor appear as jitter or a
+ *   negative age.  EINTR re-enters the SAME absolute deadline (no silent
+ *   interval shortening, no drift; the remainder is implicit in
+ *   TIMER_ABSTIME).
+ *
+ * Contract: the slot AND the generation word must stay valid until
+ * tpurx_beat_stop() returns (waiters may also touch the gen word after
+ * stop — the Python side pins both for the beater's lifetime).  Stamp
+ * stores are single aligned 64-bit writes (atomic on every supported
+ * target); gen updates are atomic RMW with release ordering, so a waiter
+ * woken by the gen bump always observes the new stamp.
  */
 
+#ifndef _GNU_SOURCE
+#define _GNU_SOURCE
+#endif
+#include <errno.h>
+#include <limits.h>
 #include <pthread.h>
+#include <sched.h>
 #include <stdint.h>
 #include <stdlib.h>
+#include <string.h>
 #include <time.h>
+
+#ifdef __linux__
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+#endif
+
+#define JITTER_RING 256
+
+/* scheduling-state flag bits reported by tpurx_beat_flags */
+#define TPURX_BEAT_PINNED 1
+#define TPURX_BEAT_FIFO 2
 
 typedef struct {
     pthread_t thread;
     int64_t *slot;
-    int64_t interval_us;
+    uint32_t *gen;
+    int64_t interval_ns;
     volatile int stop;
+    int flags;
+    /* CLOCK_MONOTONIC wake lateness per beat, most recent JITTER_RING */
+    int64_t jitter[JITTER_RING];
+    volatile uint32_t jitter_n;
 } tpurx_beater;
 
-static int64_t now_ms(void) {
-    /* folded into int32 range exactly like the Python side's
-     * now_stamp_ms() — consumers mix the two stamp sources and their age
-     * math is wrap-safe only on a shared epoch representation */
+static int64_t now_realtime_ns(void) {
+    /* folded into [0, 2^63) exactly like the Python side's now_stamp_ns()
+     * — consumers mix the two stamp sources and their age math is
+     * wrap-safe only on a shared epoch representation */
     struct timespec ts;
     clock_gettime(CLOCK_REALTIME, &ts);
-    return ((int64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000)
-           % ((int64_t)1 << 31);
+    uint64_t ns = (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+    return (int64_t)(ns & ((UINT64_C(1) << 63) - 1));
+}
+
+static int64_t mono_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000ll + ts.tv_nsec;
+}
+
+static void futex_wake_all(uint32_t *addr) {
+#ifdef __linux__
+    syscall(SYS_futex, addr, FUTEX_WAKE_PRIVATE, INT_MAX, NULL, NULL, 0);
+#else
+    (void)addr;
+#endif
 }
 
 static void *beat_loop(void *arg) {
     tpurx_beater *b = (tpurx_beater *)arg;
-    struct timespec nap;
-    nap.tv_sec = b->interval_us / 1000000;
-    nap.tv_nsec = (b->interval_us % 1000000) * 1000;
+    struct timespec deadline;
+    clock_gettime(CLOCK_MONOTONIC, &deadline);
     while (!b->stop) {
-        __atomic_store_n(b->slot, now_ms(), __ATOMIC_RELAXED);
-        nanosleep(&nap, NULL);
+        __atomic_store_n(b->slot, now_realtime_ns(), __ATOMIC_RELAXED);
+        if (b->gen) {
+            __atomic_add_fetch(b->gen, 1, __ATOMIC_RELEASE);
+            futex_wake_all(b->gen);
+        }
+        /* next absolute deadline; EINTR re-enters the SAME deadline, so a
+         * signal can neither shorten the interval nor drift the cadence */
+        deadline.tv_nsec += b->interval_ns;
+        while (deadline.tv_nsec >= 1000000000l) {
+            deadline.tv_nsec -= 1000000000l;
+            deadline.tv_sec += 1;
+        }
+        int rc;
+        do {
+            rc = clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &deadline,
+                                 NULL);
+        } while (rc == EINTR && !b->stop);
+        /* wake lateness vs the scheduled deadline — monotonic, so NTP
+         * steps cannot masquerade as beat jitter */
+        int64_t late = mono_ns() -
+                       ((int64_t)deadline.tv_sec * 1000000000ll +
+                        deadline.tv_nsec);
+        if (late < 0) late = 0;
+        b->jitter[b->jitter_n % JITTER_RING] = late;
+        __atomic_store_n(&b->jitter_n, b->jitter_n + 1, __ATOMIC_RELEASE);
+        if (late > b->interval_ns * 4) {
+            /* badly overslept (suspend, scheduler stall): resync instead of
+             * bursting catch-up beats at zero interval */
+            clock_gettime(CLOCK_MONOTONIC, &deadline);
+        }
     }
     return NULL;
 }
 
-void *tpurx_beat_start(int64_t *slot, int64_t interval_us) {
+static void apply_sched(tpurx_beater *b, int pin_cpu, int rt_prio) {
+    if (pin_cpu >= 0) {
+        cpu_set_t set;
+        CPU_ZERO(&set);
+        CPU_SET((unsigned)pin_cpu, &set);
+        if (pthread_setaffinity_np(b->thread, sizeof(set), &set) == 0)
+            b->flags |= TPURX_BEAT_PINNED;
+    }
+    if (rt_prio > 0) {
+        /* best-effort: EPERM without CAP_SYS_NICE is the common case —
+         * fall back to CFS silently, the affinity pin still helps */
+        struct sched_param sp;
+        memset(&sp, 0, sizeof(sp));
+        sp.sched_priority = rt_prio;
+        if (pthread_setschedparam(b->thread, SCHED_FIFO, &sp) == 0)
+            b->flags |= TPURX_BEAT_FIFO;
+    }
+}
+
+void *tpurx_beat_start(int64_t *slot, uint32_t *gen, int64_t interval_us,
+                       int pin_cpu, int rt_prio) {
     tpurx_beater *b = (tpurx_beater *)calloc(1, sizeof(tpurx_beater));
     if (!b) return NULL;
     b->slot = slot;
-    b->interval_us = interval_us > 0 ? interval_us : 1000;
-    *slot = now_ms();
+    b->gen = gen;
+    b->interval_ns = (interval_us > 0 ? interval_us : 1000) * 1000;
+    *slot = now_realtime_ns();
     if (pthread_create(&b->thread, NULL, beat_loop, b) != 0) {
         free(b);
         return NULL;
     }
+    apply_sched(b, pin_cpu, rt_prio);
     return b;
 }
-
-/* ABI marker: v2 folds stamps into the int32 epoch (Python-side wrap
- * parity).  load_native requires this symbol, forcing a rebuild over any
- * stale v1 .so whose exported functions look identical. */
-int tpurx_beat_abi_v2(void) { return 2; }
 
 void tpurx_beat_stop(void *handle) {
     if (!handle) return;
@@ -79,3 +176,82 @@ void tpurx_beat_stop(void *handle) {
     pthread_join(b->thread, NULL);
     free(b);
 }
+
+/* Stop stamping WITHOUT joining: the stamp freezes within one interval, as
+ * it would on a real wedge — benchmarks measure freeze->detect without the
+ * caller's join time polluting the latency.  tpurx_beat_stop() must still
+ * follow to join and free. */
+void tpurx_beat_freeze(void *handle) {
+    if (!handle) return;
+    ((tpurx_beater *)handle)->stop = 1;
+}
+
+int tpurx_beat_flags(void *handle) {
+    if (!handle) return 0;
+    return ((tpurx_beater *)handle)->flags;
+}
+
+/* Copy the most recent wake-lateness samples (ns) into out (up to cap);
+ * returns the number copied.  Lock-free racy-read of a ring the beater
+ * keeps appending to — samples are independent int64s, a torn count at
+ * worst re-reads one slot. */
+int tpurx_beat_jitter(void *handle, int64_t *out, int cap) {
+    if (!handle || !out || cap <= 0) return 0;
+    tpurx_beater *b = (tpurx_beater *)handle;
+    uint32_t n = __atomic_load_n(&b->jitter_n, __ATOMIC_ACQUIRE);
+    int have = n < JITTER_RING ? (int)n : JITTER_RING;
+    if (have > cap) have = cap;
+    for (int i = 0; i < have; i++) {
+        /* newest-last order, walking back from the write cursor */
+        uint32_t idx = (n - have + (uint32_t)i) % JITTER_RING;
+        out[i] = b->jitter[idx];
+    }
+    return have;
+}
+
+/* Event-driven staleness wait: park on the generation word until either a
+ * beat bumps it (return 0) or timeout_ns elapses with no beat (return 1 —
+ * staleness observed at wake latency, not poll-interval granularity).
+ * Returns 0 as well on EINTR/spurious wake (caller re-reads gen and
+ * re-enters; the budget restarts, which only ever DELAYS a trip, never
+ * fabricates one).  <0 = -errno (futex unavailable on this platform). */
+int tpurx_beat_wait_stale(uint32_t *gen, uint32_t expected,
+                          int64_t timeout_ns) {
+#ifdef __linux__
+    if (__atomic_load_n(gen, __ATOMIC_ACQUIRE) != expected) return 0;
+    if (timeout_ns <= 0) return 1;
+    struct timespec ts;
+    ts.tv_sec = timeout_ns / 1000000000ll;
+    ts.tv_nsec = timeout_ns % 1000000000ll;
+    long rc = syscall(SYS_futex, gen, FUTEX_WAIT_PRIVATE, expected, &ts,
+                      NULL, 0);
+    if (rc == 0) return 0;               /* woken by a beat */
+    if (errno == EAGAIN) return 0;       /* gen moved before we parked */
+    if (errno == ETIMEDOUT) return 1;    /* stale: no beat within budget */
+    if (errno == EINTR) return 0;        /* signal: caller re-arms */
+    return -errno;
+#else
+    (void)gen; (void)expected; (void)timeout_ns;
+    return -ENOSYS;
+#endif
+}
+
+/* Bump gen + wake waiters WITHOUT a stamp: lets a stopping tripwire (or a
+ * test) release a parked waiter at wake latency. */
+void tpurx_beat_kick(uint32_t *gen) {
+    if (!gen) return;
+    __atomic_add_fetch(gen, 1, __ATOMIC_RELEASE);
+    futex_wake_all(gen);
+}
+
+/* Epoch parity probes: tests cross-check the C and Python stamp domains
+ * through the loaded .so instead of trusting the source comment. */
+int64_t tpurx_beat_now_ns(void) { return now_realtime_ns(); }
+int tpurx_beat_wrap_bits(void) { return 63; }
+
+/* ABI marker: v3 stamps CLOCK_REALTIME nanoseconds folded mod 2^63 and
+ * adds the generation word + futex surface.  load_native requires this
+ * symbol, forcing a rebuild over any stale v2 .so (int32-ms stamps) whose
+ * start/stop exports would otherwise load fine and silently corrupt the
+ * ns-domain age math. */
+int tpurx_beat_abi_v3(void) { return 3; }

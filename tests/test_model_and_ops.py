@@ -14,7 +14,7 @@ from tpu_resiliency.models.transformer import (
     make_batch,
     make_train_step,
 )
-from tpu_resiliency.ops.quorum import QuorumMonitor, make_quorum_fn, now_stamp_ms
+from tpu_resiliency.ops.quorum import QuorumMonitor, make_quorum_fn, now_stamp_ns
 from tpu_resiliency.parallel.collectives import device_max_reduce, make_timeouts_reduce_fn
 from tpu_resiliency.parallel.mesh import make_mesh
 
@@ -64,11 +64,11 @@ def test_device_max_reduce_single_process():
 def test_quorum_reduce_max_age():
     mesh = make_mesh(("all",), (8,))
     fn = make_quorum_fn(mesh, use_pallas=False)
-    now = now_stamp_ms()
+    now = now_stamp_ns()
     stamps = np.full(8, now, dtype=np.int64)
-    stamps[3] = now - 5000  # one device 5s stale
-    age = fn(stamps)
-    assert 5000 <= age < 7000, age
+    stamps[3] = now - 500_000_000  # one device 500ms stale
+    age_ns = fn(stamps)
+    assert 500_000_000 <= age_ns < 2_000_000_000, age_ns
 
 
 def test_quorum_age_wrap_safe():
@@ -76,37 +76,37 @@ def test_quorum_age_wrap_safe():
     mesh = make_mesh(("all",), (8,))
     fn = make_quorum_fn(mesh, use_pallas=False)
     import tpu_resiliency.ops.quorum as q
-    now = 100  # just after the 2^31 wrap
-    hung = (2 ** 31) - 4000  # beat 4.1s ago, before the wrap
-    orig = q.now_stamp_ms
-    q.now_stamp_ms = lambda: now
+    now = 100_000_000  # 100ms after the 2^63 wrap
+    hung = q._WRAP_NS - 400_000_000  # beat 500ms ago, before the wrap
+    orig = q.now_stamp_ns
+    q.now_stamp_ns = lambda: now
     try:
         fn2 = make_quorum_fn(mesh, use_pallas=False)
-        stamps = np.full(8, now - 10, dtype=np.int64)
+        stamps = np.full(8, now - 1_000_000, dtype=np.int64)
         stamps[5] = hung
-        age = fn2(stamps)
-        assert 4000 <= age < 6000, age
+        age_ns = fn2(stamps)
+        assert 400_000_000 <= age_ns < 800_000_000, age_ns
     finally:
-        q.now_stamp_ms = orig
+        q.now_stamp_ns = orig
 
 
 def test_quorum_identify_names_stale_device():
-    """identify=True returns (age, device_idx) from the SAME single int32
+    """identify=True returns (age_ns, device_idx) from the SAME single int32
     pmax (host-side packing, ops/quorum.py::pack_age_device)."""
     mesh = make_mesh(("all",), (8,))
     fn = make_quorum_fn(mesh, use_pallas=False, identify=True)
-    now = now_stamp_ms()
+    now = now_stamp_ns()
     stamps = np.full(8, now, dtype=np.int64)
-    stamps[5] = now - 5000
-    age, dev = fn(stamps)
-    assert 5000 <= age < 7000, age
+    stamps[5] = now - 500_000_000  # 500ms: below the packed cap
+    age_ns, dev = fn(stamps)
+    assert 500_000_000 <= age_ns < 2_000_000_000, age_ns
     assert dev == 5
     # saturation: ages past the 15-bit cap still compare and identify
-    stamps[2] = now - 10_000_000
+    stamps[2] = now - 10_000_000_000  # 10s >> ~1.07s cap
     age2, dev2 = fn(stamps)
     assert dev2 == 2
-    from tpu_resiliency.ops.quorum import _AGE_CAP
-    assert age2 == _AGE_CAP
+    from tpu_resiliency.ops.quorum import _AGE_CAP, units_to_ns
+    assert age2 == units_to_ns(_AGE_CAP)
 
 
 def test_quorum_monitor_identify_passes_device_to_on_stale():
@@ -238,26 +238,26 @@ def test_quorum_dense_chain_and_load_calibration():
 
 
 def test_current_stamp_future_native_stamp_is_fresh():
-    """ADVICE r5 regression: the native C thread can stamp a NEWER
-    millisecond between ``_current_stamp``'s ``now`` read and its slot read.
-    The folded age then lands near 2^31 and a naive wrap-compare would
-    select a seconds-stale manual beat instead — a spurious trip.  Future
-    stamps must be treated as fresh (age clamped to 0)."""
+    """ADVICE r5 regression: the native C thread can stamp NEWER than
+    ``_current_stamp``'s ``now`` read between it and the slot read.  The
+    folded age then lands near the half-wrap horizon and a naive
+    wrap-compare would select a seconds-stale manual beat instead — a
+    spurious trip.  Future stamps must be treated as fresh (age 0)."""
     import ctypes
 
-    from tpu_resiliency.ops.quorum import _WRAP
+    from tpu_resiliency.ops.quorum import _WRAP_NS
 
     # __new__: _current_stamp needs only the two stamp fields, and the full
     # constructor builds device collectives this logic test doesn't touch
     mon = QuorumMonitor.__new__(QuorumMonitor)
-    now = now_stamp_ms()
-    mon._last_beat_ms = (now - 10_000) % _WRAP   # manual beat: 10s stale
-    fut = (now + 50) % _WRAP                     # native slot: "the future"
+    now = now_stamp_ns()
+    mon._last_beat_ns = (now - 10_000_000_000) % _WRAP_NS  # beat: 10s stale
+    fut = (now + 50_000_000) % _WRAP_NS          # native slot: "the future"
     mon._native_slot = ctypes.c_int64(fut)
     assert mon._current_stamp() == fut           # pre-fix: stale manual beat
     # stale native + fresh manual: manual must still win
-    mon._native_slot = ctypes.c_int64((now - 60_000) % _WRAP)
-    mon._last_beat_ms = now
+    mon._native_slot = ctypes.c_int64((now - 60_000_000_000) % _WRAP_NS)
+    mon._last_beat_ns = now
     assert mon._current_stamp() == now
     # no native slot: manual beat passes through
     mon._native_slot = None
@@ -280,7 +280,7 @@ def test_quorum_native_beater_stamps_and_freezes():
     )
     try:
         mon._start_beater()
-        if mon._native_handle is None:
+        if mon._native_beater is None or not mon._native_beater.alive:
             pytest.skip("native beat helper unavailable (no toolchain)")
         time.sleep(0.1)
         first = mon._native_slot.value

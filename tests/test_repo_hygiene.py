@@ -168,6 +168,79 @@ def test_no_raw_binary_reads_in_checkpointing_modules():
     )
 
 
+_STAMP_TOKENS = ("stamp", "beat", "timestamp", "heartbeat")
+
+
+def _target_names(node) -> list:
+    """Flatten an assignment target into its name/attr identifier chain."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+    return out
+
+
+def _calls_wall_clock(expr) -> bool:
+    """True when the expression contains a ``time.time()`` /
+    ``time.time_ns()`` call."""
+    for sub in ast.walk(expr):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("time", "time_ns")
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "time"
+        ):
+            return True
+    return False
+
+
+def test_no_raw_wall_clock_stamps_outside_quorum():
+    """Liveness stamps must derive from ``ops/quorum.py``'s clock helpers
+    (``now_stamp_ns`` / ``wall_time_s``): a raw ``time.time()``-derived
+    stamp re-decides the epoch/fold/clock-domain contract locally, and one
+    site drifting (ms vs ns, wall vs monotonic, unfolded epoch) breaks the
+    wrap-safe age math every detector shares — the exact bug class the
+    ns-scale stamp rebuild exists to close.  AST-based like the other
+    bans: any assignment whose target names a stamp (``*stamp*``,
+    ``*beat*``, ``*timestamp*``, ``*heartbeat*``) from a
+    ``time.time()``/``time.time_ns()``-containing expression is an
+    offender outside the allowlist."""
+    allowlist = {
+        # the single home of the stamp/clock contract
+        "tpu_resiliency/ops/quorum.py",
+    }
+    offenders = []
+    for rel, path in _library_sources():
+        if rel in allowlist:
+            continue
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                names = []
+                for t in targets:
+                    names.extend(_target_names(t))
+                if not any(
+                    tok in name.lower() for name in names
+                    for tok in _STAMP_TOKENS
+                ):
+                    continue
+                if node.value is not None and _calls_wall_clock(node.value):
+                    offenders.append(f"{rel}:{node.lineno}")
+    assert not offenders, (
+        f"raw time.time()-derived stamps outside ops/quorum.py (use "
+        f"quorum.now_stamp_ns / quorum.wall_time_s so the epoch and "
+        f"clock-domain contract has one home): {offenders}"
+    )
+
+
 def _range_references_world_size(call: ast.Call) -> bool:
     """True when ``call`` is ``range(...)`` with an argument mentioning
     ``world_size`` (a Name, an Attribute like ``self.world_size``, or any

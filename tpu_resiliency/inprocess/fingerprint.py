@@ -35,6 +35,8 @@ from __future__ import annotations
 import struct
 import threading
 import time
+
+from ..ops.quorum import now_stamp_ns
 from typing import Dict, List, Optional
 
 from ..utils.logging import get_logger
@@ -110,7 +112,7 @@ class DispatchTail:
         """Record one dispatched program (called at dispatch, before any
         block).  ~µs: two packs and a slot copy."""
         if stamp_ms is None:
-            stamp_ms = int(time.time() * 1000)
+            stamp_ms = now_stamp_ns() // 1_000_000
         raw = name.encode(errors="replace")[: NAME_LEN - 1]
         with self._lock:
             seq = self._seq + 1
@@ -125,7 +127,7 @@ class DispatchTail:
     def snapshot(self, now_ms: Optional[int] = None) -> List[dict]:
         """Entries oldest→newest: ``[{"op", "age_ms", "seq"}, ...]``."""
         if now_ms is None:
-            now_ms = int(time.time() * 1000)
+            now_ms = now_stamp_ns() // 1_000_000
         out = []
         for i in range(self.capacity):
             off = HEADER_SIZE + i * ENTRY_SIZE

@@ -107,7 +107,9 @@ class MonitorSharedState:
     @classmethod
     def create(cls) -> "MonitorSharedState":
         state = cls(create_shm(cls.SIZE), owner=True)
-        state.timestamp_slot.value = time.time()
+        from ..ops.quorum import wall_time_s
+
+        state.timestamp_slot.value = wall_time_s()
         state._enabled.value = 1
         return state
 

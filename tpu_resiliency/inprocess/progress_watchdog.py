@@ -25,8 +25,8 @@ from __future__ import annotations
 import ctypes
 import multiprocessing as mp
 import threading
-import time
 
+from ..ops.quorum import StampTripwire, wall_time_s
 from ..utils.logging import get_logger
 
 log = get_logger("progress_watchdog")
@@ -65,9 +65,13 @@ class ProgressWatchdog:
         # stamps without fork/pickling; default stays process-local.
         if timestamp_slot is not None:
             self.timestamp = timestamp_slot
-            self.timestamp.value = time.time()
+            self.timestamp.value = wall_time_s()
         else:
-            self.timestamp = mp.Value("d", time.time(), lock=False)
+            self.timestamp = mp.Value("d", wall_time_s(), lock=False)
+        # event-driven liveness feed: every stamp (manual ping or a consumed
+        # pending call) sets the event, so a StampTripwire can park on it
+        # instead of polling ``age()`` — see :meth:`watch_stale`
+        self.beat_event = threading.Event()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # keep the callback object alive (ctypes would GC it)
@@ -97,7 +101,8 @@ class ProgressWatchdog:
         # eval loop's error state (SystemError leaks into user code).  The
         # monitor re-raises on a backoff until the raise lands in user code.
         try:
-            self.timestamp.value = time.time()
+            self.timestamp.value = wall_time_s()
+            self.beat_event.set()
             self._pending_scheduled.clear()
         except BaseException:  # noqa: BLE001
             pass
@@ -125,10 +130,28 @@ class ProgressWatchdog:
 
     def ping(self) -> None:
         """Manual liveness signal from the training loop."""
-        self.timestamp.value = time.time()
+        self.timestamp.value = wall_time_s()
+        self.beat_event.set()
 
     def age(self) -> float:
-        return time.time() - self.timestamp.value
+        return wall_time_s() - self.timestamp.value
+
+    def watch_stale(self, budget_s: float, on_stale) -> StampTripwire:
+        """Event-driven GIL-liveness tripwire on this watchdog's stamps.
+
+        Parks a :class:`~tpu_resiliency.ops.quorum.StampTripwire` on
+        ``beat_event`` — the native pending-call stamper proves the MAIN
+        thread still reaches bytecode boundaries, so a timeout here is the
+        GIL-wedge class the native beater deliberately cannot see.  The
+        waiter observes staleness at wake latency (no polling read of
+        ``age()``); ``on_stale(age_ms)`` fires from the watcher thread.
+        Caller owns ``.stop()``."""
+        return StampTripwire(
+            on_stale=on_stale,
+            budget_ms=budget_s * 1e3,
+            event=self.beat_event,
+            age_ns_fn=lambda: max(0, int(self.age() * 1e9)),
+        ).start()
 
     def start(self) -> "ProgressWatchdog":
         self.ping()

@@ -76,6 +76,7 @@ class QuorumTripwire:
         use_pallas: Optional[bool] = None,
         fetch_workers: int = 0,
         native_beat: bool = False,
+        futex_tripwire: bool = False,
         on_trip: Optional[Callable[[int, int], None]] = None,
     ):
         self.mesh = mesh
@@ -97,6 +98,10 @@ class QuorumTripwire:
             use_pallas=use_pallas,
             fetch_workers=fetch_workers,
             native_beat=native_beat,
+            # event/futex wait on the local beat stream: a local stamp
+            # freeze is observed at wake latency and recorded through the
+            # same interruption path, without waiting for a collective round
+            futex_tripwire=futex_tripwire,
             identify=True,
             # pre-start calibration can only sample an idle interpreter;
             # after 256 in-vivo healthy ticks under the real workload the
@@ -146,7 +151,7 @@ class QuorumTripwire:
         stale_rank = device_owner_rank(self.mesh, device_idx)
         self.trip_time = time.monotonic()
         log.error(
-            "quorum tripwire: heartbeat stale by %dms (device %s, rank %s) "
+            "quorum tripwire: heartbeat stale by %.3fms (device %s, rank %s) "
             "at iteration %s — recording interruption",
             age_ms, device_idx, stale_rank, it,
         )
@@ -162,7 +167,7 @@ class QuorumTripwire:
                 InterruptionRecord(
                     rank=stale_rank,
                     interruption=Interruption.QUORUM_STALE,
-                    message=f"ICI quorum: heartbeat stale {age_ms}ms "
+                    message=f"ICI quorum: heartbeat stale {age_ms:.3f}ms "
                             f"(device {device_idx})",
                     origin_rank=self.rank,
                 ),
@@ -204,7 +209,7 @@ def quorum_restart_requester(client, min_interval_s: float = 5.0) -> Callable:
         try:
             client.send_workload_control_request(
                 WorkloadAction.RestartWorkload,
-                reason=f"ICI quorum: heartbeat stale {age_ms}ms (rank {stale})",
+                reason=f"ICI quorum: heartbeat stale {age_ms:.3f}ms (rank {stale})",
             )
         except Exception:  # noqa: BLE001 - detection must not kill the detector
             log.exception("failed sending quorum restart request")

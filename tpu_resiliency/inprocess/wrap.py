@@ -107,6 +107,9 @@ class Wrapper:
         # letting low-jitter hosts detect in ~3ms instead of flooring at 5
         quorum_min_budget_ms: float = 2.0,
         quorum_native_beat: bool = False,
+        # event/futex-wait local tripwire on the beat stream (sub-ms local
+        # staleness at wake latency; the collective stays the pod-wide path)
+        quorum_futex_tripwire: bool = False,
         # at-abort fingerprint gather budget before the restart proceeds
         # (0 disables the verdict log; publication still happens)
         fingerprint_wait: float = 1.0,
@@ -140,6 +143,7 @@ class Wrapper:
         self.quorum_interval = quorum_interval
         self.quorum_auto_beat_interval = quorum_auto_beat_interval
         self.quorum_native_beat = quorum_native_beat
+        self.quorum_futex_tripwire = quorum_futex_tripwire
         self.quorum_calibrate = quorum_calibrate
         self.fingerprint_wait = fingerprint_wait
 
@@ -314,6 +318,7 @@ class CallWrapper:
                 interval=w.quorum_interval,
                 auto_beat_interval=w.quorum_auto_beat_interval,
                 native_beat=w.quorum_native_beat,
+                futex_tripwire=w.quorum_futex_tripwire,
                 calibrate=w.quorum_calibrate,
                 min_budget_ms=w.quorum_min_budget_ms,
             ).start(state.iteration)

@@ -26,7 +26,16 @@ NATIVE_DIR = os.path.abspath(
 _cache: dict = {}
 
 
-def _build(src: str, path: str, extra_args: tuple = ()) -> None:
+def _build_and_load(src: str, path: str, extra_args: tuple,
+                    try_load) -> "ctypes.CDLL":
+    """Compile to a process-unique temp path, dlopen THAT path, then
+    atomically publish to ``path`` for future processes.
+
+    Loading the temp path (not the final one) is load-bearing: glibc
+    dedupes dlopen by pathname, so once a stale .so has been opened at
+    ``path`` in this process, re-opening ``path`` returns the OLD mapping
+    even after an os.replace — the rebuilt library would be unreachable
+    and the required-symbol staleness forcing would silently fail."""
     tmp = f"{path}.build.{os.getpid()}"
     cc = os.environ.get("CC", "cc")
     try:
@@ -35,7 +44,9 @@ def _build(src: str, path: str, extra_args: tuple = ()) -> None:
              *extra_args],
             check=True, capture_output=True, text=True, timeout=60,
         )
+        lib = try_load(tmp)
         os.replace(tmp, path)
+        return lib
     finally:
         try:
             os.unlink(tmp)
@@ -55,8 +66,8 @@ def load_native(lib_name: str, src_name: str, extra_args: tuple = (),
     path = os.path.join(NATIVE_DIR, lib_name)
     src = os.path.join(NATIVE_DIR, src_name)
 
-    def _try_load():
-        loaded = ctypes.CDLL(path)
+    def _try_load(at_path):
+        loaded = ctypes.CDLL(at_path)
         for sym in required_symbols:
             if not hasattr(loaded, sym):
                 raise OSError(f"{lib_name} is stale: missing symbol {sym}")
@@ -64,11 +75,10 @@ def load_native(lib_name: str, src_name: str, extra_args: tuple = (),
 
     lib = None
     try:
-        lib = _try_load()
+        lib = _try_load(path)
     except OSError:
         try:
-            _build(src, path, extra_args)
-            lib = _try_load()
+            lib = _build_and_load(src, path, extra_args, _try_load)
         except (OSError, subprocess.SubprocessError) as exc:
             log.info("native %s unavailable (%s); callers fall back to "
                      "pure Python", lib_name, exc)
