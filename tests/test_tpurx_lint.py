@@ -1,0 +1,541 @@
+"""tpurx-lint framework tests: per-rule firing/passing fixtures, suppression
+discipline, baseline round-trip, and the tier-1 repo gate.
+
+Fixture snippets are written into a throwaway tree mirroring the repo layout
+(`<tmp>/tpu_resiliency/...`) because every rule scopes by repo-relative path.
+"""
+
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+from tpurx_lint import run_lint
+from tpurx_lint.baseline import Baseline
+from tpurx_lint.registry import all_rules
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def lint_snippet(tmp_path, rel, code, rule=None, extra_files=()):
+    """Write `code` at `<tmp>/<rel>` and lint it; returns finding list."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    for erel, ecode in extra_files:
+        epath = tmp_path / erel
+        epath.parent.mkdir(parents=True, exist_ok=True)
+        epath.write_text(textwrap.dedent(ecode))
+    result = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                      use_baseline=False,
+                      rule_ids=[rule] if rule else None)
+    return result.findings
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# rule registry basics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_ten_rules_with_stable_ids(self):
+        ids = [r.rule_id for r in all_rules()]
+        assert ids == [f"TPURX{n:03d}" for n in range(1, 11)]
+
+    def test_every_rule_documents_itself(self):
+        for r in all_rules():
+            assert r.name and r.rationale and r.scope, r.rule_id
+
+
+# ---------------------------------------------------------------------------
+# migrated bans (TPURX001-004): one firing + one passing case each
+# ---------------------------------------------------------------------------
+
+class TestBarePrint:
+    def test_fires(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py",
+                          "print('hi')\n", rule="TPURX001")
+        assert rules_of(fs) == {"TPURX001"}
+
+    def test_passes_logger_and_out_of_scope(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py",
+                                "import logging\nlogging.info('hi')\n",
+                                rule="TPURX001")
+        # scripts outside the library may print
+        assert not lint_snippet(tmp_path, "benchmarks/x.py", "print('hi')\n",
+                                rule="TPURX001")
+
+
+class TestRawCkptRead:
+    def test_fires_on_rb_open_and_os_pread(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/checkpointing/x.py", """
+            import os
+            def f(p, fd):
+                with open(p, "rb") as fh:
+                    fh.read()
+                os.pread(fd, 10, 0)
+        """, rule="TPURX002")
+        assert len(fs) == 2
+
+    def test_passes_in_integrity_and_write_mode(self, tmp_path):
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/checkpointing/integrity.py",
+            'x = open("p", "rb")\n', rule="TPURX002")
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/checkpointing/x.py",
+            'x = open("p", "wb")\n', rule="TPURX002")
+
+
+class TestWallClockStamp:
+    def test_fires(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+            last_heartbeat = time.time()
+        """, rule="TPURX003")
+        assert rules_of(fs) == {"TPURX003"}
+
+    def test_passes_non_stamp_and_quorum_home(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py",
+                                "import time\nstarted = time.time()\n",
+                                rule="TPURX003")
+        assert not lint_snippet(tmp_path, "tpu_resiliency/ops/quorum.py",
+                                "import time\nstamp = time.time()\n",
+                                rule="TPURX003")
+
+
+class TestFlatGather:
+    def test_fires_on_loop_and_multiget(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(store, world_size):
+                out = [store.get(f"k/{r}") for r in range(2)]
+                for r in range(world_size):
+                    out.append(store.try_get(f"k/{r}"))
+                store.multi_get([f"k/{r}" for r in range(world_size)])
+                return out
+        """, rule="TPURX004")
+        assert len(fs) == 2  # loop-read + multi_get comprehension
+
+    def test_passes_in_tree_helper(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/store/tree.py", """
+            def f(store, world_size):
+                return [store.get(f"k/{r}") for r in range(world_size)]
+        """, rule="TPURX004")
+
+
+# ---------------------------------------------------------------------------
+# deep checkers (TPURX005-010)
+# ---------------------------------------------------------------------------
+
+class TestDeadlineDiscipline:
+    def test_fires_on_unbounded_waits(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import subprocess
+            def f(ev, t, proc):
+                ev.wait()
+                t.join()
+                proc.communicate()
+                subprocess.run(["x"])
+        """, rule="TPURX005")
+        assert len(fs) == 4
+
+    def test_passes_with_bounds(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import asyncio
+            import subprocess
+            async def f(ev, t, proc, timeout):
+                ev.wait(5.0)
+                ev.wait(timeout=timeout)
+                t.join(timeout=30)
+                proc.communicate(timeout=10)
+                subprocess.run(["x"], timeout=60)
+                ",".join(["a", "b"])          # str.join has an argument
+                await asyncio.wait_for(ev.wait(), timeout=1.0)
+        """, rule="TPURX005")
+
+    def test_timeout_none_is_unbounded(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py",
+                          "def f(ev):\n    ev.wait(timeout=None)\n",
+                          rule="TPURX005")
+        assert len(fs) == 1
+
+
+class TestAbortPathSafety:
+    def test_fires_in_abort_stage_and_signal_handler(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/inprocess/x.py", """
+            import signal
+            import threading
+
+            class AbortStage:
+                pass
+
+            class MyStage(AbortStage):
+                def release(self, state=None):
+                    self._helper()
+
+                def _helper(self):
+                    threading.Thread(target=print).start()
+
+            def _handler(signum, frame):
+                import subprocess
+                subprocess.run(["cleanup"])
+
+            signal.signal(signal.SIGTERM, _handler)
+        """, rule="TPURX006")
+        msgs = [f.message for f in fs]
+        assert any("thread spawned" in m for m in msgs)
+        assert any("signal handler" in m for m in msgs)
+
+    def test_passes_bounded_stage(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/inprocess/x.py", """
+            class AbortStage:
+                pass
+
+            class MyStage(AbortStage):
+                def release(self, state=None):
+                    state.proc.wait(timeout=5.0)
+        """, rule="TPURX006")
+
+
+class TestRetryDiscipline:
+    def test_fires_on_hand_rolled_loop(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+            def f(connect):
+                while True:
+                    try:
+                        return connect()
+                    except OSError:
+                        time.sleep(1.0)
+        """, rule="TPURX007")
+        assert rules_of(fs) == {"TPURX007"}
+
+    def test_passes_poll_loop_and_retry_home(self, tmp_path):
+        # a forever poll loop (no success escape in the try) is not a retry
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+            def monitor(tick):
+                while True:
+                    try:
+                        tick()
+                    except OSError:
+                        pass
+                    time.sleep(1.0)
+        """, rule="TPURX007")
+        assert not lint_snippet(tmp_path, "tpu_resiliency/utils/retry.py", """
+            import time
+            def f(connect):
+                while True:
+                    try:
+                        return connect()
+                    except OSError:
+                        time.sleep(1.0)
+        """, rule="TPURX007")
+
+
+class TestThreadLifecycle:
+    def test_fires_on_leaked_thread(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+            def f():
+                t = threading.Thread(target=print)
+                t.start()
+        """, rule="TPURX008")
+        assert rules_of(fs) == {"TPURX008"}
+
+    def test_passes_daemon_or_joined(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+            def f():
+                threading.Thread(target=print, daemon=True).start()
+                t = threading.Thread(target=print)
+                t.start()
+                t.join(timeout=5.0)
+        """, rule="TPURX008")
+
+    def test_guarded_by_fires_outside_lock(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0  # guarded-by: _lock
+
+                def bump(self):
+                    self._n += 1
+        """, rule="TPURX008")
+        assert any("guarded-by" in f.message for f in fs)
+
+    def test_guarded_by_passes_under_lock(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0  # guarded-by: _lock
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+        """, rule="TPURX008")
+
+
+class TestExceptionHygiene:
+    def test_fires_on_swallow_and_bare(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/inprocess/x.py", """
+            def f(g):
+                try:
+                    g()
+                except Exception:
+                    pass
+                try:
+                    g()
+                except:
+                    raise
+        """, rule="TPURX009")
+        assert len(fs) == 2
+
+    def test_passes_narrow_or_logged(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/inprocess/x.py", """
+            import logging
+            def f(g):
+                try:
+                    g()
+                except OSError:
+                    pass
+                try:
+                    g()
+                except Exception as exc:
+                    logging.warning("failed: %r", exc)
+        """, rule="TPURX009")
+
+    def test_swallow_allowed_outside_fault_tree(self, tmp_path):
+        # integrations/ is not a fault-handling tree; only bare except fires
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/integrations/x.py",
+            "def f(g):\n    try:\n        g()\n    except Exception:\n        pass\n",
+            rule="TPURX009")
+
+
+_ENV_FIXTURE = [
+    ("tpu_resiliency/utils/env.py", """
+        class Knob:
+            def __init__(self, name, type, default, doc):
+                self.name = name
+        FOO = Knob("TPURX_FOO", int, 1, "doc")
+    """),
+    ("docs/configuration.md", "| `TPURX_FOO` | int | `1` | doc |\n"),
+]
+
+
+class TestEnvRegistry:
+    def test_fires_on_raw_read(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import os
+            x = os.environ.get("TPURX_FOO", "1")
+            y = os.getenv("TPURX_BAR")
+            z = os.environ["TPURX_BAZ"]
+            present = "TPURX_QUX" in os.environ
+        """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+        assert len(fs) == 4
+
+    def test_resolves_env_constant_idiom(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import os
+            ENV_FOO = "TPURX_FOO"
+            x = os.environ.get(ENV_FOO)
+        """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+        assert len(fs) == 1
+
+    def test_passes_registry_read_and_non_tpurx(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import os
+            from .utils import env
+            x = env.FOO.get()
+            home = os.environ.get("HOME")
+        """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+
+    def test_undocumented_knob_fails(self, tmp_path):
+        fs = lint_snippet(
+            tmp_path, "tpu_resiliency/utils/env.py", """
+                class Knob:
+                    def __init__(self, name, type, default, doc):
+                        self.name = name
+                FOO = Knob("TPURX_FOO", int, 1, "doc")
+                BAR = Knob("TPURX_BAR", int, 2, "doc")
+            """, rule="TPURX010",
+            extra_files=[("docs/configuration.md", "only `TPURX_FOO` here\n")])
+        assert any("TPURX_BAR" in f.message and "not documented" in f.message
+                   for f in fs)
+
+    def test_duplicate_declaration_fails(self, tmp_path):
+        fs = lint_snippet(
+            tmp_path, "tpu_resiliency/utils/env.py", """
+                class Knob:
+                    def __init__(self, name, type, default, doc):
+                        self.name = name
+                A = Knob("TPURX_FOO", int, 1, "doc")
+                B = Knob("TPURX_FOO", int, 2, "doc")
+            """, rule="TPURX010",
+            extra_files=[("docs/configuration.md", "`TPURX_FOO`\n")])
+        assert any("declared more than once" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppressions:
+    def test_same_line_suppression_with_reason(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(ev):
+                ev.wait()  # tpurx: disable=TPURX005 -- sentinel always arrives
+        """, rule="TPURX005")
+
+    def test_comment_above_covers_next_line(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(ev):
+                # tpurx: disable=TPURX005 -- sentinel always arrives
+                ev.wait()
+        """, rule="TPURX005")
+
+    def test_suppression_without_reason_is_a_finding(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(ev):
+                ev.wait()  # tpurx: disable=TPURX005
+        """)
+        assert "TPURX900" in rules_of(fs)
+        # and the original finding is NOT suppressed by a reasonless directive
+        assert "TPURX005" in rules_of(fs)
+
+    def test_file_scope_suppression(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            # tpurx: disable-file=TPURX001 -- argparse CLI, stdout is the interface
+            print("usage: ...")
+            print("more")
+        """, rule="TPURX001")
+
+    def test_wrong_rule_suppression_does_not_mask(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(ev):
+                ev.wait()  # tpurx: disable=TPURX001 -- wrong rule entirely
+        """, rule="TPURX005")
+        assert rules_of(fs) == {"TPURX005"}
+
+    def test_malformed_rule_id_is_a_finding(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            x = 1  # tpurx: disable=NOTARULE -- whatever
+        """)
+        assert "TPURX900" in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _write_offender(self, tmp_path):
+        mod = tmp_path / "tpu_resiliency" / "mod.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text("def f(ev):\n    ev.wait()\n")
+        return mod
+
+    def test_round_trip(self, tmp_path):
+        self._write_offender(tmp_path)
+        result = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                          use_baseline=False, rule_ids=["TPURX005"])
+        assert len(result.findings) == 1
+
+        bpath = str(tmp_path / "baseline.json")
+        bl = Baseline.from_findings(result.findings, bpath)
+        for e in bl.entries:
+            e.justification = "grandfathered: pre-lint wait"
+        bl.save()
+        reloaded = Baseline.load(bpath)
+        assert [e.key() for e in reloaded.entries] == [e.key() for e in bl.entries]
+        assert not reloaded.unjustified()
+
+        gated = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                         baseline_path=bpath, rule_ids=["TPURX005"])
+        assert not gated.findings and len(gated.baselined) == 1
+
+    def test_baseline_keys_on_content_not_line_number(self, tmp_path):
+        mod = self._write_offender(tmp_path)
+        bpath = str(tmp_path / "baseline.json")
+        result = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                          use_baseline=False, rule_ids=["TPURX005"])
+        bl = Baseline.from_findings(result.findings, bpath)
+        for e in bl.entries:
+            e.justification = "grandfathered"
+        bl.save()
+        # unrelated edit above the offender moves its line number
+        mod.write_text("import os\n\n\ndef f(ev):\n    ev.wait()\n")
+        gated = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                         baseline_path=bpath, rule_ids=["TPURX005"])
+        assert not gated.findings and len(gated.baselined) == 1
+        # but editing the offending line itself resurfaces the finding
+        mod.write_text("def f(ev):\n    ev.wait()  # now touched\n")
+        gated = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                         baseline_path=bpath, rule_ids=["TPURX005"])
+        assert len(gated.findings) == 1
+
+    def test_unjustified_and_stale_entries_reported(self, tmp_path):
+        self._write_offender(tmp_path)
+        bpath = str(tmp_path / "baseline.json")
+        with open(bpath, "w") as f:
+            json.dump({"entries": [
+                {"rule": "TPURX005", "path": "tpu_resiliency/mod.py",
+                 "symbol": "ev.wait()", "justification": ""},
+                {"rule": "TPURX005", "path": "tpu_resiliency/gone.py",
+                 "symbol": "ev.wait()", "justification": "was removed"},
+            ]}, f)
+        result = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                          baseline_path=bpath)
+        assert len(result.unjustified_baseline) == 1
+        assert len(result.stale_baseline) == 1
+
+
+# ---------------------------------------------------------------------------
+# the repo gate (tier-1): zero non-baselined findings, fast, clean baseline
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    @pytest.fixture(scope="class")
+    def repo_result(self):
+        t0 = time.monotonic()
+        result = run_lint(paths=["tpu_resiliency", "tests", "benchmarks"],
+                          root=REPO)
+        result.elapsed = time.monotonic() - t0
+        return result
+
+    def test_zero_non_baselined_findings(self, repo_result):
+        assert not repo_result.parse_errors, repo_result.parse_errors
+        assert not repo_result.findings, "\n".join(
+            f"{f.location()}: {f.rule} {f.message}" for f in repo_result.findings)
+
+    def test_baseline_entries_all_justified_and_live(self, repo_result):
+        assert not repo_result.unjustified_baseline, [
+            e.key() for e in repo_result.unjustified_baseline]
+        assert not repo_result.stale_baseline, [
+            e.key() for e in repo_result.stale_baseline]
+
+    def test_full_repo_lint_is_fast(self, repo_result):
+        # acceptance bound is 10s; leave slack for loaded CI hosts
+        assert repo_result.elapsed < 30.0, f"{repo_result.elapsed:.1f}s"
+
+    def test_cli_json_output(self):
+        import subprocess
+        import sys
+        out = subprocess.run(
+            [sys.executable, "-m", "tpurx_lint", "tpu_resiliency/",
+             "tests/", "benchmarks/", "--format=json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+        data = json.loads(out.stdout)
+        assert data["ok"] is True
+        assert data["findings"] == []

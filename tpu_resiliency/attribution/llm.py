@@ -23,14 +23,14 @@ Usage::
 from __future__ import annotations
 
 import json
-import os
 import re
-import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from ..utils import env
 from ..utils.logging import get_logger
+from ..utils.retry import Retrier, RetryExhausted, RetryPolicy
 
 log = get_logger("attribution.llm")
 
@@ -79,8 +79,9 @@ class LLMClient:
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
         url = f"{self.base_url}/chat/completions"
-        last_exc: Optional[Exception] = None
-        for attempt in range(self.max_retries + 1):
+        retrier = Retrier("llm_chat", RetryPolicy(
+            max_attempts=self.max_retries + 1, base_delay=0.5, max_delay=2.0))
+        while True:
             try:
                 req = urllib.request.Request(url, data=payload, headers=headers)
                 with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
@@ -91,15 +92,19 @@ class LLMClient:
                     # misconfiguration (bad key/model/path) — retrying only
                     # adds dead time to every attribution and hides the status
                     raise LLMError(f"HTTP {exc.code} from {url}: {exc.reason}")
-                last_exc = exc
-                if attempt < self.max_retries:
-                    time.sleep(0.5 * (attempt + 1))
+                self._backoff(retrier, exc)
             except (urllib.error.URLError, OSError, KeyError, IndexError,
                     json.JSONDecodeError) as exc:
-                last_exc = exc
-                if attempt < self.max_retries:
-                    time.sleep(0.5 * (attempt + 1))
-        raise LLMError(f"chat completion failed after retries: {last_exc!r}")
+                self._backoff(retrier, exc)
+
+    @staticmethod
+    def _backoff(retrier: Retrier, exc: Exception) -> None:
+        try:
+            retrier.backoff(exc)
+        except RetryExhausted as spent:
+            raise LLMError(
+                f"chat completion failed after retries: {spent.last_exc!r}"
+            ) from exc
 
     def __call__(self, prompt: str) -> str:
         return self.chat(
@@ -112,14 +117,14 @@ class LLMClient:
 
 def llm_from_env() -> Optional[LLMClient]:
     """Build the client from ``TPURX_LLM_*`` env; None when unconfigured."""
-    base_url = os.environ.get("TPURX_LLM_BASE_URL", "").strip()
+    base_url = env.LLM_BASE_URL.get().strip()
     if not base_url:
         return None
     return LLMClient(
         base_url=base_url,
-        api_key=os.environ.get("TPURX_LLM_API_KEY", ""),
-        model=os.environ.get("TPURX_LLM_MODEL", "default"),
-        timeout_s=float(os.environ.get("TPURX_LLM_TIMEOUT_S", "30")),
+        api_key=env.LLM_API_KEY.get(),
+        model=env.LLM_MODEL.get(),
+        timeout_s=env.LLM_TIMEOUT_S.get(),
     )
 
 

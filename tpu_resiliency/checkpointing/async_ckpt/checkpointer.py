@@ -48,6 +48,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ...telemetry import counter, gauge, histogram
+from ...utils import env
 from ...utils.logging import get_logger
 from .core import (  # noqa: F401 - CheckpointSaveError re-exported for callers
     AsyncCallsQueue,
@@ -272,8 +273,8 @@ class AsyncCheckpointer:
                     if isinstance(leaf, jax.Array):
                         platform = list(leaf.devices())[0].platform
                         break
-            except Exception:  # noqa: BLE001 - host-only trees
-                pass
+            except (ImportError, AttributeError, IndexError, RuntimeError):
+                pass  # host-only trees / backend without device introspection
             self._resolved_stage_mode = "sync" if platform == "cpu" else "snapshot"
         return self._resolved_stage_mode
 
@@ -296,11 +297,12 @@ class AsyncCheckpointer:
             os.setpriority(
                 os.PRIO_PROCESS,
                 threading.get_native_id(),
-                int(os.environ.get("TPURX_CKPT_STAGER_NICE", "10")),
+                env.CKPT_STAGER_NICE.get(),
             )
         except (OSError, AttributeError, ValueError):
             pass
         while True:
+            # tpurx: disable=TPURX005 -- stager idles for jobs; close() enqueues the None sentinel
             job = self._stage_q.get()
             if job is None:
                 return

@@ -34,6 +34,7 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...store.client import StoreError
+from ...utils import env
 from ...store.protocol import itob
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
@@ -171,12 +172,12 @@ class _PipeWorker:
             self.proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             self.proc.kill()
-            self.proc.wait()
+            self.proc.wait()  # tpurx: disable=TPURX005 -- SIGKILL just sent; exit is kernel-guaranteed
 
     def kill(self) -> None:
         if self.alive:
             self.proc.kill()
-            self.proc.wait()
+            self.proc.wait()  # tpurx: disable=TPURX005 -- SIGKILL just sent; exit is kernel-guaranteed
 
 
 class StreamHandle:
@@ -519,7 +520,7 @@ def store_sync_fn(store, rank: int, world_size: int, namespace: Optional[str] = 
     a previous incarnation must never vouch for new calls.
     """
     if namespace is None:
-        namespace = f"ckpt/c{os.environ.get('TPURX_CYCLE', '0')}"
+        namespace = f"ckpt/c{env.CYCLE.get()}"
     last_published = -1
     # per-call poll bookkeeping for the healing scan: call_idx -> polls since
     # the last exact recount

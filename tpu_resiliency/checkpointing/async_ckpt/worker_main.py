@@ -48,6 +48,8 @@ import sys
 import threading
 import time
 
+from ...utils import env
+
 _U32 = struct.Struct("<I")
 
 _END = object()
@@ -69,8 +71,8 @@ def _set_io_priority() -> None:
     input pipeline (reference ``_set_process_qos`` io_priority analog,
     ``async_ckpt/core.py:41-110``).  Raw ``ioprio_set`` syscall — no
     dependency; unsupported arch/kernel is a silent no-op."""
-    klass = os.environ.get("TPURX_CKPT_WORKER_IONICE", "3")
-    if not klass:
+    klass = env.CKPT_WORKER_IONICE.get()
+    if klass < 0:  # negative disables
         return
     import ctypes
     import platform
@@ -81,7 +83,7 @@ def _set_io_priority() -> None:
     try:
         libc = ctypes.CDLL(None, use_errno=True)
         IOPRIO_WHO_PROCESS = 1
-        libc.syscall(syscall_nr, IOPRIO_WHO_PROCESS, 0, int(klass) << 13)
+        libc.syscall(syscall_nr, IOPRIO_WHO_PROCESS, 0, klass << 13)
     except (OSError, ValueError):
         pass
 
@@ -97,7 +99,7 @@ def main() -> None:
     # QoS: deprioritize CPU (nice) and I/O (ionice idle) so the drain yields
     # to the trainer on both resources
     try:
-        os.nice(int(os.environ.get("TPURX_CKPT_WORKER_NICE", "10")))
+        os.nice(env.CKPT_WORKER_NICE.get())
     except OSError:
         pass
     _set_io_priority()
@@ -128,6 +130,7 @@ def main() -> None:
             else:
                 def items():
                     while True:
+                        # tpurx: disable=TPURX005 -- stream feed queue; _END/_StreamAborted sentinel always closes it
                         got = item_q.get()
                         if got is _END:
                             return
@@ -190,7 +193,7 @@ def main() -> None:
         q.put(_StreamAborted("stream closed before completion (trainer exit)"))
     streams.clear()
     for t in threads:
-        t.join()
+        t.join()  # tpurx: disable=TPURX005 -- every stream just got the abort sentinel; bodies unwind finite local work
 
 
 if __name__ == "__main__":

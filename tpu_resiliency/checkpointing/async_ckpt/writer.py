@@ -65,6 +65,8 @@ import threading
 import time
 
 from ...telemetry import BYTE_BUCKETS, counter, gauge, histogram
+from ...utils import env as _envknobs
+from ...utils.logging import get_logger
 from ...utils.shm import attach_shm
 from ..coverage import contiguous_offset, covers
 from ..integrity import (
@@ -76,6 +78,8 @@ from ..integrity import (
     verify_chunk,
     verify_composed,
 )
+
+log = get_logger("ckpt_writer")
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -131,7 +135,7 @@ _RESTORE_THREADS = gauge(
 
 def default_chunk_bytes() -> int:
     try:
-        n = int(os.environ.get("TPURX_CKPT_CHUNK_BYTES", str(16 << 20)))
+        n = _envknobs.CKPT_CHUNK_BYTES.get()
     except ValueError:
         n = 16 << 20
     # chunk boundaries must stay O_DIRECT-aligned; floor to the alignment
@@ -155,11 +159,11 @@ def resolve_restore_threads(requested: Optional[int] = None) -> int:
     if requested:
         return max(1, int(requested))
     try:
-        env = int(os.environ.get("TPURX_CKPT_RESTORE_THREADS", "0"))
+        n = _envknobs.CKPT_RESTORE_THREADS.get()
     except ValueError:
-        env = 0
-    if env > 0:
-        return env
+        n = 0
+    if n > 0:
+        return n
     return resolve_write_threads(None)
 
 
@@ -283,8 +287,8 @@ class _ShardSink:
         if shm is not None:
             try:
                 shm.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except (OSError, BufferError):
+                pass  # exported buffer views can outlive the drain
 
 
 class _WriteEngine:
@@ -309,9 +313,9 @@ class _WriteEngine:
         self.plan_sig = plan_sig
         self.chunk_bytes = chunk_bytes or default_chunk_bytes()
         if digest is None:
-            digest = os.environ.get("TPURX_CKPT_DIGEST", "1") != "0"
+            digest = _envknobs.CKPT_DIGEST.get()
         self.digest = digest
-        self.use_direct = os.environ.get("TPURX_CKPT_DIRECT_IO", "1") != "0"
+        self.use_direct = _envknobs.CKPT_DIRECT_IO.get()
         self.pdir = os.path.join(ckpt_dir, f"process_{process_index}")
         os.makedirs(self.pdir, exist_ok=True)
         self._progress_cb = progress_cb
@@ -324,7 +328,7 @@ class _WriteEngine:
         self._cv = threading.Condition()
         # log2-size buckets of (sink, off, length); threads drain largest-first
         self._buckets: Dict[int, collections.deque] = {}
-        self._pending_chunks = 0
+        self._pending_chunks = 0  # guarded-by: _cv
         self._closed = False
         self._error: Optional[BaseException] = None
         self._threads = [
@@ -504,8 +508,8 @@ class _WriteEngine:
             total = sum(s.nbytes for s in self._sinks)
         try:
             self._progress_cb(self.bytes_written, total)
-        except Exception:  # noqa: BLE001 - progress is best-effort
-            pass
+        except Exception as exc:  # noqa: BLE001 - progress is best-effort
+            log.debug("progress callback failed: %r", exc)
 
 
 def write_process_shards(
@@ -806,7 +810,7 @@ class _RestoreEngine:
         )
         self._cv = threading.Condition()
         self._buckets: Dict[int, collections.deque] = {}
-        self._pending = 0
+        self._pending = 0  # guarded-by: _cv
         self._error: Optional[BaseException] = None
         self._t0_ns = time.monotonic_ns()
         self.bytes_read = 0

@@ -58,6 +58,7 @@ import zlib
 from typing import List, Optional, Sequence, Tuple, Union
 
 from ..telemetry import counter, histogram
+from ..utils import env
 from ..utils.logging import get_logger
 
 log = get_logger("ckpt.integrity")
@@ -309,7 +310,7 @@ class ChunkReader:
         self.site = site
         self.name = os.path.basename(path)
         if direct is None:
-            direct = os.environ.get("TPURX_CKPT_DIRECT_IO", "1") != "0"
+            direct = env.CKPT_DIRECT_IO.get()
         self._want_direct = direct
         self._fd_buf = -1
         self._fd_direct = -1

@@ -57,6 +57,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ...store.tree import combine_json_merge, tree_gather
 from ...telemetry import counter, gauge
+from ...utils import env as _envknobs
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
 from ..async_ckpt.writer import resolve_restore_threads
@@ -130,8 +131,7 @@ class LocalCheckpointManager:
         self._scrubber: Optional[threading.Thread] = None
         self._scrub_stop = threading.Event()
         if scrub_interval is None:
-            env = os.environ.get("TPURX_CKPT_SCRUB_INTERVAL", "")
-            scrub_interval = float(env) if env else None
+            scrub_interval = _envknobs.CKPT_SCRUB_INTERVAL.get()
         if scrub_interval:
             self.start_scrubber(scrub_interval)
 

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...utils import env
 from ...utils.logging import get_logger
 from ...utils.retry import RetryPolicy, Retrier
 
@@ -81,9 +82,7 @@ class PeerExchange:
         loopback on stock Debian (/etc/hosts 127.0.1.1) — instead take the
         source address of the route toward the store host, which is exactly
         the interface peers share with us.  Env TPURX_PEER_ADDR overrides."""
-        import os
-
-        override = os.environ.get("TPURX_PEER_ADDR")
+        override = env.PEER_ADDR.get()
         if override:
             return override
         target = getattr(self.store, "host", None) or getattr(

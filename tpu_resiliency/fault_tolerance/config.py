@@ -13,10 +13,11 @@ probe; NUMA binding kept (TPU hosts are NUMA machines too).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Any, Dict, Mapping, Optional
 
 import yaml
+
+from ..utils import env as _env
 
 
 @dataclasses.dataclass
@@ -94,7 +95,7 @@ class FaultToleranceConfig:
     # --- timeouts persistence ---
     state_dict_path: Optional[str] = None
 
-    ENV_PREFIX = "TPURX_FT_"
+    ENV_PREFIX = _env.FT_OVERRIDES.prefix
 
     @classmethod
     def field_names(cls) -> list[str]:
@@ -141,7 +142,7 @@ class FaultToleranceConfig:
         """TPURX_FT_<UPPER_FIELD> env overrides (highest precedence)."""
         overrides: Dict[str, Any] = {}
         for f in dataclasses.fields(self):
-            env_val = os.environ.get(self.ENV_PREFIX + f.name.upper())
+            env_val = _env.FT_OVERRIDES.raw(f.name)
             if env_val is None:
                 continue
             overrides[f.name] = _coerce(env_val, f.type)

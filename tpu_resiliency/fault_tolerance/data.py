@@ -15,6 +15,8 @@ import json
 import math
 from typing import Any, Dict, Optional
 
+from ..utils import env as _env
+
 
 @dataclasses.dataclass
 class RankInfo:
@@ -29,10 +31,8 @@ class RankInfo:
         import socket
 
         return cls(
-            global_rank=int(os.environ.get("TPURX_RANK", os.environ.get("RANK", "0"))),
-            local_rank=int(
-                os.environ.get("TPURX_LOCAL_RANK", os.environ.get("LOCAL_RANK", "0"))
-            ),
+            global_rank=_env.RANK.get(),
+            local_rank=_env.LOCAL_RANK.get(),
             host=socket.gethostname(),
             pid=os.getpid(),
         )

@@ -13,19 +13,19 @@ exercise hot-spare replacement without real hardware faults.
 
 from __future__ import annotations
 
-import os
 
+from ..utils import env
 from ..utils.logging import get_logger
 from .config import FaultToleranceConfig
 from .rendezvous import UnhealthyNodeError
 
 log = get_logger("health_gate")
 
-ENV_INJECT = "TPURX_INJECT_NODE_FAILURE"
+ENV_INJECT = env.INJECT_NODE_FAILURE.name
 
 
 def _injected_failure(node_id: str, current_cycle: int) -> bool:
-    spec = os.environ.get(ENV_INJECT)
+    spec = env.INJECT_NODE_FAILURE.get()
     if not spec:
         return False
     try:

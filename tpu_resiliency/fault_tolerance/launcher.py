@@ -38,6 +38,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from ..store import StoreClient, StoreError, StoreServer
+from ..utils import env
 from ..utils.ipc import IpcConnector
 from ..utils.logging import get_logger, setup_logger
 from ..utils.profiling import ProfilingEvent, get_recorder, record_event
@@ -197,7 +198,7 @@ class ElasticAgent:
 
     def _setup_store(self) -> None:
         if self.host_store:
-            if os.environ.get("TPURX_NATIVE_STORE", "").lower() in ("1", "true", "yes"):
+            if env.NATIVE_STORE.get():
                 from ..store.native import NativeStoreServer
 
                 self._store_server = NativeStoreServer(
@@ -362,7 +363,7 @@ class ElasticAgent:
             except (ProcessLookupError, PermissionError):
                 pass
             if w.proc.poll() is None:
-                w.proc.wait()
+                w.proc.wait()  # tpurx: disable=TPURX005 -- process group was just SIGKILLed; exit is kernel-guaranteed
         record_event(ProfilingEvent.WORKER_STOPPED)
         self.workers = []
 
@@ -492,6 +493,7 @@ class ElasticAgent:
     def _monitor_until_event(self, result: RendezvousResult) -> str:
         """Hot loop (reference ``launcher.py:629-697``). Returns outcome."""
         store_down_since: Optional[float] = None
+        # tpurx: disable=TPURX007 -- outage ride-out, not a retry: the window resets when the store recovers and the verdict depends on live worker status
         while True:
             try:
                 outcome = self._monitor_tick(result)

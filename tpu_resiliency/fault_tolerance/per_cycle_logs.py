@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Dict, IO, Optional, Tuple
 
+from ..utils import env
 from ..utils.logging import get_logger
 
 log = get_logger("per_cycle_logs")
@@ -48,7 +49,7 @@ class CycleLogRouter:
         self._file_lock = threading.Lock()
         self._readers: Dict[Tuple[int, str], threading.Thread] = {}
         self._funnel = None
-        funnel = os.environ.get("TPURX_LOG_FUNNEL")
+        funnel = env.LOG_FUNNEL.get()
         if funnel:
             # stream worker lines into the cluster log funnel as well
             try:

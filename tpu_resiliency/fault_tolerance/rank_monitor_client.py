@@ -15,13 +15,13 @@ a training step.
 from __future__ import annotations
 
 import contextlib
-import os
 import socket
 import threading
 import time
 from typing import Any, Dict, Optional
 
 from ..telemetry import counter, histogram
+from ..utils import env
 from ..utils.ipc import recv_msg, send_msg
 from ..utils.logging import get_logger
 from .config import FaultToleranceConfig
@@ -54,8 +54,8 @@ _SECTION_NS = histogram(
     "Section start/end message latency over the monitor UDS",
 )
 
-ENV_MONITOR_SOCKET = "TPURX_RANK_MONITOR_SOCKET"
-ENV_LAUNCHER_IPC_SOCKET = "TPURX_LAUNCHER_IPC_SOCKET"
+ENV_MONITOR_SOCKET = env.RANK_MONITOR_SOCKET.name
+ENV_LAUNCHER_IPC_SOCKET = env.LAUNCHER_IPC_SOCKET.name
 
 
 class RankMonitorClientError(RuntimeError):
@@ -81,7 +81,7 @@ class RankMonitorClient:
         rank_info: Optional[RankInfo] = None,
         op_ring_shm: Optional[str] = None,
     ) -> None:
-        path = socket_path or os.environ.get(ENV_MONITOR_SOCKET)
+        path = socket_path or env.RANK_MONITOR_SOCKET.get()
         if not path:
             raise RankMonitorClientError(
                 f"no monitor socket: set {ENV_MONITOR_SOCKET} or pass socket_path"
@@ -99,7 +99,7 @@ class RankMonitorClient:
         # straggler op-ring arena name: lets the monitor read this rank's
         # per-op stats POST-MORTEM while the trainer is wedged (the
         # CUPTI-buffers-outlive-the-launch property)
-        ring = op_ring_shm or os.environ.get("TPURX_OPRING_SHM")
+        ring = op_ring_shm or env.OPRING_SHM.get()
         if ring:
             init["op_ring_shm"] = ring
         if self._loaded_state:
@@ -272,7 +272,7 @@ class RankMonitorClient:
         (reference ``WorkloadControlRequest``, ``data.py:272``)."""
         from ..utils.ipc import IpcConnector
 
-        path = os.environ.get(ENV_LAUNCHER_IPC_SOCKET)
+        path = env.LAUNCHER_IPC_SOCKET.get()
         if not path:
             raise RankMonitorClientError(f"{ENV_LAUNCHER_IPC_SOCKET} not set")
         req = WorkloadControlRequest(action=action, reason=reason)

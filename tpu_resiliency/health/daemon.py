@@ -20,15 +20,15 @@ check passes with a note (the daemon is optional infrastructure), unless
 from __future__ import annotations
 
 import json
-import os
 import socket
 from typing import Optional
 
 from ..telemetry import counter
+from ..utils import env
 from ..utils.retry import PROBE_POLICY, RetryExhausted, retry_call
 from .base import HealthCheck, HealthCheckResult
 
-ENDPOINT_ENV = "TPURX_NODE_HEALTH_ENDPOINT"
+ENDPOINT_ENV = env.NODE_HEALTH_ENDPOINT.name
 
 _DAEMON_UNREACHABLE = counter(
     "tpurx_health_daemon_unreachable_total",
@@ -60,7 +60,7 @@ class NodeHealthDaemonCheck(HealthCheck):
         self.retry_policy = retry_policy
 
     def _resolve(self) -> Optional[str]:
-        return self.endpoint or os.environ.get(ENDPOINT_ENV) or None
+        return self.endpoint or env.NODE_HEALTH_ENDPOINT.get()
 
     def _connect(self, target: str) -> socket.socket:
         if target.startswith("unix://"):

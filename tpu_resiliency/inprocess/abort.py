@@ -44,12 +44,12 @@ Built-in rungs:
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 import time
 from typing import Callable, List, Optional
 
 from ..telemetry import counter, histogram
+from ..utils import env
 from ..utils.logging import get_logger
 
 log = get_logger("inproc.abort")
@@ -356,7 +356,7 @@ class ShrinkMeshStage(AbortStage):
                  timeout: Optional[float] = None):
         super().__init__(timeout)
         if enabled is None:
-            enabled = os.environ.get("TPURX_SHRINK_MESH", "0") == "1"
+            enabled = env.SHRINK_MESH.get()
         self.enabled = enabled
 
     def applicable(self, state=None) -> bool:
@@ -394,8 +394,8 @@ class ShrinkMeshStage(AbortStage):
             from ..parallel import distributed as dist_mod
 
             dist_mod._initialized = False
-        except Exception:  # noqa: BLE001 - helper is optional
-            pass
+        except (ImportError, AttributeError):
+            pass  # helper is optional
         return "; ".join(detail)
 
 

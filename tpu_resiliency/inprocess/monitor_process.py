@@ -193,7 +193,7 @@ def _endpoint_from_factory(store_factory) -> Optional[Tuple[str, int]]:
     finally:
         try:
             client.close()
-        except Exception:  # noqa: BLE001
+        except OSError:
             pass
     return None
 

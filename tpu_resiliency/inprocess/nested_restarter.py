@@ -50,8 +50,8 @@ class NestedRestarterCallback:
         if self.client is not None and self._section_open:
             try:
                 self.client.end_section(SECTION_NAME)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:  # noqa: BLE001
+                log.debug("end_section(%s) failed: %r", SECTION_NAME, exc)
             self._section_open = False
 
     # -- Wrapper plugin hooks ---------------------------------------------

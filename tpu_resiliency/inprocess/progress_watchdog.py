@@ -104,6 +104,7 @@ class ProgressWatchdog:
             self.timestamp.value = wall_time_s()
             self.beat_event.set()
             self._pending_scheduled.clear()
+        # tpurx: disable=TPURX009 -- ctypes pending-call callback: an escaping raise corrupts the eval loop error state
         except BaseException:  # noqa: BLE001
             pass
         return 0

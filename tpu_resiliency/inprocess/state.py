@@ -7,6 +7,8 @@ import enum
 import os
 from typing import Optional
 
+from ..utils import env
+
 
 class Mode(str, enum.Enum):
     INITIALIZED = "initialized"
@@ -39,11 +41,7 @@ class State:
 
     @classmethod
     def from_env(cls) -> "State":
-        rank = int(os.environ.get("TPURX_RANK", os.environ.get("RANK", "0")))
-        world = int(
-            os.environ.get("TPURX_WORLD_SIZE", os.environ.get("WORLD_SIZE", "1"))
-        )
-        return cls(rank=rank, world_size=world)
+        return cls(rank=env.RANK.get(), world_size=env.WORLD_SIZE.get())
 
     def set_distributed_vars(self) -> None:
         """Export active rank/world for the wrapped fn's ecosystem

@@ -64,6 +64,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..telemetry import counter, gauge, histogram
+from ..utils import env
 from ..utils.logging import get_logger
 
 log = get_logger("quorum")
@@ -312,8 +313,8 @@ def make_quorum_fn(
 
 # -- native beater (ABI v3): pinned C pthread + futex-woken generation ------
 
-ENV_PIN_CPU = "TPURX_BEAT_PIN_CPU"
-ENV_RT_PRIO = "TPURX_BEAT_RT_PRIO"
+ENV_PIN_CPU = env.BEAT_PIN_CPU.name
+ENV_RT_PRIO = env.BEAT_RT_PRIO.name
 
 _BEAT_SYMBOLS = (
     "tpurx_beat_start", "tpurx_beat_stop", "tpurx_beat_abi_v3",
@@ -409,9 +410,9 @@ class NativeBeater:
 
         self.interval_s = max(0.00005, interval_s)
         if pin_cpu is None:
-            pin_cpu = int(os.environ.get(ENV_PIN_CPU, _default_pin_cpu()))
+            pin_cpu = env.BEAT_PIN_CPU.get(default=_default_pin_cpu())
         if rt_prio is None:
-            rt_prio = int(os.environ.get(ENV_RT_PRIO, "1"))
+            rt_prio = env.BEAT_RT_PRIO.get()
         self.pin_cpu = pin_cpu
         self.rt_prio = rt_prio
         self.slot = ctypes.c_int64(now_stamp_ns())
@@ -467,6 +468,7 @@ class NativeBeater:
     def __del__(self):  # best-effort: keepalive registry prevents UAF
         try:
             self.stop()
+        # tpurx: disable=TPURX009 -- __del__ at interpreter teardown: any raise prints unraisable-noise to stderr
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
 
@@ -991,6 +993,7 @@ class QuorumMonitor:
     def __del__(self):  # best-effort: registry already prevents UAF
         try:
             self._stop_native_beater()
+        # tpurx: disable=TPURX009 -- __del__ at interpreter teardown: any raise prints unraisable-noise to stderr
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
 

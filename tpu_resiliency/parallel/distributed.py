@@ -16,9 +16,9 @@ already owns the control plane), port = store port + 1 by default, or
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
+from ..utils import env as _env
 from ..utils.logging import get_logger
 
 log = get_logger("distributed")
@@ -36,18 +36,17 @@ def init_distributed(
     global _initialized
     if _initialized:
         return True
-    env = os.environ
     if num_processes is None:
-        num_processes = int(env.get("TPURX_NNODES", "1"))
+        num_processes = _env.NNODES.get()
     if process_id is None:
-        process_id = int(env.get("TPURX_GROUP_RANK", "0"))
+        process_id = _env.GROUP_RANK.get()
     if num_processes <= 1:
         return False
     if coordinator_address is None:
-        coordinator_address = env.get("TPURX_JAX_COORDINATOR")
+        coordinator_address = _env.JAX_COORDINATOR.get()
     if coordinator_address is None:
-        host = env.get("TPURX_STORE_ADDR", "127.0.0.1")
-        port = int(env.get("TPURX_STORE_PORT", "29400")) + 1
+        host = _env.STORE_ADDR.get()
+        port = _env.STORE_PORT.get() + 1
         coordinator_address = f"{host}:{port}"
     import jax
 

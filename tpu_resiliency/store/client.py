@@ -12,7 +12,6 @@ threads keep their own clone so a blocked GET can't starve heartbeats).
 
 from __future__ import annotations
 
-import os
 import socket
 import struct
 import threading
@@ -20,6 +19,7 @@ import time
 from typing import List, Optional, Sequence
 
 from ..telemetry import counter, histogram
+from ..utils import env
 from ..utils.retry import (
     CONNECT_POLICY,
     ROUNDTRIP_POLICY,
@@ -540,7 +540,7 @@ def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
     launcher); TPURX_STORE_SHARDS="h1:p1,h2:p2" selects the sharded client
     (consistent-hash routing, per-shard failover);
     TPURX_STORE_ENDPOINTS="h1:p1,h2:p2" enables serial failover."""
-    shards = os.environ.get("TPURX_STORE_SHARDS")
+    shards = env.STORE_SHARDS.get()
     if shards:
         from .sharding import ShardedStoreClient  # local: avoids a cycle
 
@@ -548,11 +548,11 @@ def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
             [e.strip() for e in shards.split(",") if e.strip()],
             timeout=timeout,
         )
-    endpoints = os.environ.get("TPURX_STORE_ENDPOINTS")
+    endpoints = env.STORE_ENDPOINTS.get()
     if endpoints:
         return FailoverStoreClient(
             [e.strip() for e in endpoints.split(",") if e.strip()], timeout=timeout
         )
-    host = os.environ.get("TPURX_STORE_ADDR", "127.0.0.1")
-    port = int(os.environ.get("TPURX_STORE_PORT", "29500"))
+    host = env.STORE_ADDR.get()
+    port = env.STORE_PORT.get()
     return StoreClient(host, port, timeout=timeout)

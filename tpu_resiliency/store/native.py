@@ -15,8 +15,13 @@ import time
 from typing import Optional
 
 from ..utils.logging import get_logger
+from ..utils.retry import Retrier, RetryExhausted, RetryPolicy
 
 log = get_logger("store.native")
+
+# fixed-cadence startup poll: jitter is pointless against a local child
+_STARTUP_POLL = RetryPolicy(max_attempts=None, base_delay=0.05, max_delay=0.05,
+                            min_delay_fraction=1.0)
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 
@@ -115,19 +120,23 @@ class NativeStoreServer:
                     f"native store server failed to start: {last_line!r}"
                 )
             self.port = int(m.group(1))
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
+            from .client import StoreClient, StoreError
+
+            retrier = Retrier("native_store_start", _STARTUP_POLL, deadline=timeout)
+            while True:
                 if self._proc.poll() is not None:
                     raise RuntimeError("native store server exited at startup")
                 try:
-                    from .client import StoreClient
-
                     StoreClient("127.0.0.1", self.port, connect_timeout=1.0).close()
                     self._drain_stderr()
                     return self
-                except Exception:  # noqa: BLE001
-                    time.sleep(0.05)
-            raise RuntimeError("native store server did not accept connections")
+                except (StoreError, OSError) as exc:
+                    try:
+                        retrier.backoff(exc)
+                    except RetryExhausted:
+                        raise RuntimeError(
+                            "native store server did not accept connections"
+                        ) from exc
         except BaseException:
             self.stop()  # never leak the child holding the port
             raise

@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+from ..utils import env
 from ..utils.logging import get_logger
 from .protocol import Op, Status, encode_response, itob
 
@@ -233,7 +234,7 @@ class StoreServer:
         # test-only fault hook: die after writing N snapshot records, so the
         # crash-consistency suite can SIGKILL-equivalent the server exactly
         # mid-``write_snapshot`` (the soak harness's fault-injection idiom)
-        crash_after = os.environ.get("TPURX_STORE_TEST_COMPACT_CRASH")
+        crash_after = env.STORE_TEST_COMPACT_CRASH.get()
 
         def write_snapshot() -> int:
             written = 0
@@ -482,7 +483,7 @@ class StoreServer:
             writer.close()
             try:
                 await writer.wait_closed()
-            except Exception:  # noqa: BLE001
+            except (OSError, asyncio.CancelledError):
                 pass
 
     # -- lifecycle ---------------------------------------------------------

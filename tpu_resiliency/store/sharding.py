@@ -49,6 +49,10 @@ from typing import Callable, List, Optional, Sequence, Tuple
 from ..telemetry import counter, gauge
 from ..utils.logging import get_logger
 from ..utils.retry import Retrier, RetryExhausted, RetryPolicy
+
+# fixed-cadence subprocess-start poll (local child; no jitter needed)
+_SPAWN_POLL = RetryPolicy(max_attempts=None, base_delay=0.05, max_delay=0.05,
+                          min_delay_fraction=1.0)
 from .client import (
     _DEFAULT_TIMEOUT,
     StoreClient,
@@ -535,8 +539,8 @@ def spawn_shard_subprocess(
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
     )
-    deadline = time.monotonic() + connect_timeout
-    while time.monotonic() < deadline:
+    retrier = Retrier("shard_spawn", _SPAWN_POLL, deadline=connect_timeout)
+    while True:
         if proc.poll() is not None:
             raise RuntimeError(
                 f"shard subprocess on port {port} exited at startup "
@@ -545,7 +549,11 @@ def spawn_shard_subprocess(
         try:
             StoreClient(host, port, connect_timeout=1.0).close()
             return proc
-        except StoreError:
-            time.sleep(0.05)
-    proc.kill()
-    raise RuntimeError(f"shard subprocess on port {port} never accepted")
+        except StoreError as exc:
+            try:
+                retrier.backoff(exc)
+            except RetryExhausted:
+                proc.kill()
+                raise RuntimeError(
+                    f"shard subprocess on port {port} never accepted"
+                ) from exc

@@ -27,14 +27,14 @@ module (mirroring the raw-rb-read ban in ``checkpointing/``).
 
 from __future__ import annotations
 
-import os
 import time
 from typing import Callable, List, Optional, Sequence
 
 from ..telemetry import counter, gauge
+from ..utils import env
 from .client import StoreTimeout
 
-ENV_FANOUT = "TPURX_TREE_FANOUT"
+ENV_FANOUT = env.TREE_FANOUT.name
 DEFAULT_FANOUT = 16
 
 _ROUNDS = counter(
@@ -53,7 +53,7 @@ _FANIN = gauge(
 def resolve_fanout(fanout: Optional[int] = None) -> int:
     if fanout is not None:
         return max(2, int(fanout))
-    return max(2, int(os.environ.get(ENV_FANOUT, str(DEFAULT_FANOUT))))
+    return max(2, env.TREE_FANOUT.get())
 
 
 class TreeGatherTimeout(TimeoutError):

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import collections
 import ctypes
-import os
 import queue
 import shutil
 import tempfile
@@ -47,6 +46,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..utils import env
 from ..utils.logging import get_logger
 from ..utils.native import load_native
 from ..utils.shm import attach_shm, create_shm
@@ -60,7 +60,7 @@ _version_checked = False
 
 def _check_jax_version() -> None:
     global _version_checked
-    if _version_checked or os.environ.get("TPURX_SKIP_JAX_LANE_CHECK") == "1":
+    if _version_checked or env.SKIP_JAX_LANE_CHECK.get():
         return
     _version_checked = True
     import jax

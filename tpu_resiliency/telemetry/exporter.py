@@ -24,11 +24,12 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
+from ..utils import env
 from ..utils.logging import _resolve_rank
 from .registry import Registry, get_registry
 
-ENV_METRICS_PORT = "TPURX_METRICS_PORT"
-ENV_METRICS_TEXTFILE = "TPURX_METRICS_TEXTFILE"
+ENV_METRICS_PORT = env.METRICS_PORT.name
+ENV_METRICS_TEXTFILE = env.METRICS_TEXTFILE.name
 
 CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
@@ -240,17 +241,16 @@ def serve_from_env(registry: Optional[Registry] = None):
     sink.  Returns the list of started exporters (possibly empty).
     """
     started = []
-    port = os.environ.get(ENV_METRICS_PORT)
-    if port is not None:
+    if env.METRICS_PORT.is_set():
         try:
-            base = int(port)
+            base = env.METRICS_PORT.get()
             if base:
                 # multi-worker hosts: each local rank claims base+local_rank
-                base += int(os.environ.get("TPURX_LOCAL_RANK", "0") or 0)
+                base += env.LOCAL_RANK.get()
             started.append(MetricsHTTPServer(registry, port=base).start())
         except (OSError, ValueError):
             pass  # a taken port must not kill the workload
-    template = os.environ.get(ENV_METRICS_TEXTFILE)
+    template = env.METRICS_TEXTFILE.get()
     if template:
         started.append(TextfileSink(template, registry).start())
     return started

@@ -24,13 +24,14 @@ histograms can pass their own bucket table.
 from __future__ import annotations
 
 import bisect
-import os
 import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-ENV_TELEMETRY = "TPURX_TELEMETRY"
+from ..utils import env
+
+ENV_TELEMETRY = env.TELEMETRY.name
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -47,7 +48,7 @@ BYTE_BUCKETS: Tuple[float, ...] = tuple(4096.0 * (8 ** i) for i in range(8))
 
 def telemetry_enabled() -> bool:
     """The global switch: ``TPURX_TELEMETRY=0`` disables collection."""
-    return os.environ.get(ENV_TELEMETRY, "1").lower() not in ("0", "false", "off")
+    return env.TELEMETRY.get()
 
 
 def valid_metric_name(name: str) -> bool:

@@ -1,6 +1,410 @@
-"""Environment helpers shared by subprocess launchers."""
+"""Typed registry of every ``TPURX_*`` environment knob.
+
+Seven PRs accreted ~50 knobs, each read site re-deciding its own default and
+parse convention (``!= "0"`` here, ``== "1"`` there, ``or 0`` for empty
+strings somewhere else) — and two sites disagreeing about the default store
+port.  This module is the single home: every knob is declared once with a
+name, type, default, and doc line; every library read routes through
+``Knob.get()`` (enforced by tpurx-lint rule TPURX010); and
+``docs/configuration.md`` is generated from the declarations
+(``python -m tpu_resiliency.utils.env --write``).
+
+Parse conventions (uniform for every knob):
+
+- empty string == unset (falls back to the declared default);
+- bool: ``0 / false / no / off`` (case-insensitive) are False, anything else
+  set is True;
+- a knob may name a ``fallback`` env var (e.g. ``TPURX_RANK`` falls back to
+  plain ``RANK``) consulted when the primary is unset;
+- ``Knob.get(default=...)`` overrides the declared default for call sites
+  whose default is computed (e.g. the beater CPU pin).
+
+This module must import nothing from the package (everything imports it).
+"""
 
 from __future__ import annotations
+
+import os
+
+_UNSET = object()
+_BOOL_FALSE = frozenset({"0", "false", "no", "off"})
+
+_REGISTRY: dict = {}
+
+
+class Knob:
+    """One declared environment knob."""
+
+    __slots__ = ("name", "type", "default", "doc", "fallback", "group")
+
+    def __init__(self, name: str, type: type, default, doc: str,
+                 fallback: str | None = None, group: str = "general"):
+        if name in _REGISTRY:
+            raise ValueError(f"knob {name} declared twice")
+        self.name = name
+        self.type = type
+        self.default = default
+        self.doc = doc
+        self.fallback = fallback
+        self.group = group
+        _REGISTRY[name] = self
+
+    def raw(self) -> str | None:
+        """The raw string value, honoring the fallback var; None when unset
+        (empty string counts as unset)."""
+        val = os.environ.get(self.name)
+        if (val is None or val == "") and self.fallback:
+            val = os.environ.get(self.fallback)
+        if val == "":
+            val = None
+        return val
+
+    def is_set(self) -> bool:
+        return self.raw() is not None
+
+    def get(self, default=_UNSET):
+        """Parsed value, or the (declared or overridden) default when unset.
+
+        Raises ValueError naming the knob on an unparseable value — a typo'd
+        knob must fail loudly at read time, not act as silently-default.
+        """
+        raw = self.raw()
+        if raw is None:
+            return self.default if default is _UNSET else default
+        try:
+            return self._parse(raw)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"{self.name}={raw!r} is not a valid {self.type.__name__}: {e}"
+            ) from e
+
+    def _parse(self, raw: str):
+        if self.type is bool:
+            return raw.strip().lower() not in _BOOL_FALSE
+        if self.type is int:
+            return int(raw, 0)
+        if self.type is float:
+            return float(raw)
+        return raw
+
+    def __repr__(self):
+        return f"Knob({self.name}, {self.type.__name__}, default={self.default!r})"
+
+
+class KnobFamily:
+    """A dynamic family of knobs sharing a prefix (``TPURX_FT_<FIELD>``):
+    individual members are per-config-field overrides that can't be
+    enumerated statically, but the family itself is declared and documented
+    here like any other knob."""
+
+    __slots__ = ("prefix", "doc", "group")
+
+    def __init__(self, prefix: str, doc: str, group: str = "general"):
+        if prefix in _REGISTRY:
+            raise ValueError(f"knob family {prefix} declared twice")
+        self.prefix = prefix
+        self.doc = doc
+        self.group = group
+        _REGISTRY[prefix] = self
+
+    def raw(self, field: str) -> str | None:
+        """Raw value of ``<prefix><FIELD>`` (field upper-cased), None if unset."""
+        return os.environ.get(self.prefix + field.upper())
+
+
+def all_knobs():
+    """Every declared Knob/KnobFamily, sorted by name."""
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def lookup(name: str):
+    return _REGISTRY.get(name)
+
+
+# ---------------------------------------------------------------------------
+# Knob catalog.  Grouped to match docs/configuration.md sections.
+# ---------------------------------------------------------------------------
+
+# -- job identity (set by the launcher, read everywhere) --------------------
+RANK = Knob(
+    "TPURX_RANK", int, 0, "Global rank of this worker.",
+    fallback="RANK", group="identity")
+LOCAL_RANK = Knob(
+    "TPURX_LOCAL_RANK", int, 0, "Rank local to this host.",
+    fallback="LOCAL_RANK", group="identity")
+WORLD_SIZE = Knob(
+    "TPURX_WORLD_SIZE", int, 1, "Total ranks in the job.",
+    fallback="WORLD_SIZE", group="identity")
+GROUP_RANK = Knob(
+    "TPURX_GROUP_RANK", int, 0,
+    "Node index within the job (one per agent/host).", group="identity")
+NNODES = Knob(
+    "TPURX_NNODES", int, 1, "Number of nodes (agents) in the job.",
+    group="identity")
+INFRA_RANK = Knob(
+    "TPURX_INFRA_RANK", int, None,
+    "Infrastructure-assigned rank used for log prefixes before the "
+    "launcher assigns TPURX_RANK.", group="identity")
+CYCLE = Knob(
+    "TPURX_CYCLE", int, 0,
+    "Restart-cycle counter, bumped by the launcher on every restart; "
+    "namespaces store keys and checkpoint rounds.", group="identity")
+REPO = Knob(
+    "TPURX_REPO", str, None,
+    "Absolute path to the repo checkout; set by bench/soak harnesses for "
+    "their generated worker scripts.", group="identity")
+
+# -- control-plane store ----------------------------------------------------
+STORE_ADDR = Knob(
+    "TPURX_STORE_ADDR", str, "127.0.0.1",
+    "Host of the control-plane store (seed shard when sharded).",
+    group="store")
+STORE_PORT = Knob(
+    "TPURX_STORE_PORT", int, 29500,
+    "Port of the control-plane store seed.", group="store")
+STORE_SHARDS = Knob(
+    "TPURX_STORE_SHARDS", str, None,
+    "Comma-separated host:port shard endpoints; set selects the sharded "
+    "store client (consistent-hash routing, per-shard failover).",
+    group="store")
+STORE_ENDPOINTS = Knob(
+    "TPURX_STORE_ENDPOINTS", str, None,
+    "Comma-separated host:port shard endpoints, overriding the "
+    "shard-map bootstrap read.", group="store")
+NATIVE_STORE = Knob(
+    "TPURX_NATIVE_STORE", bool, False,
+    "Launcher hosts the native C++ store server instead of the asyncio "
+    "one.", group="store")
+TREE_FANOUT = Knob(
+    "TPURX_TREE_FANOUT", int, 16,
+    "Fan-out of the rank→host→job reduction tree used by every "
+    "cross-rank gather round.", group="store")
+STORE_TEST_COMPACT_CRASH = Knob(
+    "TPURX_STORE_TEST_COMPACT_CRASH", int, None,
+    "TEST-ONLY fault hook: crash the store journal compactor after N "
+    "appends.", group="store")
+JAX_COORDINATOR = Knob(
+    "TPURX_JAX_COORDINATOR", str, None,
+    "host:port for jax.distributed.initialize; default derives "
+    "store host and port+1.", group="store")
+
+# -- heartbeat / hang detection --------------------------------------------
+RANK_MONITOR_SOCKET = Knob(
+    "TPURX_RANK_MONITOR_SOCKET", str, None,
+    "Unix socket path of this rank's monitor server (set by the "
+    "launcher).", group="detection")
+LAUNCHER_IPC_SOCKET = Knob(
+    "TPURX_LAUNCHER_IPC_SOCKET", str, None,
+    "Unix socket for worker→launcher section/heartbeat IPC.",
+    group="detection")
+OPRING_SHM = Knob(
+    "TPURX_OPRING_SHM", str, None,
+    "Name of the dispatched-op ring shm segment (set by the straggler "
+    "detector, read by the monitor for at-abort fingerprints).",
+    group="detection")
+BEAT_PIN_CPU = Knob(
+    "TPURX_BEAT_PIN_CPU", int, None,
+    "CPU to pin the native beater thread to (-1 disables; default "
+    "picks the last online CPU).", group="detection")
+BEAT_RT_PRIO = Knob(
+    "TPURX_BEAT_RT_PRIO", int, 1,
+    "SCHED_FIFO priority requested for the native beater (EPERM falls "
+    "back to normal scheduling).", group="detection")
+FT_OVERRIDES = KnobFamily(
+    "TPURX_FT_",
+    "Per-field overrides of FaultToleranceConfig: TPURX_FT_<UPPER_FIELD> "
+    "(e.g. TPURX_FT_RANK_HEARTBEAT_TIMEOUT=null disables that timeout). "
+    "Highest-precedence config source.", group="detection")
+
+# -- checkpointing ----------------------------------------------------------
+CKPT_CHUNK_BYTES = Knob(
+    "TPURX_CKPT_CHUNK_BYTES", int, 16 << 20,
+    "Chunk size of the multi-threaded checkpoint drain/restore engines.",
+    group="checkpoint")
+CKPT_RESTORE_THREADS = Knob(
+    "TPURX_CKPT_RESTORE_THREADS", int, 0,
+    "Restore read-engine thread count (0 = same sizing as the write "
+    "engine).", group="checkpoint")
+CKPT_DIGEST = Knob(
+    "TPURX_CKPT_DIGEST", bool, True,
+    "Compute per-chunk crc32 spans + composed shard digests during the "
+    "drain.", group="checkpoint")
+CKPT_DIRECT_IO = Knob(
+    "TPURX_CKPT_DIRECT_IO", bool, True,
+    "Use O_DIRECT for checkpoint reads/writes (buffered fallback on "
+    "EINVAL).", group="checkpoint")
+CKPT_SCRUB_INTERVAL = Knob(
+    "TPURX_CKPT_SCRUB_INTERVAL", float, None,
+    "Idle-time integrity scrubber period in seconds (unset disables).",
+    group="checkpoint")
+CKPT_STAGER_NICE = Knob(
+    "TPURX_CKPT_STAGER_NICE", int, 10,
+    "nice() increment applied to the async-save stager thread.",
+    group="checkpoint")
+CKPT_WORKER_NICE = Knob(
+    "TPURX_CKPT_WORKER_NICE", int, 10,
+    "nice() increment applied to the checkpoint writer process.",
+    group="checkpoint")
+CKPT_WORKER_IONICE = Knob(
+    "TPURX_CKPT_WORKER_IONICE", int, 3,
+    "ionice class for the checkpoint writer process (3 = idle).",
+    group="checkpoint")
+PEER_ADDR = Knob(
+    "TPURX_PEER_ADDR", str, None,
+    "Override of the replication peer address map: "
+    "'rank:host:port,rank:host:port'.", group="checkpoint")
+
+# -- telemetry / logging ----------------------------------------------------
+TELEMETRY = Knob(
+    "TPURX_TELEMETRY", bool, True,
+    "Global telemetry switch; 0 swaps every metric for a shared no-op.",
+    group="telemetry")
+METRICS_PORT = Knob(
+    "TPURX_METRICS_PORT", int, None,
+    "Base port of the per-rank OpenMetrics HTTP endpoint "
+    "(port + local_rank; 0 = ephemeral; unset disables).",
+    group="telemetry")
+METRICS_TEXTFILE = Knob(
+    "TPURX_METRICS_TEXTFILE", str, None,
+    "Atomic textfile sink path template for OpenMetrics output "
+    "(%r = rank, %h = host).", group="telemetry")
+PROFILING_FILE = Knob(
+    "TPURX_PROFILING_FILE", str, None,
+    "JSONL profiling-event sink path (%r expanded to rank).",
+    group="telemetry")
+PROFILING_HISTORY = Knob(
+    "TPURX_PROFILING_HISTORY", int, 4096,
+    "Bounded in-memory profiling event history per process.",
+    group="telemetry")
+LOG_LEVEL = Knob(
+    "TPURX_LOG_LEVEL", str, "INFO", "Root log level for tpurx loggers.",
+    group="telemetry")
+LOG_FILE = Knob(
+    "TPURX_LOG_FILE", str, None,
+    "Log file path template (%r expanded to rank, deferred to first "
+    "record).", group="telemetry")
+LOG_FUNNEL = Knob(
+    "TPURX_LOG_FUNNEL", str, None,
+    "Unix socket of the per-node log funnel root (set by the launcher "
+    "for workers).", group="telemetry")
+
+# -- health / fault injection ----------------------------------------------
+NODE_HEALTH_ENDPOINT = Knob(
+    "TPURX_NODE_HEALTH_ENDPOINT", str, None,
+    "HTTP endpoint of the node health daemon probed by the health "
+    "gate.", group="health")
+INJECT_NODE_FAILURE = Knob(
+    "TPURX_INJECT_NODE_FAILURE", str, None,
+    "TEST-ONLY: fake a node-health failure spec in the health gate.",
+    group="health")
+FAULT = Knob(
+    "TPURX_FAULT", str, None,
+    "Soak-harness fault spec to inject in this worker (class[:arg]).",
+    group="health")
+FAULT_RANKS = Knob(
+    "TPURX_FAULT_RANKS", str, None,
+    "Comma-separated ranks the injected fault applies to (default all).",
+    group="health")
+FAULT_CYCLES = Knob(
+    "TPURX_FAULT_CYCLES", str, None,
+    "Comma-separated restart cycles the injected fault fires in.",
+    group="health")
+FAULT_CKPT_DIR = Knob(
+    "TPURX_FAULT_CKPT_DIR", str, None,
+    "Checkpoint directory targeted by corruption fault classes.",
+    group="health")
+SHRINK_MESH = Knob(
+    "TPURX_SHRINK_MESH", bool, False,
+    "Enable the opt-in ShrinkMeshStage rung in the abort ladder.",
+    group="health")
+SKIP_JAX_LANE_CHECK = Knob(
+    "TPURX_SKIP_JAX_LANE_CHECK", bool, False,
+    "Skip the jax-version compatibility probe of the straggler "
+    "device lane.", group="health")
+
+# -- attribution / LLM ------------------------------------------------------
+LLM_BASE_URL = Knob(
+    "TPURX_LLM_BASE_URL", str, "",
+    "OpenAI-compatible endpoint for LLM-backed log attribution "
+    "(empty disables).", group="attribution")
+LLM_API_KEY = Knob(
+    "TPURX_LLM_API_KEY", str, "", "API key for the attribution LLM.",
+    group="attribution")
+LLM_MODEL = Knob(
+    "TPURX_LLM_MODEL", str, "default",
+    "Model name for the attribution LLM.", group="attribution")
+LLM_TIMEOUT_S = Knob(
+    "TPURX_LLM_TIMEOUT_S", float, 30.0,
+    "Per-request timeout for the attribution LLM.", group="attribution")
+
+# -- bench / harness --------------------------------------------------------
+BENCH_DEADLINE_S = Knob(
+    "TPURX_BENCH_DEADLINE_S", int, 480,
+    "SIGALRM deadline for a full bench.py run.", group="bench")
+BENCH_CHILD_BUDGET_S = Knob(
+    "TPURX_BENCH_CHILD_BUDGET_S", float, 300.0,
+    "Per-child time budget within the bench harness.", group="bench")
+BENCH_ACQUIRE_S = Knob(
+    "TPURX_BENCH_ACQUIRE_S", float, None,
+    "Override of the bench TPU-acquisition retry campaign duration.",
+    group="bench")
+BENCH_LIGHT = Knob(
+    "TPURX_BENCH_LIGHT", bool, False,
+    "Run the light bench variant (small sizes, CPU-safe).", group="bench")
+BENCH_PARTIAL = Knob(
+    "TPURX_BENCH_PARTIAL", str, None,
+    "Path for incremental partial bench JSON output.", group="bench")
+
+_GROUP_TITLES = {
+    "identity": "Job identity",
+    "store": "Control-plane store",
+    "detection": "Heartbeat & hang detection",
+    "checkpoint": "Checkpointing",
+    "telemetry": "Telemetry & logging",
+    "health": "Health & fault injection",
+    "attribution": "Attribution / LLM",
+    "bench": "Bench & harness",
+    "general": "General",
+}
+
+
+def render_markdown() -> str:
+    """docs/configuration.md content, generated from the declarations."""
+    lines = [
+        "# Configuration — TPURX_* environment knobs",
+        "",
+        "**Generated from `tpu_resiliency/utils/env.py` — do not edit by "
+        "hand.**  Regenerate with `python -m tpu_resiliency.utils.env "
+        "--write` after declaring a knob.",
+        "",
+        "Conventions: empty string == unset; booleans treat "
+        "`0/false/no/off` as false and anything else set as true; every "
+        "library read goes through the typed registry (lint rule TPURX010).",
+        "",
+    ]
+    by_group: dict = {}
+    for knob in all_knobs():
+        by_group.setdefault(knob.group, []).append(knob)
+    for group in _GROUP_TITLES:
+        knobs = by_group.pop(group, [])
+        if not knobs:
+            continue
+        lines += [f"## {_GROUP_TITLES[group]}", "",
+                  "| Name | Type | Default | Description |",
+                  "| --- | --- | --- | --- |"]
+        for k in knobs:
+            if isinstance(k, KnobFamily):
+                lines.append(
+                    f"| `{k.prefix}<FIELD>` | family | — | {k.doc} |")
+            else:
+                fb = f" (falls back to `{k.fallback}`)" if k.fallback else ""
+                default = "unset" if k.default is None else f"`{k.default}`"
+                lines.append(
+                    f"| `{k.name}` | {k.type.__name__} | {default} | "
+                    f"{k.doc}{fb} |")
+        lines.append("")
+    assert not by_group, f"groups missing a title: {sorted(by_group)}"
+    return "\n".join(lines)
 
 
 def disarm_platform_sitecustomize(env: dict) -> dict:
@@ -16,3 +420,43 @@ def disarm_platform_sitecustomize(env: dict) -> dict:
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     return env
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_resiliency.utils.env",
+        description="Regenerate docs/configuration.md from the knob registry.")
+    default_doc = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "docs", "configuration.md")
+    ap.add_argument("--write", nargs="?", const=default_doc, metavar="PATH",
+                    help=f"write the generated catalog (default: {default_doc})")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if the doc on disk is stale")
+    args = ap.parse_args(argv)
+
+    content = render_markdown()
+    target = args.write or default_doc
+    if args.check:
+        try:
+            with open(target) as f:
+                on_disk = f.read()
+        except OSError:
+            on_disk = ""
+        if on_disk != content:
+            import sys
+            sys.stderr.write(f"{target} is stale — regenerate with "
+                             f"python -m tpu_resiliency.utils.env --write\n")
+            return 1
+        return 0
+    with open(target, "w") as f:
+        f.write(content)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -40,14 +40,15 @@ import threading
 import time
 from typing import List, Optional
 
+from . import env
 from .logging import get_logger
 
 log = get_logger("inject_fault")
 
-ENV_FAULT = "TPURX_FAULT"
-ENV_FAULT_RANKS = "TPURX_FAULT_RANKS"
-ENV_FAULT_CYCLES = "TPURX_FAULT_CYCLES"
-ENV_FAULT_CKPT_DIR = "TPURX_FAULT_CKPT_DIR"
+ENV_FAULT = env.FAULT.name
+ENV_FAULT_RANKS = env.FAULT_RANKS.name
+ENV_FAULT_CYCLES = env.FAULT_CYCLES.name
+ENV_FAULT_CKPT_DIR = env.FAULT_CKPT_DIR.name
 
 
 class Fault(str, enum.Enum):
@@ -220,7 +221,7 @@ def _fire(fault: Fault) -> None:
     elif fault == Fault.DEVICE_HANG:
         _device_hang()
     elif fault in _CKPT_FAULTS:
-        root = os.environ.get(ENV_FAULT_CKPT_DIR)
+        root = env.FAULT_CKPT_DIR.get()
         if not root:
             log.warning("%s fault without %s set; skipping",
                         fault.value, ENV_FAULT_CKPT_DIR)
@@ -243,19 +244,18 @@ def inject_fault(fault: Fault, delay: float = 0.0) -> threading.Thread:
 
 def maybe_inject_from_env(rank: Optional[int] = None) -> Optional[threading.Thread]:
     """Parse TPURX_FAULT / TPURX_FAULT_RANKS and schedule if applicable."""
-    spec = os.environ.get(ENV_FAULT)
+    spec = env.FAULT.get()
     if not spec:
         return None
-    cycles = os.environ.get(ENV_FAULT_CYCLES)
+    cycles = env.FAULT_CYCLES.get()
     if cycles is not None:
-        cycle = int(os.environ.get("TPURX_CYCLE", "0"))
+        cycle = env.CYCLE.get()
         if cycle not in {int(c) for c in cycles.split(",") if c.strip()}:
             return None
-    ranks = os.environ.get(ENV_FAULT_RANKS)
+    ranks = env.FAULT_RANKS.get()
     if ranks is not None:
         if rank is None:
-            env_rank = os.environ.get("TPURX_RANK", os.environ.get("RANK"))
-            rank = int(env_rank) if env_rank is not None else None
+            rank = env.RANK.get(default=None)
         if rank is None:
             # Rank gate requested but rank unknown: do NOT fire on everyone.
             log.warning("%s set but rank unknown; skipping injection", ENV_FAULT_RANKS)

@@ -18,13 +18,15 @@ import socket
 import sys
 from typing import Optional
 
+from . import env
+
 _ROOT_NAME = "tpurx"
 
-# Env knobs (reference analog: NVRX_LOG_DEBUG etc.)
-ENV_LOG_LEVEL = "TPURX_LOG_LEVEL"
-ENV_LOG_FILE = "TPURX_LOG_FILE"
-ENV_RANK = "TPURX_RANK"
-ENV_INFRA_RANK = "TPURX_INFRA_RANK"
+# Env knobs (reference analog: NVRX_LOG_DEBUG etc.) — declared in utils/env.py
+ENV_LOG_LEVEL = env.LOG_LEVEL.name
+ENV_LOG_FILE = env.LOG_FILE.name
+ENV_RANK = env.RANK.name
+ENV_INFRA_RANK = env.INFRA_RANK.name
 
 
 @dataclasses.dataclass
@@ -48,16 +50,16 @@ class LogConfig:
     @classmethod
     def from_env(cls) -> "LogConfig":
         return cls(
-            level=os.environ.get(ENV_LOG_LEVEL, "INFO"),
-            to_file=os.environ.get(ENV_LOG_FILE),
+            level=env.LOG_LEVEL.get(),
+            to_file=env.LOG_FILE.get(),
         )
 
 
 def _resolve_rank(explicit: Optional[int] = None) -> str:
     if explicit is not None:
         return str(explicit)
-    for key in (ENV_RANK, "TPURX_GROUP_RANK", ENV_INFRA_RANK):
-        val = os.environ.get(key)
+    for knob in (env.RANK, env.GROUP_RANK, env.INFRA_RANK):
+        val = knob.raw()
         if val is not None:
             return val
     return "?"
@@ -128,7 +130,7 @@ def setup_logger(
     ``force=True`` (which drops existing handlers and reconfigures)."""
     cfg = config or LogConfig.from_env()
     logger = logging.getLogger(_ROOT_NAME)
-    level = getattr(logging, os.environ.get(ENV_LOG_LEVEL, cfg.level).upper(), logging.INFO)
+    level = getattr(logging, env.LOG_LEVEL.get(default=cfg.level).upper(), logging.INFO)
     logger.setLevel(level)
     if getattr(logger, "_tpurx_configured", False):
         if not force:
@@ -146,7 +148,7 @@ def setup_logger(
     console.addFilter(rank_filter)
     logger.addHandler(console)
 
-    to_file = os.environ.get(ENV_LOG_FILE, cfg.to_file)
+    to_file = env.LOG_FILE.get(default=cfg.to_file)
     if to_file:
         fh = _TemplateFileHandler(to_file, cfg.rank)
         fh.setFormatter(formatter)

@@ -31,8 +31,8 @@ def device_memory_stats() -> List[Dict[str, float]]:
                 "peak_bytes_in_use": float(raw.get("peak_bytes_in_use", 0)),
                 "bytes_limit": float(raw.get("bytes_limit", 0)),
             }
-        except Exception:  # noqa: BLE001 - some backends lack memory_stats
-            pass
+        except (AttributeError, RuntimeError, TypeError, KeyError):
+            pass  # some backends lack memory_stats
         stats["device"] = f"{dev.platform}:{dev.id}"
         out.append(stats)
     return out

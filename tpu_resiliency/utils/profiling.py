@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from . import env
+
 
 class ProfilingEvent(str, enum.Enum):
     # Detection
@@ -50,7 +52,7 @@ class ProfilingEvent(str, enum.Enum):
     NODE_EXCLUDE_REQUESTED = "node_exclude_requested"
 
 
-ENV_HISTORY = "TPURX_PROFILING_HISTORY"
+ENV_HISTORY = env.PROFILING_HISTORY.name
 _DEFAULT_HISTORY = 4096
 
 
@@ -78,7 +80,7 @@ class ProfilingRecorder:
         self._lock = threading.Lock()
         if history is None:
             try:
-                history = int(os.environ.get(ENV_HISTORY, _DEFAULT_HISTORY))
+                history = env.PROFILING_HISTORY.get()
             except ValueError:
                 history = _DEFAULT_HISTORY
         self._events: "collections.deque[Dict[str, Any]]" = collections.deque(
@@ -146,7 +148,7 @@ class ProfilingRecorder:
         return None
 
 
-_global_recorder = ProfilingRecorder(path=os.environ.get("TPURX_PROFILING_FILE"))
+_global_recorder = ProfilingRecorder(path=env.PROFILING_FILE.get())
 
 
 def get_recorder() -> ProfilingRecorder:

@@ -1,0 +1,29 @@
+"""tpurx-lint: pluggable resiliency static analysis for the tpu-resiliency repo.
+
+The invariants that kill resiliency systems — an unbounded blocking wait in a
+recovery path, a hand-rolled retry loop bypassing the shared jitter/deadline
+policy, a non-daemon thread wedging abort teardown, a swallowed exception in a
+fault handler — are machine-enforceable.  This package is the single home for
+those checks: a single-parse-per-file rule engine with stable rule IDs
+(TPURX001…), inline ``# tpurx: disable=<RULE> -- <reason>`` suppressions
+(reason required), a checked-in baseline for grandfathered findings, text/JSON
+output, and a ``python -m tpurx_lint`` CLI.
+
+See ``docs/lint.md`` for the rule catalog and the suppression/baseline policy.
+"""
+
+from .findings import Finding
+from .engine import LintResult, Project, run_lint
+from .registry import all_rules, get_rule
+
+__version__ = "1.0"
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Project",
+    "run_lint",
+    "all_rules",
+    "get_rule",
+    "__version__",
+]

@@ -1,0 +1,91 @@
+"""Shared AST predicates used by several rules."""
+
+from __future__ import annotations
+
+import ast
+
+
+def attr_chain(node) -> str:
+    """Dotted-name text of a Name/Attribute chain ('' when not a plain chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif parts:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> str:
+    """Dotted name of the called object ('' when dynamic)."""
+    return attr_chain(call.func)
+
+
+def keyword(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def is_none_constant(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def has_finite_timeout(call: ast.Call, kwarg: str = "timeout",
+                       positional_ok: bool = True) -> bool:
+    """True when the call carries a timeout that is not literally None.
+
+    Any non-None expression counts — the linter can't evaluate it, and the
+    point of the rule is that SOMEONE chose a bound, not what the bound is.
+    """
+    kw = keyword(call, kwarg)
+    if kw is not None:
+        return not is_none_constant(kw)
+    if positional_ok and call.args:
+        return not is_none_constant(call.args[0])
+    return False
+
+
+def contains_call_to(node, names: set) -> ast.Call | None:
+    """First descendant Call whose dotted name is in `names`."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and call_name(sub) in names:
+            return sub
+    return None
+
+
+def enclosing_function(pf, node):
+    """Nearest FunctionDef/AsyncFunctionDef ancestor (or None)."""
+    for anc in pf.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def enclosing_class(pf, node):
+    for anc in pf.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def class_base_names(cls: ast.ClassDef) -> set:
+    out = set()
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.add(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.add(b.attr)
+    return out
+
+
+def body_is_swallow(handler: ast.ExceptHandler) -> bool:
+    """Handler body is only `pass` / `...` / a docstring constant."""
+    return all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in handler.body
+    )

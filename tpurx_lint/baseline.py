@@ -1,0 +1,116 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline exists so a new rule can land with the gate green while its
+pre-existing offenders are burned down deliberately.  Every entry carries a
+one-line ``justification`` written at review time — an empty justification
+fails the gate, which keeps ``--write-baseline`` output from being committed
+unreviewed.
+
+Entries match on (rule, path, symbol) — symbol is the stripped source line —
+not on line numbers, so edits elsewhere in a file don't invalidate the
+baseline, while any edit to the offending line itself surfaces the finding
+again for fresh review.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    symbol: str
+    justification: str = ""
+
+    def key(self):
+        return (self.rule, self.path, self.symbol)
+
+
+@dataclass
+class Baseline:
+    entries: list = field(default_factory=list)
+    path: str = ""
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(entries=[], path=path)
+        with open(path) as f:
+            data = json.load(f)
+        entries = [
+            BaselineEntry(
+                rule=e["rule"], path=e["path"], symbol=e["symbol"],
+                justification=e.get("justification", ""),
+            )
+            for e in data.get("entries", [])
+        ]
+        return cls(entries=entries, path=path)
+
+    def save(self, path: str = "") -> None:
+        path = path or self.path
+        data = {
+            "comment": (
+                "Grandfathered tpurx-lint findings. Every entry needs a "
+                "one-line justification reviewed by a human; new code must "
+                "not be added here — fix it or suppress inline with a reason."
+            ),
+            "entries": [
+                {
+                    "rule": e.rule,
+                    "path": e.path,
+                    "symbol": e.symbol,
+                    "justification": e.justification,
+                }
+                for e in sorted(self.entries, key=BaselineEntry.key)
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+
+    def _index(self):
+        idx = set()
+        for e in self.entries:
+            idx.add(e.key())
+        return idx
+
+    def split(self, findings):
+        """Partition findings into (new, baselined)."""
+        idx = self._index()
+        new, old = [], []
+        for f in findings:
+            if (f.rule, f.path, f.symbol) in idx:
+                old.append(f)
+            else:
+                new.append(f)
+        return new, old
+
+    def unjustified(self):
+        return [e for e in self.entries if not e.justification.strip()]
+
+    def stale(self, findings):
+        """Entries no longer matched by any finding (burned down or drifted)."""
+        live = {(f.rule, f.path, f.symbol) for f in findings}
+        return [e for e in self.entries if e.key() not in live]
+
+    @classmethod
+    def from_findings(cls, findings, path: str,
+                      justifications: dict | None = None) -> "Baseline":
+        justifications = justifications or {}
+        seen = {}
+        for f in findings:
+            key = (f.rule, f.path, f.symbol)
+            if key not in seen:
+                seen[key] = BaselineEntry(
+                    rule=f.rule, path=f.path, symbol=f.symbol,
+                    justification=justifications.get(key, ""),
+                )
+        return cls(entries=list(seen.values()), path=path)

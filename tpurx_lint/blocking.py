@@ -1,0 +1,118 @@
+"""Shared detection of unbounded blocking calls.
+
+Used by TPURX005 (deadline discipline everywhere) and TPURX006 (abort-path
+safety), so both rules agree on what "blocks without a deadline" means.
+
+The contract is intentionally about INTENT, not value: any non-None timeout
+expression counts as bounded — the rule enforces that someone chose a bound,
+not what the bound is.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import attr_chain, call_name, has_finite_timeout, keyword, is_none_constant
+
+# attribute-call names that park the caller until an external event
+_WAIT_ATTRS = {"wait", "wait_stale", "watch_stale"}
+
+_SUBPROCESS_FUNCS = {
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+    "subprocess.call",
+}
+
+
+def _receiver_hints_queue(func: ast.Attribute) -> bool:
+    chain = attr_chain(func.value).lower()
+    last = chain.rsplit(".", 1)[-1]
+    return "queue" in last or last == "q" or last.endswith("_q")
+
+
+def _inside_asyncio_wait_for(pf, node) -> bool:
+    parent = pf.parent(node)
+    # unwrap `await x.wait()` one level
+    if isinstance(parent, ast.Await):
+        parent = pf.parent(parent)
+    return (
+        isinstance(parent, ast.Call)
+        and call_name(parent) in ("asyncio.wait_for", "wait_for")
+        and node in ast.walk(parent)
+    )
+
+
+def unbounded_blocking_calls(pf, scope_node=None):
+    """Yield (call_node, description) for every unbounded blocking call.
+
+    ``scope_node`` limits the walk (used by the abort-path rule to scan one
+    reachable function); default is the whole module.
+    """
+    root = scope_node if scope_node is not None else pf.tree
+    for node in ast.walk(root):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        dotted = call_name(node)
+
+        if isinstance(func, ast.Attribute):
+            attr = func.attr
+            if attr in _WAIT_ATTRS:
+                if _inside_asyncio_wait_for(pf, node):
+                    continue
+                if not has_finite_timeout(node):
+                    yield node, (
+                        f".{attr}() without a finite timeout (event/condition/"
+                        f"process wait can park forever — pass timeout=)"
+                    )
+                continue
+            if attr == "join" and not node.args and not node.keywords:
+                # zero-arg .join() can't be str.join (that needs an iterable)
+                yield node, (
+                    ".join() without a timeout (a wedged thread/process "
+                    "parks the joiner forever — pass a bound)"
+                )
+                continue
+            if attr == "join" and (node.args or node.keywords):
+                # thread/process join with explicit timeout=None
+                kw = keyword(node, "timeout")
+                if kw is not None and is_none_constant(kw):
+                    yield node, ".join(timeout=None) is unbounded"
+                elif (not node.keywords and len(node.args) == 1
+                      and is_none_constant(node.args[0])):
+                    yield node, ".join(None) is unbounded"
+                continue
+            if attr == "communicate" and not has_finite_timeout(node):
+                yield node, (
+                    ".communicate() without timeout= blocks until the child "
+                    "exits"
+                )
+                continue
+            if attr == "result" and not node.args and keyword(node, "timeout") is None:
+                yield node, (
+                    ".result() without timeout= parks on the future forever"
+                )
+                continue
+            if attr == "settimeout" and node.args and is_none_constant(node.args[0]):
+                yield node, "settimeout(None) makes the socket blocking-forever"
+                continue
+            if (attr == "get" and not node.args
+                    and keyword(node, "timeout") is None
+                    and _receiver_hints_queue(func)):
+                yield node, (
+                    "queue .get() without timeout= blocks forever if the "
+                    "producer dies"
+                )
+                continue
+
+        if dotted in _SUBPROCESS_FUNCS and keyword(node, "timeout") is None:
+            yield node, f"{dotted}() without timeout= can hang on the child"
+            continue
+        if dotted in ("socket.create_connection",) and len(node.args) < 2 \
+                and keyword(node, "timeout") is None:
+            yield node, (
+                "socket.create_connection without timeout= inherits the "
+                "global default (None)"
+            )
+            continue
+        if dotted in ("select.select",) and len(node.args) == 3:
+            yield node, "select.select without a timeout blocks forever"

@@ -1,0 +1,140 @@
+"""Engine: discover files, parse each exactly once, run every rule, apply
+suppressions, split against the baseline."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from .baseline import Baseline, DEFAULT_BASELINE
+from .findings import Finding
+from .registry import all_rules
+from .source import ParsedFile
+
+_SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules", ".venv"}
+
+
+@dataclass
+class Project:
+    """Everything the rules may look at: parsed files plus the repo root
+    (finalize passes read non-Python artifacts like docs through it)."""
+
+    root: str
+    files: list = field(default_factory=list)   # list[ParsedFile]
+
+    def file(self, rel: str):
+        for pf in self.files:
+            if pf.rel == rel:
+                return pf
+        return None
+
+    def read_text(self, rel: str) -> str | None:
+        path = os.path.join(self.root, rel)
+        try:
+            with open(path) as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)       # non-baselined
+    baselined: list = field(default_factory=list)
+    parse_errors: list = field(default_factory=list)   # list[Finding] TPURX999
+    stale_baseline: list = field(default_factory=list)
+    unjustified_baseline: list = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+    def all_raw(self):
+        return self.findings + self.baselined
+
+
+def discover(paths, root: str):
+    """Yield (abs, rel) for every .py file under the given paths."""
+    seen = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        ap = os.path.abspath(ap)
+        if os.path.isfile(ap):
+            if ap.endswith(".py") and ap not in seen:
+                seen.add(ap)
+                yield ap, os.path.relpath(ap, root).replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                f = os.path.join(dirpath, fn)
+                if f in seen:
+                    continue
+                seen.add(f)
+                yield f, os.path.relpath(f, root).replace(os.sep, "/")
+
+
+def parse_project(paths, root: str) -> tuple:
+    project = Project(root=os.path.abspath(root))
+    errors = []
+    for path, rel in discover(paths, root):
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            project.files.append(ParsedFile.parse(path, rel, text))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(Finding(
+                rule="TPURX999", path=rel,
+                line=getattr(e, "lineno", None) or 1,
+                message=f"unparseable: {e}"))
+    return project, errors
+
+
+def run_lint(paths=None, root=None, baseline_path=None,
+             use_baseline: bool = True, rule_ids=None) -> LintResult:
+    """Run every (or the selected) rule over `paths` relative to `root`.
+
+    Suppression directives are applied first (their misuse surfaces as
+    TPURX900), then the baseline splits what's left into new vs grandfathered.
+    """
+    root = os.path.abspath(root or os.getcwd())
+    paths = list(paths) if paths else ["tpu_resiliency", "tests", "benchmarks"]
+    project, parse_errors = parse_project(paths, root)
+
+    rules = all_rules()
+    if rule_ids:
+        wanted = set(rule_ids)
+        rules = [r for r in rules if r.rule_id in wanted]
+
+    raw = []
+    for pf in project.files:
+        raw.extend(pf.directive_findings)
+        for rule in rules:
+            if not rule.applies_to(pf.rel):
+                continue
+            raw.extend(rule.check_file(pf))
+    for rule in rules:
+        raw.extend(rule.finalize(project))
+
+    kept = []
+    for f in raw:
+        pf = project.file(f.path)
+        if (pf is not None and f.rule != "TPURX900"
+                and pf.is_suppressed(f.rule, f.line)):
+            continue
+        kept.append(f)
+    kept.sort(key=Finding.sort_key)
+
+    result = LintResult(parse_errors=parse_errors)
+    if use_baseline:
+        bl = Baseline.load(baseline_path or DEFAULT_BASELINE)
+        result.findings, result.baselined = bl.split(kept)
+        # stale/justification audits only make sense over a full-rule run
+        if not rule_ids:
+            result.stale_baseline = bl.stale(kept)
+            result.unjustified_baseline = bl.unjustified()
+    else:
+        result.findings = kept
+    return result

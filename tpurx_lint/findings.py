@@ -1,0 +1,37 @@
+"""Finding record shared by every rule, the engine, and the reporters."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location.
+
+    ``symbol`` is the stripped source line the finding anchors to — baseline
+    matching keys on (rule, path, symbol) rather than the line number so that
+    unrelated edits above a grandfathered site don't invalidate the baseline.
+    """
+
+    rule: str
+    path: str           # repo-relative, posix separators
+    line: int           # 1-indexed
+    message: str
+    symbol: str = ""    # stripped source line content at `line`
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "symbol": self.symbol,
+        }
+
+    @staticmethod
+    def sort_key(f: "Finding"):
+        return (f.path, f.line, f.rule)

@@ -1,0 +1,60 @@
+"""Rule registry: stable IDs, one instance per rule, discovery for the CLI.
+
+A rule is a class with:
+
+- ``rule_id``: stable ``TPURXnnn`` identifier (never reused, never renumbered)
+- ``name``: short kebab-case handle shown in reports
+- ``rationale``: one-paragraph why (surfaces in ``--list-rules`` and docs)
+- ``scope``: tuple of repo-relative path prefixes the rule examines
+- ``exclude``: exact repo-relative paths exempt from the rule (the sanctioned
+  home of the pattern, e.g. ``utils/retry.py`` for the retry-loop ban)
+- ``check_file(pf)``: yield ``Finding``s for one ``ParsedFile``
+- ``finalize(project)``: optional cross-file pass after every file is parsed
+"""
+
+from __future__ import annotations
+
+_RULES: dict = {}
+
+
+class Rule:
+    rule_id = ""
+    name = ""
+    rationale = ""
+    scope: tuple = ("tpu_resiliency/",)
+    exclude: tuple = ()
+
+    def applies_to(self, rel: str) -> bool:
+        if rel in self.exclude:
+            return False
+        return any(rel.startswith(p) for p in self.scope)
+
+    def check_file(self, pf):
+        return ()
+
+    def finalize(self, project):
+        return ()
+
+
+def register(cls):
+    """Class decorator: instantiate and index by rule id."""
+    inst = cls()
+    if not inst.rule_id or inst.rule_id in _RULES:
+        raise ValueError(f"bad or duplicate rule id: {inst.rule_id!r}")
+    _RULES[inst.rule_id] = inst
+    return cls
+
+
+def all_rules():
+    _load()
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def get_rule(rule_id: str):
+    _load()
+    return _RULES[rule_id]
+
+
+def _load():
+    if not _RULES:
+        from . import rules  # noqa: F401  (imports register every rule)

@@ -1,0 +1,14 @@
+"""Importing this package registers every rule (via the @register decorator)."""
+
+from . import (  # noqa: F401
+    prints,
+    raw_reads,
+    wall_clock,
+    flat_gather,
+    deadlines,
+    abort_path,
+    retry_loops,
+    threads,
+    exceptions,
+    envvars,
+)

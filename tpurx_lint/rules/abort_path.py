@@ -1,0 +1,143 @@
+"""TPURX006: abort-path safety.
+
+Code reachable from an ``AbortStage.run`` implementation, a signal handler,
+or the monitor kill path runs while the process is already wedged or dying:
+an unbounded blocking call there turns a recoverable fault into a silent
+hang, and a freshly spawned thread there outlives (and wedges) teardown.
+
+Reachability is computed per file: roots are ``run``/``abort`` methods of
+classes whose bases name ``AbortStage``, callables handed to
+``signal.signal``, and an explicit extra-roots table for the monitor kill
+path; edges follow bare-name calls to module functions and ``self.x()``
+calls to same-class methods.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, class_base_names
+from ..blocking import unbounded_blocking_calls
+from ..registry import Rule, register
+
+# the monitor kill path: functions that run between "rank declared dead" and
+# "SIGKILL delivered" — same no-unbounded-blocking contract as abort stages
+EXTRA_ROOTS = {
+    "tpu_resiliency/inprocess/monitor_thread.py": {"_run", "stop"},
+    "tpu_resiliency/fault_tolerance/rank_monitor_server.py": {"_default_kill"},
+}
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+# the overridable stage surface of AbortStage subclasses
+_STAGE_METHODS = ("run", "abort", "release", "applicable", "__call__")
+
+
+def _index_functions(tree):
+    """(module_funcs: name->node, methods: (class,name)->node)"""
+    module_funcs, methods = {}, {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[(node.name, sub.name)] = sub
+    return module_funcs, methods
+
+
+def _roots(pf):
+    """Yield (func_node, why) abort-path entry points in this file."""
+    module_funcs, methods = _index_functions(pf.tree)
+    # signal handlers are often nested in a main(): index every def by name
+    all_funcs = {
+        n.name: n for n in ast.walk(pf.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+    for (cls, name), node in methods.items():
+        if name in _STAGE_METHODS:
+            cls_node = next(
+                n for n in pf.tree.body
+                if isinstance(n, ast.ClassDef) and n.name == cls
+            )
+            if any("AbortStage" in b for b in class_base_names(cls_node)):
+                yield node, f"{cls}.{name} (AbortStage implementation)"
+
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Call)
+                and call_name(node) in ("signal.signal",)
+                and len(node.args) == 2):
+            handler = node.args[1]
+            if isinstance(handler, ast.Name) and handler.id in all_funcs:
+                yield all_funcs[handler.id], (
+                    f"{handler.id} (signal handler)")
+            elif isinstance(handler, ast.Lambda):
+                yield handler, f"signal handler lambda at line {handler.lineno}"
+
+    for name in EXTRA_ROOTS.get(pf.rel, ()):
+        for key, node in list(methods.items()) + list(module_funcs.items()):
+            fname = key[1] if isinstance(key, tuple) else key
+            if fname == name:
+                yield node, f"{name} (monitor kill path)"
+
+
+def _callees(func_node, module_funcs, methods, own_class):
+    """Function nodes this function calls, resolved within the file."""
+    for node in ast.walk(func_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in module_funcs:
+            yield module_funcs[f.id]
+        elif (isinstance(f, ast.Attribute)
+              and isinstance(f.value, ast.Name) and f.value.id == "self"
+              and own_class is not None
+              and (own_class, f.attr) in methods):
+            yield methods[(own_class, f.attr)]
+
+
+@register
+class AbortPathSafetyRule(Rule):
+    rule_id = "TPURX006"
+    name = "abort-path-safety"
+    rationale = (
+        "Code reachable from AbortStage.run implementations, signal "
+        "handlers, and the monitor kill path may not perform unbounded "
+        "blocking waits or spawn threads — it runs while the process is "
+        "already wedged, so anything it parks on is lost."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def check_file(self, pf):
+        module_funcs, methods = _index_functions(pf.tree)
+        node_class = {n: cls for (cls, _n), n in methods.items()}
+
+        seen = {}
+        queue = [(node, why) for node, why in _roots(pf)]
+        while queue:
+            node, why = queue.pop()
+            if node in seen:
+                continue
+            seen[node] = why
+            for callee in _callees(node, module_funcs, methods,
+                                   node_class.get(node)):
+                if callee not in seen:
+                    queue.append((callee, why))
+
+        for func, why in seen.items():
+            for node in ast.walk(func):
+                if (isinstance(node, ast.Call)
+                        and call_name(node) in _THREAD_CTORS):
+                    yield pf.finding(
+                        self.rule_id, node,
+                        f"thread spawned on the abort path (reachable from "
+                        f"{why}) — a thread born during teardown outlives it",
+                    )
+            for node, desc in unbounded_blocking_calls(pf, func):
+                yield pf.finding(
+                    self.rule_id, node,
+                    f"unbounded blocking call on the abort path (reachable "
+                    f"from {why}): {desc}",
+                )

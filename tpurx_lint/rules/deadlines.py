@@ -1,0 +1,30 @@
+"""TPURX005: every blocking wait in the library carries a finite timeout.
+
+The failure mode this kills: a recovery path parks on an Event/Condition/
+process that the fault it is recovering FROM prevents from ever firing — the
+silent-hang class the reliable-CCL literature attributes most lost pods to.
+A deliberate forever-wait is fine, but it must say so in a suppression
+reason so the next reader knows the unbounding is load-bearing.
+"""
+
+from __future__ import annotations
+
+from ..blocking import unbounded_blocking_calls
+from ..registry import Rule, register
+
+
+@register
+class DeadlineDisciplineRule(Rule):
+    rule_id = "TPURX005"
+    name = "deadline-discipline"
+    rationale = (
+        "Every blocking store/event/condition/process/socket/join wait in "
+        "the library must carry a finite timeout (or an explicit suppression "
+        "with a reason) — an unbounded wait in a recovery path is a silent "
+        "hang when the peer is the thing that failed."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def check_file(self, pf):
+        for node, desc in unbounded_blocking_calls(pf):
+            yield pf.finding(self.rule_id, node, desc)

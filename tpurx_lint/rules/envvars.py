@@ -1,0 +1,132 @@
+"""TPURX010: every TPURX_* knob is declared once, typed, defaulted, and
+documented — reads go through the utils/env.py registry.
+
+54 knobs accreted over seven PRs, each read site re-deciding its own default
+and parse ("!= '0'" here, "== '1'" there).  The registry gives each knob one
+name, one type, one default, one doc line; this rule bans literal TPURX_*
+environment reads everywhere else and cross-checks the registry against
+docs/configuration.md.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_chain, call_name
+from ..findings import Finding
+from ..registry import Rule, register
+
+ENV_MODULE = "tpu_resiliency/utils/env.py"
+DOC_PATH = "docs/configuration.md"
+
+
+def _module_string_consts(tree) -> dict:
+    """Module-level NAME = "literal" bindings (the ENV_FOO = "TPURX_FOO"
+    idiom) so reads through the constant are still attributed to the knob."""
+    out = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _tpurx_literal_in(expr, consts) -> str:
+    """First string (constant or resolved module constant) starting with
+    TPURX_ inside the key expression."""
+    for sub in ast.walk(expr):
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and sub.value.startswith("TPURX_")):
+            return sub.value
+        if isinstance(sub, ast.Name):
+            val = consts.get(sub.id, "")
+            if val.startswith("TPURX_"):
+                return val
+    return ""
+
+
+def _env_read_key(node: ast.AST, consts) -> str:
+    """TPURX key literal when `node` reads the environment, else ''."""
+    if isinstance(node, ast.Call):
+        dotted = call_name(node)
+        if dotted in ("os.getenv", "os.environ.get") and node.args:
+            return _tpurx_literal_in(node.args[0], consts)
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+        if attr_chain(node.value) == "os.environ":
+            return _tpurx_literal_in(node.slice, consts)
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+        if attr_chain(node.comparators[0]) == "os.environ":
+            return _tpurx_literal_in(node.left, consts)
+    return ""
+
+
+def declared_knob_names(env_pf) -> list:
+    """(name, lineno) for every Knob("NAME", ...) literal in env.py."""
+    out = []
+    for node in ast.walk(env_pf.tree):
+        if (isinstance(node, ast.Call)
+                and call_name(node).split(".")[-1] == "Knob"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+@register
+class EnvRegistryRule(Rule):
+    rule_id = "TPURX010"
+    name = "env-registry"
+    rationale = (
+        "All TPURX_* environment reads route through the typed registry in "
+        "utils/env.py (one declared name/type/default/doc per knob); every "
+        "declared knob must be cataloged in docs/configuration.md."
+    )
+    scope = ("tpu_resiliency/", "benchmarks/")
+    exclude = (ENV_MODULE,)
+
+    def check_file(self, pf):
+        consts = _module_string_consts(pf.tree)
+        for node in ast.walk(pf.tree):
+            key = _env_read_key(node, consts)
+            if key:
+                yield pf.finding(
+                    self.rule_id, node,
+                    f"raw environment read of {key!r} — declare the knob in "
+                    f"utils/env.py and read it through the registry",
+                )
+
+    def finalize(self, project):
+        env_pf = project.file(ENV_MODULE)
+        if env_pf is None:
+            return
+        declared = declared_knob_names(env_pf)
+        seen = {}
+        for name, lineno in declared:
+            if name in seen:
+                yield env_pf.finding(
+                    self.rule_id, lineno,
+                    f"knob {name} declared more than once (first at line "
+                    f"{seen[name]})",
+                )
+            else:
+                seen[name] = lineno
+        doc = project.read_text(DOC_PATH)
+        if doc is None:
+            yield Finding(
+                rule=self.rule_id, path=DOC_PATH, line=1,
+                message=f"{DOC_PATH} is missing — regenerate it with "
+                        f"'python -m tpu_resiliency.utils.env --write'",
+            )
+            return
+        for name, lineno in declared:
+            if name not in doc:
+                yield env_pf.finding(
+                    self.rule_id, lineno,
+                    f"knob {name} is not documented in {DOC_PATH} — "
+                    f"regenerate it with 'python -m tpu_resiliency.utils.env "
+                    f"--write'",
+                )

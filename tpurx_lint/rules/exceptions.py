@@ -1,0 +1,63 @@
+"""TPURX009: exception hygiene in fault-handling modules.
+
+A swallowed exception in a fault handler converts a diagnosable failure into
+a mis-attributed one: the fault surfaces later, somewhere else, stripped of
+its cause — the exact mis-attribution class the Chameleon/reliable-CCL
+papers trace silent degradation to.  Bare ``except:`` is banned everywhere
+in the library; ``except Exception:`` whose body only ``pass``es is banned
+in the fault-handling trees.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import body_is_swallow
+from ..registry import Rule, register
+
+FAULT_HANDLING_PREFIXES = (
+    "tpu_resiliency/inprocess/",
+    "tpu_resiliency/fault_tolerance/",
+    "tpu_resiliency/health/",
+    "tpu_resiliency/checkpointing/",
+    "tpu_resiliency/store/",
+    "tpu_resiliency/ops/",
+    "tpu_resiliency/straggler/",
+    "tpu_resiliency/utils/",
+)
+
+
+@register
+class ExceptionHygieneRule(Rule):
+    rule_id = "TPURX009"
+    name = "exception-hygiene"
+    rationale = (
+        "No bare except anywhere; no swallow-all 'except Exception: pass' in "
+        "fault-handling modules — narrow the type, log it, or suppress with "
+        "the reason the drop is safe."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def check_file(self, pf):
+        in_fault_tree = pf.rel.startswith(FAULT_HANDLING_PREFIXES)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield pf.finding(
+                    self.rule_id, node,
+                    "bare 'except:' catches SystemExit/KeyboardInterrupt — "
+                    "name the exception type",
+                )
+                continue
+            if not in_fault_tree:
+                continue
+            broad = (isinstance(node.type, ast.Name)
+                     and node.type.id in ("Exception", "BaseException"))
+            if broad and body_is_swallow(node):
+                yield pf.finding(
+                    self.rule_id, node,
+                    f"'except {node.type.id}: pass' swallows every fault in "
+                    f"a fault-handling module — narrow the type, log it, or "
+                    f"suppress with a reason",
+                )

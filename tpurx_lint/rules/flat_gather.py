@@ -1,0 +1,89 @@
+"""TPURX004: cross-rank gather rounds route through the reduction tree."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+_STORE_READ_ATTRS = {"multi_get", "get", "try_get"}
+
+
+def _range_references_world_size(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Name) and call.func.id == "range"):
+        return False
+    for arg in call.args:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Name) and node.id == "world_size":
+                return True
+            if isinstance(node, ast.Attribute) and node.attr == "world_size":
+                return True
+    return False
+
+
+@register
+class FlatGatherRule(Rule):
+    rule_id = "TPURX004"
+    name = "flat-gather"
+    rationale = (
+        "A direct all-ranks-to-one gather (one store key per rank of the "
+        "world) makes rank 0 and the owning shard an O(N) hotspot — route "
+        "the round through store/tree.py's tree_gather so rank-0 inbound "
+        "stays O(fanout)."
+    )
+    scope = ("tpu_resiliency/",)
+    exclude = (
+        # the sanctioned reduction-tree helper itself
+        "tpu_resiliency/store/tree.py",
+        # post-mortem reads of possibly-dead ranks: no collective possible
+        "tpu_resiliency/attribution/trace_analyzer.py",
+        # single-process emulation moving BULK blob bytes, not control metadata
+        "tpu_resiliency/checkpointing/local/ici_replication.py",
+    )
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            # shape 1: multi_get(<comprehension over range(world_size)>)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "multi_get"
+            ):
+                for arg in node.args:
+                    comps = [
+                        c
+                        for sub in ast.walk(arg)
+                        if isinstance(sub, (ast.ListComp, ast.GeneratorExp,
+                                            ast.SetComp))
+                        for c in sub.generators
+                    ]
+                    if any(
+                        isinstance(c.iter, ast.Call)
+                        and _range_references_world_size(c.iter)
+                        for c in comps
+                    ):
+                        yield pf.finding(
+                            self.rule_id, node,
+                            "multi_get over range(world_size) — flat gather; "
+                            "route the round through tree_gather",
+                        )
+            # shape 2: store reads inside `for r in range(world_size):`
+            if (
+                isinstance(node, ast.For)
+                and isinstance(node.iter, ast.Call)
+                and _range_references_world_size(node.iter)
+            ):
+                for sub in ast.walk(node):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in _STORE_READ_ATTRS
+                        and isinstance(sub.func.value, (ast.Name, ast.Attribute))
+                        and "store" in ast.dump(sub.func.value).lower()
+                    ):
+                        yield pf.finding(
+                            self.rule_id, sub,
+                            f"store .{sub.func.attr} inside a "
+                            f"range(world_size) loop — flat gather; route the "
+                            f"round through tree_gather",
+                        )

@@ -1,0 +1,43 @@
+"""TPURX001: no bare print() in library modules."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+# argparse mains whose stdout IS the interface
+CLI_ALLOWLIST = (
+    "tpu_resiliency/straggler/inspect.py",
+    "tpu_resiliency/utils/shm_janitor.py",
+    "tpu_resiliency/health/device.py",
+    "tpu_resiliency/fault_tolerance/per_cycle_logs.py",
+    "tpu_resiliency/telemetry/trace.py",
+)
+
+
+@register
+class BarePrintRule(Rule):
+    rule_id = "TPURX001"
+    name = "bare-print"
+    rationale = (
+        "A bare print() in a library module bypasses rank prefixes, the log "
+        "funnel, and level control — use utils.logging.get_logger, or mark a "
+        "genuine argparse CLI with a file-level suppression."
+    )
+    scope = ("tpu_resiliency/",)
+    exclude = CLI_ALLOWLIST
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield pf.finding(
+                    self.rule_id, node,
+                    "bare print() in a library module (use "
+                    "utils.logging.get_logger, or suppress file-wide for a "
+                    "CLI entry point)",
+                )

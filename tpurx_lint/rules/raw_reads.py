@@ -1,0 +1,59 @@
+"""TPURX002: checkpoint bytes only enter through the verifying readers."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+_OS_READ_CALLS = {"read", "pread", "preadv", "readv"}
+
+
+@register
+class RawBinaryReadRule(Rule):
+    rule_id = "TPURX002"
+    name = "raw-ckpt-read"
+    rationale = (
+        "Checkpoint payload bytes must only enter the process through the "
+        "verifying readers in checkpointing/integrity.py — a raw rb-open or "
+        "positioned os.read is a trust-boundary bypass of the corrupt-shard "
+        "quarantine."
+    )
+    scope = ("tpu_resiliency/checkpointing/",)
+    exclude = ("tpu_resiliency/checkpointing/integrity.py",)
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _OS_READ_CALLS
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "os"
+            ):
+                yield pf.finding(
+                    self.rule_id, node,
+                    f"os.{func.attr} of checkpoint data outside the verifying "
+                    f"reader (use integrity.ChunkReader)",
+                )
+                continue
+            if not (isinstance(func, ast.Name) and func.id == "open"):
+                continue
+            mode = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "mode":
+                    mode = kw.value
+            if (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+                and "r" in mode.value
+                and "b" in mode.value
+            ):
+                yield pf.finding(
+                    self.rule_id, node,
+                    "raw rb-open of checkpoint data outside the verifying "
+                    "reader (use integrity.read_verified_blob / "
+                    "read_verified_shard / ChunkReader)",
+                )

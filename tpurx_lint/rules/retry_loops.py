@@ -1,0 +1,77 @@
+"""TPURX007: retry discipline — no hand-rolled while+sleep retry loops.
+
+utils/retry.py is the single home of retry policy (exponential backoff, full
+jitter, overall deadline, per-site telemetry).  A hand-rolled loop silently
+lacks at least one of those: un-jittered retries synchronize thundering
+herds, deadline-less ones hide outages, and untelemetered ones are invisible
+to the policy engine.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+
+def _walk_stop_at_functions(node):
+    """Walk descendants without descending into nested function/class defs."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef, ast.Lambda)):
+            continue
+        yield cur
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _has_sleep(nodes) -> bool:
+    for n in nodes:
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "sleep"):
+            return True
+    return False
+
+
+def _try_exits_on_success(try_node: ast.Try) -> bool:
+    """break/return in the try body or else-clause (the success escape that
+    distinguishes a retry loop from a forever poll loop)."""
+    for part in (try_node.body, try_node.orelse):
+        for stmt in part:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.Break, ast.Return)):
+                    return True
+    return False
+
+
+@register
+class RetryDisciplineRule(Rule):
+    rule_id = "TPURX007"
+    name = "retry-discipline"
+    rationale = (
+        "No while/for + sleep retry loops outside utils/retry.py — "
+        "hand-rolled retries skip the shared jitter/deadline/telemetry "
+        "policy; use retry_call / Retrier / RetryPolicy."
+    )
+    scope = ("tpu_resiliency/",)
+    exclude = ("tpu_resiliency/utils/retry.py",)
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.While, ast.For)):
+                continue
+            body_nodes = list(_walk_stop_at_functions(node))
+            if not _has_sleep(body_nodes):
+                continue
+            tries = [n for n in body_nodes if isinstance(n, ast.Try)
+                     and n.handlers]
+            for t in tries:
+                if _try_exits_on_success(t):
+                    yield pf.finding(
+                        self.rule_id, node,
+                        "hand-rolled retry loop (loop + sleep + try/except "
+                        "with success escape) — use utils.retry.retry_call / "
+                        "Retrier so jitter, deadline, and telemetry apply",
+                    )
+                    break

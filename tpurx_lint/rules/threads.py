@@ -1,0 +1,168 @@
+"""TPURX008: thread lifecycle.
+
+Two checks:
+
+1. Every ``threading.Thread(...)`` is daemon (can't wedge interpreter exit /
+   abort teardown) or provably joined with a finite timeout somewhere in the
+   same module.  A non-daemon, never-joined thread is exactly the shape that
+   hangs the monitor kill path after the main thread is gone.
+
+2. ``# guarded-by: <lock>`` annotations: an attribute assignment carrying the
+   comment declares that every OTHER method of the class must touch
+   ``self.<attr>`` only inside ``with self.<lock>:``.  The declaring function
+   (usually ``__init__``, pre-publication) is exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+
+from ..astutil import attr_chain, call_name, enclosing_class, enclosing_function, \
+    has_finite_timeout
+from ..registry import Rule, register
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _thread_target_chain(pf, call):
+    """Dotted chain the Thread object is bound to ('' when unbound)."""
+    parent = pf.parent(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        return attr_chain(parent.targets[0])
+    if isinstance(parent, ast.AnnAssign) and parent.target is not None:
+        return attr_chain(parent.target)
+    return ""
+
+
+def _module_has_daemon_set(pf, chain: str) -> bool:
+    tail = chain.split(".")[-1]
+    for node in ast.walk(pf.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute) and t.attr == "daemon"
+                        and attr_chain(t.value).split(".")[-1] == tail
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True):
+                    return True
+    return False
+
+
+def _module_has_bounded_join(pf, chain: str) -> bool:
+    tail = chain.split(".")[-1]
+    for node in ast.walk(pf.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and attr_chain(node.func.value).split(".")[-1] == tail
+                and has_finite_timeout(node)):
+            return True
+    return False
+
+
+def _guarded_attrs(pf):
+    """{class_name: {attr: (lock, declaring_func_node)}} from guarded-by
+    comments on self.<attr> assignments."""
+    line_lock = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(pf.text).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _GUARDED_BY_RE.search(tok.string)
+                if m:
+                    line_lock[tok.start[0]] = m.group(1)
+    except tokenize.TokenError:
+        return {}
+    out = {}
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Assign) or node.lineno not in line_lock:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name) and t.value.id == "self"):
+                cls = enclosing_class(pf, node)
+                fn = enclosing_function(pf, node)
+                if cls is not None:
+                    out.setdefault(cls.name, {})[t.attr] = (
+                        line_lock[node.lineno], fn)
+    return out
+
+
+def _under_lock(pf, node, lock_attr: str) -> bool:
+    want = f"self.{lock_attr}"
+    for anc in pf.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if attr_chain(item.context_expr) == want:
+                    return True
+    return False
+
+
+@register
+class ThreadLifecycleRule(Rule):
+    rule_id = "TPURX008"
+    name = "thread-lifecycle"
+    rationale = (
+        "Every threading.Thread must be daemon or joined with a finite "
+        "timeout (a non-daemon never-joined thread wedges abort teardown); "
+        "attributes declared '# guarded-by: <lock>' must be accessed under "
+        "'with self.<lock>:'."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def check_file(self, pf):
+        yield from self._check_threads(pf)
+        yield from self._check_guarded(pf)
+
+    def _check_threads(self, pf):
+        for node in ast.walk(pf.tree):
+            if not (isinstance(node, ast.Call)
+                    and call_name(node) in ("threading.Thread", "Thread")):
+                continue
+            daemon = None
+            for kw in node.keywords:
+                if kw.arg == "daemon":
+                    daemon = kw.value
+            if daemon is not None:
+                if isinstance(daemon, ast.Constant) and daemon.value is False:
+                    yield pf.finding(
+                        self.rule_id, node,
+                        "daemon=False thread — must be joined with a finite "
+                        "timeout or made daemon",
+                    )
+                continue  # daemon=True or a deliberate expression
+            chain = _thread_target_chain(pf, node)
+            if chain and (_module_has_daemon_set(pf, chain)
+                          or _module_has_bounded_join(pf, chain)):
+                continue
+            yield pf.finding(
+                self.rule_id, node,
+                "thread is neither daemon nor joined-with-timeout in this "
+                "module — it can outlive and wedge abort teardown",
+            )
+
+    def _check_guarded(self, pf):
+        guarded = _guarded_attrs(pf)
+        if not guarded:
+            return
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.ClassDef) or node.name not in guarded:
+                continue
+            attrs = guarded[node.name]
+            for sub in ast.walk(node):
+                if not (isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"
+                        and sub.attr in attrs):
+                    continue
+                lock, decl_fn = attrs[sub.attr]
+                fn = enclosing_function(pf, sub)
+                if fn is decl_fn:   # pre-publication init is exempt
+                    continue
+                if not _under_lock(pf, sub, lock):
+                    yield pf.finding(
+                        self.rule_id, sub,
+                        f"self.{sub.attr} is declared guarded-by {lock} but "
+                        f"accessed outside 'with self.{lock}:'",
+                    )

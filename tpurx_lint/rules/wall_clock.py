@@ -1,0 +1,68 @@
+"""TPURX003: liveness stamps derive only from ops/quorum.py clock helpers."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+_STAMP_TOKENS = ("stamp", "beat", "timestamp", "heartbeat")
+
+
+def _target_names(node) -> list:
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+    return out
+
+
+def _calls_wall_clock(expr) -> bool:
+    for sub in ast.walk(expr):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in ("time", "time_ns")
+            and isinstance(sub.func.value, ast.Name)
+            and sub.func.value.id == "time"
+        ):
+            return True
+    return False
+
+
+@register
+class WallClockStampRule(Rule):
+    rule_id = "TPURX003"
+    name = "raw-wall-clock-stamp"
+    rationale = (
+        "Liveness stamps must derive from ops/quorum.py's clock helpers "
+        "(now_stamp_ns / wall_time_s): a raw time.time()-derived stamp "
+        "re-decides the epoch/fold/clock-domain contract locally and breaks "
+        "the wrap-safe age math every detector shares."
+    )
+    scope = ("tpu_resiliency/",)
+    exclude = ("tpu_resiliency/ops/quorum.py",)
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            names = []
+            for t in targets:
+                names.extend(_target_names(t))
+            if not any(
+                tok in name.lower() for name in names for tok in _STAMP_TOKENS
+            ):
+                continue
+            if node.value is not None and _calls_wall_clock(node.value):
+                yield pf.finding(
+                    self.rule_id, node,
+                    "raw time.time()-derived stamp (use quorum.now_stamp_ns / "
+                    "quorum.wall_time_s so the epoch and clock-domain "
+                    "contract has one home)",
+                )

@@ -1,0 +1,169 @@
+"""Per-file parse product: source text, AST (parsed exactly once), parent
+links, and tpurx suppression directives.
+
+Suppression syntax (reason REQUIRED — an inline waiver without a recorded
+why is how grandfathered rot accumulates):
+
+    x = ev.wait()  # tpurx: disable=TPURX005 -- bounded by caller's SIGALRM
+
+    # tpurx: disable=TPURX005 -- bounded by caller's SIGALRM
+    x = ev.wait()
+
+    # tpurx: disable-file=TPURX001 -- argparse CLI, stdout IS the interface
+
+``disable=`` on a line suppresses matching findings on that line; a comment
+alone on its line also covers the next non-blank code line.  ``disable-file=``
+covers the whole file.  Several rules may be listed comma-separated.  A
+directive missing its ``-- reason`` (or naming a malformed rule id) is itself
+reported as TPURX900.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from .findings import Finding
+
+SUPPRESSION_META_RULE = "TPURX900"
+
+_DIRECTIVE_RE = re.compile(
+    r"#\s*tpurx:\s*(?P<kind>disable(?:-file)?)\s*=\s*(?P<rules>[A-Za-z0-9_,\s]+?)"
+    r"(?:\s*--\s*(?P<reason>.*\S))?\s*$"
+)
+_RULE_ID_RE = re.compile(r"^TPURX\d{3}$")
+
+
+@dataclass
+class Suppression:
+    rules: frozenset
+    line: int              # line the directive appears on
+    reason: str
+    file_scope: bool = False
+
+
+@dataclass
+class ParsedFile:
+    """One source file, parsed once, shared by every rule."""
+
+    path: str                   # absolute
+    rel: str                    # repo-relative, posix
+    text: str
+    tree: ast.AST
+    lines: list = field(default_factory=list)
+    suppressions: list = field(default_factory=list)
+    directive_findings: list = field(default_factory=list)
+    _parents: dict = field(default_factory=dict)
+    _line_suppress: dict = field(default_factory=dict)   # line -> set(rule ids)
+    _file_suppress: set = field(default_factory=set)
+
+    @classmethod
+    def parse(cls, path: str, rel: str, text: str) -> "ParsedFile":
+        tree = ast.parse(text, filename=rel)
+        pf = cls(path=path, rel=rel, text=text, tree=tree,
+                 lines=text.splitlines())
+        pf._link_parents()
+        pf._collect_directives()
+        return pf
+
+    # -- AST helpers -------------------------------------------------------
+
+    def _link_parents(self) -> None:
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+
+    def parent(self, node: ast.AST):
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        return Finding(rule=rule, path=self.rel, line=line, message=message,
+                       symbol=self.source_line(line))
+
+    # -- suppression directives -------------------------------------------
+
+    def _collect_directives(self) -> None:
+        code_lines = set()
+        try:
+            toks = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
+        except tokenize.TokenError:
+            toks = []
+        comments = []
+        for tok in toks:
+            if tok.type == tokenize.COMMENT:
+                comments.append(tok)
+            elif tok.type not in (
+                tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+                tokenize.DEDENT, tokenize.ENCODING, tokenize.ENDMARKER,
+            ):
+                for ln in range(tok.start[0], tok.end[0] + 1):
+                    code_lines.add(ln)
+
+        for tok in comments:
+            m = _DIRECTIVE_RE.search(tok.string)
+            if m is None:
+                if re.search(r"#\s*tpurx:", tok.string):
+                    self.directive_findings.append(self.finding(
+                        SUPPRESSION_META_RULE, tok.start[0],
+                        f"malformed tpurx directive {tok.string.strip()!r} "
+                        f"(expected '# tpurx: disable=<RULE,...> -- <reason>')",
+                    ))
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            )
+            reason = (m.group("reason") or "").strip()
+            line = tok.start[0]
+            bad = [r for r in rules if not _RULE_ID_RE.match(r)]
+            if bad:
+                self.directive_findings.append(self.finding(
+                    SUPPRESSION_META_RULE, line,
+                    f"suppression names malformed rule id(s) {sorted(bad)} "
+                    f"(expected TPURXnnn)",
+                ))
+                continue
+            if not reason:
+                self.directive_findings.append(self.finding(
+                    SUPPRESSION_META_RULE, line,
+                    f"suppression for {sorted(rules)} has no reason — append "
+                    f"'-- <why this is safe>' (reasons are required)",
+                ))
+                continue
+            file_scope = m.group("kind") == "disable-file"
+            self.suppressions.append(
+                Suppression(rules=rules, line=line, reason=reason,
+                            file_scope=file_scope))
+            if file_scope:
+                self._file_suppress |= rules
+            else:
+                covered = {line}
+                if line not in code_lines:
+                    # comment on its own line: cover the next code line
+                    nxt = line + 1
+                    limit = len(self.lines)
+                    while nxt <= limit and nxt not in code_lines:
+                        nxt += 1
+                    if nxt <= limit:
+                        covered.add(nxt)
+                for ln in covered:
+                    self._line_suppress.setdefault(ln, set()).update(rules)
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppress:
+            return True
+        return rule in self._line_suppress.get(line, set())
