@@ -159,3 +159,42 @@ def test_wedged_device_call_hard_killed_and_ring_recovers(tmp_path):
     # verdict itself is covered by the stall scenario, where a survivor
     # runs the restart path (here rank 0 completed before the escalation)
     assert "abort ladder: fingerprint=released" in blob
+
+
+def test_abort_ladder_under_lock_order_sanitizer_clean_witness(tmp_path):
+    """Soak smoke lane for the runtime lock-order sanitizer: the layered
+    restart e2e (inner fault -> full abort ladder -> in-process recovery)
+    runs with TPURX_SANITIZE=1.  The sanitizer wraps every lock the wrapper,
+    monitor thread, quorum tripwire, and checkpoint machinery create, and
+    must observe NO runtime lock-order cycle on the abort-ladder path — a
+    cycle would have raised LockOrderViolation and failed the run.  The
+    per-process witness files it leaves are the confirm/prune input for
+    `tpurx-lint --witness` (see docs/lint.md)."""
+    import glob
+    import json
+
+    wit_tpl = str(tmp_path / "witness.r%r.p%p.jsonl")
+    proc = run_layered(
+        tmp_path, "inner",
+        extra_env={
+            "TPURX_SANITIZE": "1",
+            "TPURX_SANITIZE_WITNESS_PATH": wit_tpl,
+        },
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    # recovery completed exactly as without the sanitizer
+    assert proc.stdout.count("ret=done@1") == 2
+    assert "worker failure detected" not in proc.stderr
+    assert "abort ladder:" in proc.stdout + proc.stderr
+
+    paths = glob.glob(str(tmp_path / "witness.r*.jsonl"))
+    assert paths, "sanitizer produced no witness files"
+    edges = 0
+    for p in paths:
+        for line in open(p):
+            rec = json.loads(line)
+            assert rec["event"] != "cycle", (
+                f"runtime lock-order cycle on the abort path: {rec}")
+            if rec["event"] == "edge":
+                edges += 1
+    assert edges > 0, "sanitizer observed no lock acquisitions at all"

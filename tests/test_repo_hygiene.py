@@ -29,7 +29,7 @@ from tpurx_lint import run_lint
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 PKG = os.path.join(REPO, "tpu_resiliency")
 
-LINT_PATHS = ["tpu_resiliency", "tests", "benchmarks"]
+LINT_PATHS = ["tpu_resiliency", "tests", "benchmarks", "tpurx_lint"]
 
 
 def _tracked_files():

@@ -1,0 +1,196 @@
+"""Store-key lifecycle fixes driven by lint rule TPURX013, plus the
+bounded background-save join (TPURX012 burndown).
+
+The leaks these pin down: per-iteration in-process protocol keys
+(interruption/fingerprint logs, completion markers, iteration barriers)
+and per-generation ICI-replication blob rows accumulated in the
+control-plane store for the life of the job — O(restarts) and O(rounds)
+growth that a 10k-rank job turns into a store OOM.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.inprocess.store_ops import InprocStore
+from tpu_resiliency.store.barrier import (
+    barrier, barrier_keys, gc_barrier, reentrant_barrier,
+)
+from tpu_resiliency.store.client import StoreTimeout
+
+
+class FakeStore:
+    """Dict-backed stand-in implementing the KV surface the protocol uses."""
+
+    def __init__(self):
+        self.kv = {}
+
+    @staticmethod
+    def _b(value):
+        return value if isinstance(value, bytes) else str(value).encode()
+
+    def set(self, key, value):
+        self.kv[key] = self._b(value)
+
+    def append(self, key, value):
+        self.kv[key] = self.kv.get(key, b"") + self._b(value)
+        return len(self.kv[key])
+
+    def add(self, key, amount):
+        cur = int(self.kv.get(key, b"0"))
+        cur += amount
+        self.kv[key] = str(cur).encode()
+        return cur
+
+    def get(self, key, timeout=None):
+        return self.kv[key]
+
+    def try_get(self, key):
+        return self.kv.get(key)
+
+    def check(self, keys):
+        return all(k in self.kv for k in keys)
+
+    def wait(self, keys, timeout=None):
+        if not self.check(keys):
+            raise StoreTimeout(f"missing {keys}")
+
+    def delete(self, key):
+        return self.kv.pop(key, None) is not None
+
+
+class TestBarrierGC:
+    def test_barrier_keys_cover_both_flavors(self):
+        ks = barrier_keys("x/b", generation=0)
+        assert "barrier/x/b/count" in ks
+        assert "barrier/x/b/arrivals" in ks
+        assert "barrier/x/b/done" in ks
+
+    def test_gc_barrier_removes_counting_barrier_keys(self):
+        store = FakeStore()
+        barrier(store, "r/b", world_size=1, timeout=1.0)
+        assert any(k.startswith("barrier/r/b") for k in store.kv)
+        gc_barrier(store, "r/b")
+        assert not any(k.startswith("barrier/r/b") for k in store.kv)
+
+    def test_gc_barrier_removes_reentrant_keys_per_generation(self):
+        store = FakeStore()
+        reentrant_barrier(store, "it/b", rank=0, world_size=1,
+                          timeout=1.0, generation=3)
+        assert any("/g3/" in k for k in store.kv)
+        gc_barrier(store, "it/b", generation=3)
+        assert not store.kv
+
+    def test_gc_is_idempotent(self):
+        store = FakeStore()
+        gc_barrier(store, "never/ran")   # no keys: no error
+
+
+class TestIterationKeyGC:
+    def _populate(self, ops, iteration):
+        from tpu_resiliency.inprocess.attribution import (
+            Interruption, InterruptionRecord,
+        )
+        ops.record_interruption(iteration, InterruptionRecord(
+            rank=0, interruption=Interruption.EXCEPTION, message="x"))
+        ops.record_fingerprint(iteration, 0, [("op", 1)])
+        ops.mark_completed(iteration)
+        ops.iteration_barrier(iteration, 0, [0], timeout=1.0)
+
+    def test_gc_iteration_removes_all_round_keys(self):
+        store = FakeStore()
+        ops = InprocStore(store)
+        self._populate(ops, 0)
+        self._populate(ops, 1)
+        n_before = len(store.kv)
+        assert n_before > 0
+        ops.gc_iteration(0)
+        # every iter-0 key gone, every iter-1 key intact
+        assert not [k for k in store.kv if "/iter/0/" in k], store.kv
+        assert [k for k in store.kv if "/iter/1/" in k]
+        # protocol reads degrade to empty, not errors
+        assert ops.get_interruptions(0) == []
+        assert not ops.get_fingerprints(0)
+        assert not ops.any_completed(0)
+
+    def test_gc_iteration_negative_is_noop(self):
+        store = FakeStore()
+        ops = InprocStore(store)
+        ops.gc_iteration(-1)
+        ops.gc_iteration(-2)
+        assert not store.kv
+
+    def test_durable_keys_survive_gc(self):
+        store = FakeStore()
+        ops = InprocStore(store)
+        ops.mark_terminated(3)
+        ops.heartbeat(0)
+        self._populate(ops, 0)
+        ops.gc_iteration(0)
+        assert ops.terminated_ranks() == [3]
+        assert ops.last_heartbeat(0) is not None
+
+
+class TestIciReplicationGC:
+    def test_gen2_blob_rows_and_barrier_are_collected(self):
+        from tpu_resiliency.checkpointing.local.ici_replication import (
+            IciReplication,
+        )
+        import numpy as np
+
+        store = FakeStore()
+        rep = IciReplication.__new__(IciReplication)
+        rep.store = store
+        rep.rank = 0
+        rep.world_size = 1
+        rep._sync_gen = 0
+
+        buf = np.zeros(16, dtype=np.uint8)
+        buf[:8] = np.frombuffer(np.uint64(8).tobytes(), dtype=np.uint8)
+        for _ in range(4):
+            rep._assemble_single_process(buf, 16, None)
+        live_gens = {
+            k.split("/")[2] for k in store.kv
+            if k.startswith("ici_repl/blob/")
+        }
+        # rounds 0 and 1 were GC'd when rounds 2 and 3 started
+        assert "0" not in live_gens and "1" not in live_gens
+        assert {"2", "3"} <= live_gens
+        assert not [k for k in store.kv
+                    if k.startswith("barrier/ici_repl/blob/0")]
+
+
+class TestBoundedBackgroundSaveJoin:
+    """TPURX012 burndown: a wedged background local save used to park every
+    caller of manager.wait() forever; now it raises, naming the thread."""
+
+    def _manager(self, tmp_path):
+        from tpu_resiliency.checkpointing.local.manager import (
+            LocalCheckpointManager,
+        )
+        return LocalCheckpointManager(
+            root_dir=str(tmp_path), rank=0, world_size=1)
+
+    def test_wedged_save_raises_instead_of_hanging(self, tmp_path):
+        mgr = self._manager(tmp_path)
+        release = threading.Event()
+        mgr._bg = threading.Thread(
+            target=release.wait, kwargs={"timeout": 30.0},
+            name="wedged-save", daemon=True)
+        mgr._bg.start()
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="wedged-save"):
+            mgr.wait(timeout=0.2)
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+
+    def test_completed_save_joins_and_surfaces_errors(self, tmp_path):
+        mgr = self._manager(tmp_path)
+        mgr._bg = threading.Thread(target=lambda: None, daemon=True)
+        mgr._bg.start()
+        mgr.wait(timeout=5.0)
+        assert mgr._bg is None
+        mgr._bg_error = ValueError("boom")
+        with pytest.raises(RuntimeError, match="boom"):
+            mgr.wait(timeout=5.0)

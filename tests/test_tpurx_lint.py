@@ -43,9 +43,9 @@ def rules_of(findings):
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_ten_rules_with_stable_ids(self):
+    def test_thirteen_rules_with_stable_ids(self):
         ids = [r.rule_id for r in all_rules()]
-        assert ids == [f"TPURX{n:03d}" for n in range(1, 11)]
+        assert ids == [f"TPURX{n:03d}" for n in range(1, 14)]
 
     def test_every_rule_documents_itself(self):
         for r in all_rules():
@@ -386,6 +386,124 @@ class TestEnvRegistry:
 
 
 # ---------------------------------------------------------------------------
+# whole-program tier (TPURX011-013) — see test_lockorder_analysis.py for the
+# deep call-graph/lock-order fixtures; these are the one-firing/one-passing
+# cases the rule-addition checklist requires
+# ---------------------------------------------------------------------------
+
+class TestLockOrder:
+    def test_fires_on_intra_class_inversion(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, rule="TPURX011")
+        assert rules_of(fs) == {"TPURX011"}
+        assert any("PLAUSIBLE" in f.message and "deadlock" in f.message
+                   for f in fs)
+
+    def test_passes_consistent_order(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """, rule="TPURX011")
+
+
+class TestDeadlinePropagation:
+    def test_fires_on_dead_and_dropped_deadline(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            class C:
+                def join(self, timeout):
+                    self._cv.wait()
+        """, rule="TPURX012")
+        msgs = [f.message for f in fs]
+        assert any("never reads it" in m for m in msgs)
+        assert any("drops it" in m for m in msgs)
+
+    def test_passes_threaded_deadline(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            class C:
+                def join(self, timeout):
+                    self._cv.wait(timeout=timeout)
+        """, rule="TPURX012")
+
+    def test_fires_on_call_site_drop(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def blocking_helper(timeout=None):
+                ev().wait(timeout=timeout)
+
+            def outer(deadline):
+                x = deadline  # read, so no dead-deadline finding
+                blocking_helper()
+        """, rule="TPURX012")
+        assert len(fs) == 1
+        assert "stops propagating" in fs[0].message
+
+    def test_passes_call_site_bound(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def blocking_helper(timeout=None):
+                ev().wait(timeout=timeout)
+
+            def outer(deadline):
+                blocking_helper(timeout=deadline)
+        """, rule="TPURX012")
+
+
+class TestStoreKeyLifecycle:
+    def test_fires_on_undeleted_round_key(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/store/proto.py", """
+            def publish(store, round_no, rank):
+                store.set(f"round/{round_no}/r{rank}", b"1")
+        """, rule="TPURX013")
+        assert rules_of(fs) == {"TPURX013"}
+        assert "round" in fs[0].message
+
+    def test_passes_with_delete_path_and_singleton(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/store/proto.py", """
+            def publish(store, round_no, rank):
+                store.set(f"round/{round_no}/r{rank}", b"1")
+                store.set("round_singleton", b"1")
+
+            def gc(store, round_no, rank):
+                store.delete(f"round/{round_no}/r{rank}")
+        """, rule="TPURX013")
+
+    def test_append_on_fixed_key_still_fires(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/store/proto.py", """
+            def log(store, rank):
+                store.append("audit_log", f"{rank},")
+        """, rule="TPURX013")
+        assert rules_of(fs) == {"TPURX013"}
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -506,9 +624,10 @@ class TestBaseline:
 class TestRepoGate:
     @pytest.fixture(scope="class")
     def repo_result(self):
+        # the gate lints the linter too (self-check), with the whole-program
+        # tier enabled and --jobs auto — exactly what CI runs
         t0 = time.monotonic()
-        result = run_lint(paths=["tpu_resiliency", "tests", "benchmarks"],
-                          root=REPO)
+        result = run_lint(root=REPO, jobs="auto")
         result.elapsed = time.monotonic() - t0
         return result
 
@@ -523,19 +642,182 @@ class TestRepoGate:
         assert not repo_result.stale_baseline, [
             e.key() for e in repo_result.stale_baseline]
 
-    def test_full_repo_lint_is_fast(self, repo_result):
-        # acceptance bound is 10s; leave slack for loaded CI hosts
-        assert repo_result.elapsed < 30.0, f"{repo_result.elapsed:.1f}s"
+    def test_full_repo_lint_perf_floor(self, repo_result):
+        # PR 8's per-file-only run measured 3.8s; the whole-program tier
+        # (symbol table + call graph + 3 interprocedural rules) must stay
+        # within 2x that with --jobs auto (measured ~6.0s single-core).
+        # Bound carries ~2.5x slack for loaded CI hosts.
+        assert repo_result.elapsed < 19.0, f"{repo_result.elapsed:.1f}s"
+
+    def test_lints_itself(self, repo_result):
+        # self-check: the tpurx_lint package is part of the default gate
+        from tpurx_lint.engine import DEFAULT_PATHS
+        assert "tpurx_lint" in DEFAULT_PATHS
 
     def test_cli_json_output(self):
         import subprocess
         import sys
         out = subprocess.run(
-            [sys.executable, "-m", "tpurx_lint", "tpu_resiliency/",
-             "tests/", "benchmarks/", "--format=json"],
+            [sys.executable, "-m", "tpurx_lint", "--format=json"],
             cwd=REPO, capture_output=True, text=True, timeout=120,
         )
         assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
         data = json.loads(out.stdout)
         assert data["ok"] is True
         assert data["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+# The structural subset of the SARIF 2.1.0 schema that CI annotators rely
+# on: required top-level fields, driver rules with ids, results with ruleId/
+# message/locations/regions.  (The full OASIS schema is ~500KB; this captures
+# every property the spec marks `required` on the objects we emit.)
+SARIF_21_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {
+            "type": "array", "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object", "required": ["driver"],
+                        "properties": {"driver": {
+                            "type": "object", "required": ["name"],
+                            "properties": {
+                                "name": {"type": "string"},
+                                "rules": {"type": "array", "items": {
+                                    "type": "object", "required": ["id"],
+                                }},
+                            },
+                        }},
+                    },
+                    "results": {"type": "array", "items": {
+                        "type": "object",
+                        "required": ["message"],
+                        "properties": {
+                            "ruleId": {"type": "string"},
+                            "level": {"enum": ["none", "note", "warning",
+                                               "error"]},
+                            "message": {"type": "object",
+                                        "required": ["text"]},
+                            "locations": {"type": "array", "items": {
+                                "type": "object",
+                                "properties": {"physicalLocation": {
+                                    "type": "object",
+                                    "properties": {
+                                        "artifactLocation": {
+                                            "type": "object",
+                                            "properties": {"uri": {
+                                                "type": "string"}},
+                                        },
+                                        "region": {
+                                            "type": "object",
+                                            "properties": {"startLine": {
+                                                "type": "integer",
+                                                "minimum": 1}},
+                                        },
+                                    },
+                                }},
+                            }},
+                        },
+                    }},
+                },
+            },
+        },
+    },
+}
+
+
+class TestSarif:
+    def _render(self, tmp_path):
+        from tpurx_lint.sarif import render
+        mod = tmp_path / "tpu_resiliency" / "mod.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text("def f(ev):\n    ev.wait()\n")
+        result = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                          use_baseline=False)
+        return render(result, all_rules(), str(tmp_path))
+
+    def test_validates_against_sarif_210_schema(self, tmp_path):
+        jsonschema = pytest.importorskip("jsonschema")
+        log = self._render(tmp_path)
+        jsonschema.validate(log, SARIF_21_SUBSET_SCHEMA)
+        assert log["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in log["$schema"]
+
+    def test_findings_carry_stable_fingerprints(self, tmp_path):
+        log = self._render(tmp_path)
+        results = log["runs"][0]["results"]
+        assert any(r["ruleId"] == "TPURX005" for r in results)
+        for r in results:
+            assert r["partialFingerprints"]["tpurxContentKey/v1"]
+        # fingerprint keys on content, not line: re-render after a shift
+        mod = tmp_path / "tpu_resiliency" / "mod.py"
+        mod.write_text("import os\n\ndef f(ev):\n    ev.wait()\n")
+        result2 = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                           use_baseline=False)
+        from tpurx_lint.sarif import render
+        log2 = render(result2, all_rules(), str(tmp_path))
+        fp = {r["partialFingerprints"]["tpurxContentKey/v1"]
+              for r in log["runs"][0]["results"] if r["ruleId"] == "TPURX005"}
+        fp2 = {r["partialFingerprints"]["tpurxContentKey/v1"]
+               for r in log2["runs"][0]["results"] if r["ruleId"] == "TPURX005"}
+        assert fp == fp2
+
+    def test_cli_sarif_output(self):
+        import subprocess
+        import sys
+        out = subprocess.run(
+            [sys.executable, "-m", "tpurx_lint", "tpurx_lint/",
+             "--format=sarif"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+        data = json.loads(out.stdout)
+        assert data["version"] == "2.1.0"
+        assert data["runs"][0]["tool"]["driver"]["name"] == "tpurx-lint"
+
+
+# ---------------------------------------------------------------------------
+# parallel engine
+# ---------------------------------------------------------------------------
+
+class TestParallelJobs:
+    def test_jobs_equals_serial_findings(self, tmp_path):
+        for i in range(6):
+            mod = tmp_path / "tpu_resiliency" / f"m{i}.py"
+            mod.parent.mkdir(parents=True, exist_ok=True)
+            mod.write_text(
+                f"def f{i}(ev):\n    ev.wait()\n    print('x')\n")
+        serial = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                          use_baseline=False, jobs=1)
+        par = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                       use_baseline=False, jobs=3)
+        key = lambda fs: sorted((f.rule, f.path, f.line) for f in fs)  # noqa: E731
+        assert key(par.findings) == key(serial.findings)
+        assert len(serial.findings) == 12  # wait + print per module
+
+    def test_suppressions_apply_across_jobs(self, tmp_path):
+        mod = tmp_path / "tpu_resiliency" / "m.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(
+            "def f(ev):\n"
+            "    ev.wait()  # tpurx: disable=TPURX005 -- bounded by caller\n")
+        par = run_lint(paths=[str(tmp_path)], root=str(tmp_path),
+                       use_baseline=False, jobs=2)
+        assert not par.findings
+
+    def test_resolve_jobs(self):
+        from tpurx_lint.engine import resolve_jobs
+        assert resolve_jobs(None) == 1
+        assert resolve_jobs(4) == 4
+        assert resolve_jobs("auto") >= 1
+        assert resolve_jobs(0) >= 1

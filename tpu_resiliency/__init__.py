@@ -25,3 +25,18 @@ SURVEY.md for the reference layer map this mirrors):
 """
 
 __version__ = "0.1.0"
+
+# Opt-in lock-order sanitizer: must patch threading.Lock/RLock BEFORE any
+# library object constructs its locks, and every component import passes
+# through this package __init__ — so this is the earliest reliable hook.
+# The gate is a raw presence peek: importing utils.env eagerly here would
+# pre-import it under `python -m tpu_resiliency.utils.env` (runpy warning);
+# the TYPED read happens inside sanitize.install_from_env ("0" still
+# disables).
+import os as _os  # noqa: E402
+
+# tpurx: disable=TPURX010 -- bootstrap presence peek only; the typed registry read is sanitize.install_from_env's env.SANITIZE.get()
+if _os.environ.get("TPURX_SANITIZE"):
+    from .utils import sanitize as _sanitize  # noqa: E402
+
+    _sanitize.install_from_env()

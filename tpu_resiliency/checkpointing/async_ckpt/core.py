@@ -172,7 +172,7 @@ class _PipeWorker:
             self.proc.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             self.proc.kill()
-            self.proc.wait()  # tpurx: disable=TPURX005 -- SIGKILL just sent; exit is kernel-guaranteed
+            self.proc.wait()  # tpurx: disable=TPURX005,TPURX012 -- SIGKILL just sent; exit is kernel-guaranteed, no deadline needed
 
     def kill(self) -> None:
         if self.alive:

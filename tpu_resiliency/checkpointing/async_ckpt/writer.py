@@ -384,7 +384,10 @@ class _WriteEngine:
             self._closed = True
             self._cv.notify_all()
             while self._pending_chunks > 0 and self._error is None:
-                self._cv.wait()
+                # bounded wait inside a predicate loop: a lost notify (or a
+                # worker dying between decrement and notify) re-checks within
+                # 5s instead of parking the drain forever
+                self._cv.wait(timeout=5.0)
             err = self._error
         for t in self._threads:
             t.join()
@@ -464,7 +467,8 @@ class _WriteEngine:
                     return None
                 if waited_t0 is None:
                     waited_t0 = time.monotonic_ns()
-                self._cv.wait()
+                # predicate loop re-checks every 5s: lost-notify insurance
+                self._cv.wait(timeout=5.0)
 
     def _worker(self) -> None:
         while True:
@@ -875,7 +879,8 @@ class _RestoreEngine:
                         return dq.popleft()
                 if self._pending <= 0:
                     return None
-                self._cv.wait()
+                # predicate loop re-checks every 5s: lost-notify insurance
+                self._cv.wait(timeout=5.0)
 
     def _worker(self) -> None:
         try:

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ...store.barrier import barrier
+from ...store.barrier import barrier, gc_barrier
 from ...utils.logging import get_logger
 
 log = get_logger("local_ckpt.ici")
@@ -178,6 +178,13 @@ class IciReplication:
         gen = self._sync_gen
         self._sync_gen += 1
         prefix = f"ici_repl/blob/{gen}"
+        # gen-2 GC: by the time round `gen` starts, every rank has passed the
+        # round-(gen-2) barrier twice over — its blob rows (full checkpoint
+        # bytes!) and barrier keys are settled and deletable (TPURX013)
+        if gen >= 2:
+            self.store.delete(f"ici_repl/blob/{gen - 2}/r{self.rank}")
+            if self.rank == 0:
+                gc_barrier(self.store, f"ici_repl/blob/{gen - 2}/b")
         self.store.set(f"{prefix}/r{self.rank}", buf.tobytes())
         barrier(self.store, f"{prefix}/b", self.world_size, timeout=120.0)
         rows = []

@@ -225,7 +225,7 @@ class LocalCheckpointManager:
             )
 
         if is_async:
-            self.wait()
+            self.wait(timeout=600.0)
 
             def _bg_main():
                 try:
@@ -239,11 +239,23 @@ class LocalCheckpointManager:
         else:
             _write_and_publish()
 
-    def wait(self) -> None:
+    def wait(self, timeout: float = 600.0) -> None:
         """Join the background save; raises if it failed (a silently-lost
-        local checkpoint would defeat the fast-recovery path)."""
+        local checkpoint would defeat the fast-recovery path).
+
+        Bounded: a background save wedged in I/O used to park every caller —
+        train-end drain, ``find_candidates``, the next ``save`` — forever
+        (deadline-propagation finding TPURX012).  Now the join times out and
+        raises, naming the save, so the restore ladder can surface the hang
+        instead of inheriting it.
+        """
         if self._bg is not None:
-            self._bg.join()
+            self._bg.join(timeout=timeout)
+            if self._bg.is_alive():
+                raise TimeoutError(
+                    f"background local save did not finish within {timeout}s "
+                    f"(thread {self._bg.name}); the save thread is wedged"
+                )
             self._bg = None
         if self._bg_error is not None:
             err, self._bg_error = self._bg_error, None
@@ -253,6 +265,7 @@ class LocalCheckpointManager:
         if self.store is None:
             return
         holdings = {str(k): v for k, v in self._holdings().items()}
+        # tpurx: disable=TPURX013 -- one holdings key per rank, overwritten on every publish; the namespace is cycle-fenced so growth is bounded by world_size x max_restarts
         self.store.set(f"{self._ns}/holdings/{self.rank}", json.dumps(holdings))
 
     def _cleanup(self) -> None:
@@ -431,7 +444,7 @@ class LocalCheckpointManager:
     def find_candidates(self, gather_timeout: float = 60.0) -> List[int]:
         """Fully-covered iterations, newest first — the fallback ladder's
         rungs.  Collective (one holdings gather round)."""
-        self.wait()
+        self.wait(timeout=gather_timeout)
         coverage = self._gather_coverage(gather_timeout)
         everyone = set(range(self.world_size))
         return sorted(
@@ -507,7 +520,7 @@ class LocalCheckpointManager:
         if iteration is None:
             iteration, blob, depth = self._load_ladder(fallback)
         else:
-            self.wait()
+            self.wait(timeout=600.0)
             blob = self._obtain_blob(iteration)
         # zero-copy parse: device_put consumes the views straight out of the
         # blob; host leaves are copied out by to_tree (views never escape).

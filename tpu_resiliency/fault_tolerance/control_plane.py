@@ -47,6 +47,7 @@ def run(
     if native:
         from ..store.native import NativeStoreServer
 
+        # tpurx: disable=TPURX012 -- round_timeout bounds rendezvous rounds, not server startup: start()'s own default bounds the native-store spawn probe
         server = NativeStoreServer(
             host=host, port=port, journal=journal,
             journal_strip_prefixes=[K_SHUTDOWN],

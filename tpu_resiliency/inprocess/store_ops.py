@@ -12,7 +12,7 @@ from __future__ import annotations
 import time
 from typing import List, Optional
 
-from ..store.barrier import reentrant_barrier
+from ..store.barrier import gc_barrier, reentrant_barrier
 from .attribution import InterruptionRecord
 
 NS = "inproc"
@@ -98,6 +98,7 @@ class InprocStore:
         # order of terminations (each read is a prefix of the same log).
         # Stateful rank-assignment policies (Tree) replay this order, so a
         # canonical order is load-bearing, not cosmetic.
+        # tpurx: disable=TPURX013 -- lifetime log, not a round key: policies replay the full order for the group's whole life, and growth is bounded by world_size (a rank terminates once)
         self.store.append(f"{self.ns}/terminated_log", f"{rank},".encode())
 
     def terminated_ranks(self) -> List[int]:
@@ -118,6 +119,7 @@ class InprocStore:
     # -- sibling heartbeats ------------------------------------------------
 
     def heartbeat(self, rank: int) -> None:
+        # tpurx: disable=TPURX013 -- one key per rank, overwritten in place: bounded by world_size, never grows with rounds
         self.store.set(f"{self.ns}/hb/{rank}", str(time.time()))
 
     def last_heartbeat(self, rank: int) -> Optional[float]:
@@ -152,3 +154,23 @@ class InprocStore:
         reentrant_barrier(
             self.store, f"{self.ns}/initial_barrier", rank, world_size, timeout=timeout
         )
+
+    # -- per-iteration key GC ---------------------------------------------
+
+    def gc_iteration(self, iteration: int) -> None:
+        """Delete a SETTLED iteration's protocol keys (idempotent).
+
+        The per-iteration keys (interruption log, fingerprint log, completion
+        marker, iteration barrier) previously accumulated for the life of the
+        store — O(restarts) growth per wrapper group, found by lint rule
+        TPURX013.  The wrapper calls this for iteration ``i-2`` when the
+        iteration-``i`` barrier closes: by then every surviving rank has
+        advanced twice past ``i-2``, so nobody can still read or re-enter its
+        keys (the same two-generation settling the tree-gather GC uses).
+        """
+        if iteration < 0:
+            return
+        self.store.delete(self.k_interruptions(iteration))
+        self.store.delete(self.k_fingerprints(iteration))
+        self.store.delete(self.k_completed(iteration))
+        gc_barrier(self.store, f"{self.ns}/iter/{iteration}/barrier")

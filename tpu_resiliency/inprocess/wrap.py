@@ -24,7 +24,7 @@ import time
 from typing import Any, Callable, Optional
 
 from ..store.barrier import BarrierTimeout
-from ..store.client import StoreClient, store_from_env
+from ..store.client import StoreClient, StoreError, store_from_env
 from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
@@ -539,6 +539,16 @@ class CallWrapper:
                 )
                 return None
             phase_t0 = _observe_phase("iteration_barrier", phase_t0)
+            # the iteration-i barrier closing means every survivor advanced
+            # past i-2: its interruption/fingerprint/barrier keys are settled
+            # and can be GC'd (idempotent; any rank may do it)
+            if state.initial_rank == 0:
+                try:
+                    self.ops.gc_iteration(iteration - 2)
+                except (OSError, StoreError) as exc:
+                    # GC is best-effort: a store hiccup here must never turn
+                    # a successful recovery round into a failure
+                    log.debug("iteration key GC skipped: %r", exc)
             state.rank = state.initial_rank
             state.world_size = state.initial_world_size
             self._assign()

@@ -217,17 +217,21 @@ class LocalCheckpointCallback(Callback):
     caller's global saves for durability; ``resume()`` prefers the freshest
     fully-covered local checkpoint over the global one."""
 
-    def __init__(self, manager, get_state, local_interval: int = 50):
+    def __init__(self, manager, get_state, local_interval: int = 50,
+                 drain_timeout: float = 600.0):
         self.manager = manager
         self.get_state = get_state
         self.local_interval = local_interval
+        self.drain_timeout = drain_timeout
 
     def on_step_end(self, step: int = 0, **ctx) -> None:
         if step > 0 and step % self.local_interval == 0:
             self.manager.save(self.get_state(), iteration=step, is_async=True)
 
     def on_train_end(self, **ctx) -> None:
-        self.manager.wait()
+        # bounded drain: a wedged background save raises here (naming the
+        # save thread) instead of hanging train end forever
+        self.manager.wait(timeout=self.drain_timeout)
 
     def resume(self, template, global_iteration: Optional[int] = None):
         """Returns (tree, iteration, source) — local wins if fresher."""

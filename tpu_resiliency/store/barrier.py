@@ -21,6 +21,15 @@ round/iteration counter in ``name`` instead).
 Both poll in timeout chunks so a hung peer is reported as
 :class:`BarrierTimeout` — which now NAMES the missing ranks (decoded from
 the arrival log) rather than just counting them.
+
+Key lifecycle: a barrier's keys cannot be deleted at completion — a rank
+re-entering (reentrant barrier) or arriving last (counting barrier) must
+still observe ``done``, and an immediate delete reopens exactly the hang
+the reentrancy exists to close.  Instead callers GC *settled* rounds with
+:func:`gc_barrier` once no participant can re-enter them — the in-process
+wrapper deletes iteration ``i-2``'s barrier when iteration ``i`` closes,
+mirroring the ``store/tree.py`` consumed-child-key discipline (lint rule
+TPURX013 enforces that every ephemeral key has such a path).
 """
 
 from __future__ import annotations
@@ -159,3 +168,32 @@ def reentrant_barrier(
                 store.set(done_key, b"1")
                 return
             continue
+
+
+def barrier_keys(name: str, generation: int = 0) -> List[str]:
+    """Every store key either barrier flavor may have created for ``name``.
+
+    The counting and reentrant barriers share the ``barrier/<name>`` prefix;
+    returning the union keeps one GC path correct for both.
+    """
+    gen = f"/g{generation}" if generation else ""
+    return [
+        f"barrier/{name}/count",
+        f"barrier/{name}/done",
+        f"barrier/{name}{gen}/arrivals",
+        f"barrier/{name}{gen}/done",
+    ]
+
+
+def gc_barrier(store, name: str, generation: int = 0) -> None:
+    """Delete a SETTLED barrier's keys (idempotent).
+
+    Only call once no participant can re-enter ``name`` — typically two
+    rounds later (the wrapper GCs iteration ``i-2`` when ``i`` closes).
+    Deleting a live barrier reintroduces the lost-arrival hang.
+    """
+    gen = f"/g{generation}" if generation else ""
+    store.delete(f"barrier/{name}/count")
+    store.delete(f"barrier/{name}/done")
+    store.delete(f"barrier/{name}{gen}/arrivals")
+    store.delete(f"barrier/{name}{gen}/done")

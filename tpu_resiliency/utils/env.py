@@ -321,6 +321,16 @@ SKIP_JAX_LANE_CHECK = Knob(
     "TPURX_SKIP_JAX_LANE_CHECK", bool, False,
     "Skip the jax-version compatibility probe of the straggler "
     "device lane.", group="health")
+SANITIZE = Knob(
+    "TPURX_SANITIZE", bool, False,
+    "Opt-in runtime lock-order sanitizer: wraps threading.Lock/RLock, "
+    "records the cross-thread acquisition DAG, and raises "
+    "LockOrderViolation on a runtime lock-order cycle.", group="health")
+SANITIZE_WITNESS_PATH = Knob(
+    "TPURX_SANITIZE_WITNESS_PATH", str, None,
+    "JSONL witness sink for the lock-order sanitizer (%r = rank, "
+    "%p = pid); feed it back with 'tpurx-lint --witness <file>' to "
+    "confirm or prune static TPURX011 cycles.", group="health")
 
 # -- attribution / LLM ------------------------------------------------------
 LLM_BASE_URL = Knob(

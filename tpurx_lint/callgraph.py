@@ -1,0 +1,477 @@
+"""Whole-program symbol table + module-qualified call graph.
+
+Built once per lint run from the already-parsed ``Project`` (no re-parsing)
+and shared by every interprocedural rule: TPURX011 (lock-order), TPURX012
+(deadline propagation), TPURX013 (store-key lifecycle).  The graph is
+deliberately conservative — it resolves only what it can prove:
+
+- bare-name calls to same-module functions and ``from x import f`` imports;
+- ``self.m()`` to methods of the same class and its repo-resolvable bases;
+- ``mod.f()`` through ``import mod`` / ``import pkg.mod as alias``;
+- ``ClassName.m()`` and ``ClassName(...).m()``;
+- ``self.attr.m()`` / ``var.m()`` where the attribute/local was assigned from
+  a repo-class constructor (one level of flow-insensitive type inference).
+
+Anything dynamic resolves to nothing: the rules built on top over-report
+nothing from edges that do not exist, and the runtime sanitizer witness
+(``tpurx-lint --witness``) closes the gap from the other side.
+
+Qualified names are dotted: ``pkg.mod.func`` and ``pkg.mod.Class.method``.
+Lock declarations (``self.x = threading.Lock()``, module-level ``X =
+threading.Condition()``) are indexed here too, because lock identity and the
+call graph must agree on ownership.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .astutil import attr_chain, call_name
+
+_LOCK_KINDS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock",
+    "RLock": "RLock",
+    "Condition": "Condition",
+}
+
+
+def module_name(rel: str) -> str:
+    """Repo-relative posix path -> dotted module name."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+@dataclass
+class LockDecl:
+    """One lock/condition declaration site: the unit of lock identity.
+
+    Granularity is (owner, attr) — every instance of a class shares one
+    identity, which is exactly what the runtime witness keys on (creation
+    site), so static and runtime views compare 1:1.
+    """
+
+    owner: str              # class qname or module name
+    attr: str               # attribute / module-level name
+    kind: str               # Lock | RLock | Condition
+    rel: str
+    line: int
+    wraps: str | None = None   # attr of the lock a Condition was built over
+
+    @property
+    def lock_id(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+    @property
+    def site(self) -> str:
+        return f"{self.rel}:{self.line}"
+
+    @property
+    def reentrant(self) -> bool:
+        # Condition() wraps an RLock by default; Condition(lock) aliases
+        # `lock` and is resolved to it before edges are built.
+        return self.kind in ("RLock", "Condition")
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    node: ast.AST           # FunctionDef / AsyncFunctionDef
+    pf: object              # ParsedFile
+    module: str
+    cls: str | None = None  # owning class qname
+
+    # deadline-ish parameter names, in signature order
+    deadline_params: list = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    qname: str
+    node: ast.ClassDef
+    pf: object
+    module: str
+    bases: list = field(default_factory=list)       # resolvable base qnames
+    methods: dict = field(default_factory=dict)     # name -> FunctionInfo
+    attr_types: dict = field(default_factory=dict)  # attr -> class qname
+    locks: dict = field(default_factory=dict)       # attr -> LockDecl
+    param_attrs: dict = field(default_factory=dict)  # __init__ param -> attr
+
+
+_DEADLINE_HINTS = ("timeout", "deadline")
+
+
+def is_deadline_param(name: str) -> bool:
+    low = name.lower()
+    return any(h in low for h in _DEADLINE_HINTS)
+
+
+def _param_names(node) -> list:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    names += [a.arg for a in args.kwonlyargs]
+    return names
+
+
+class CallGraph:
+    """Symbol table + call edges for one ``Project``."""
+
+    def __init__(self):
+        self.modules: dict = {}      # module name -> ParsedFile
+        self.functions: dict = {}    # qname -> FunctionInfo
+        self.classes: dict = {}      # qname -> ClassInfo
+        self.imports: dict = {}      # module -> {local name -> qualified}
+        self.locks: dict = {}        # lock_id -> LockDecl
+        self.locks_by_site: dict = {}  # "rel:line" -> LockDecl
+        self._callee_cache: dict = {}
+        self._local_type_cache: dict = {}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def build(cls, project) -> "CallGraph":
+        cg = cls()
+        for pf in project.files:
+            cg.modules[module_name(pf.rel)] = pf
+        for mod, pf in cg.modules.items():
+            cg._index_module(mod, pf)
+        for mod, pf in cg.modules.items():
+            cg._collect_imports(mod, pf)
+        for ci in list(cg.classes.values()):
+            cg._infer_class(ci)
+        # constructor-param propagation: `self.x = param` in __init__ picks up
+        # the type of what call sites actually pass (back-references like
+        # Worker(self) are how cross-module lock cycles arise); two passes so
+        # one level of chaining resolves
+        for _ in range(2):
+            cg._propagate_ctor_params()
+        return cg
+
+    def _index_module(self, mod: str, pf) -> None:
+        for node in pf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._add_function(mod, pf, node, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                cq = f"{mod}.{node.name}"
+                ci = ClassInfo(qname=cq, node=node, pf=pf, module=mod)
+                self.classes[cq] = ci
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = self._add_function(mod, pf, sub, cls=cq)
+                        ci.methods[sub.name] = fi
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    decl = LockDecl(owner=mod, attr=node.targets[0].id,
+                                    kind=kind, rel=pf.rel, line=node.lineno)
+                    self._add_lock(decl)
+
+    def _add_function(self, mod, pf, node, cls):
+        qname = f"{cls}.{node.name}" if cls else f"{mod}.{node.name}"
+        fi = FunctionInfo(qname=qname, node=node, pf=pf, module=mod, cls=cls)
+        fi.deadline_params = [
+            p for p in _param_names(node)
+            if p not in ("self", "cls") and is_deadline_param(p)
+        ]
+        self.functions[qname] = fi
+        return fi
+
+    def _add_lock(self, decl: LockDecl) -> None:
+        self.locks[decl.lock_id] = decl
+        self.locks_by_site[decl.site] = decl
+
+    def _collect_imports(self, mod: str, pf) -> None:
+        table: dict = {}
+        pkg_parts = mod.split(".")[:-1]
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    table[local] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    src = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    src = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    table[local] = f"{src}.{alias.name}" if src else alias.name
+        self.imports[mod] = table
+
+    def _infer_class(self, ci: ClassInfo) -> None:
+        # resolvable bases
+        for b in ci.node.bases:
+            bq = self._resolve_symbol(ci.module, b)
+            if bq in self.classes:
+                ci.bases.append(bq)
+        # attribute types + lock declarations from `self.x = ...` anywhere
+        for node in ast.walk(ci.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            t = node.targets[0]
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name) and t.value.id == "self"):
+                continue
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                wraps = None
+                if kind == "Condition" and isinstance(node.value, ast.Call) \
+                        and node.value.args:
+                    arg = node.value.args[0]
+                    chain = attr_chain(arg)
+                    if chain.startswith("self."):
+                        wraps = chain[5:]
+                decl = LockDecl(owner=ci.qname, attr=t.attr, kind=kind,
+                                rel=ci.pf.rel, line=node.lineno, wraps=wraps)
+                ci.locks[t.attr] = decl
+                self._add_lock(decl)
+                continue
+            if isinstance(node.value, ast.Call):
+                cq = self._resolve_symbol(ci.module, node.value.func)
+                if cq in self.classes:
+                    ci.attr_types[t.attr] = cq
+        # `self.x = <param>` inside __init__: remember which param lands in
+        # which attribute, so call-site types can be propagated in
+        init = ci.methods.get("__init__")
+        if init is not None:
+            params = set(_param_names(init.node)) - {"self"}
+            for node in ast.walk(init.node):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in params):
+                    ci.param_attrs[node.value.id] = node.targets[0].attr
+
+    def _propagate_ctor_params(self) -> None:
+        for fi in list(self.functions.values()):
+            local_types = self._local_types(fi)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                cq = self._resolve_symbol(fi.module, node.func)
+                ci = self.classes.get(cq)
+                if ci is None or not ci.param_attrs:
+                    continue
+                init = self.lookup_method(cq, "__init__")
+                if init is None:
+                    continue
+                names = [a.arg for a in init.node.args.args]
+                if names and names[0] == "self":
+                    names = names[1:]
+                bindings = list(zip(names, node.args)) + [
+                    (kw.arg, kw.value) for kw in node.keywords if kw.arg]
+                for pname, expr in bindings:
+                    attr = ci.param_attrs.get(pname)
+                    if attr is None or attr in ci.attr_types:
+                        continue
+                    ptype = self._expr_type(fi, expr, local_types)
+                    if ptype:
+                        ci.attr_types[attr] = ptype
+
+    def _expr_type(self, fi, expr, local_types) -> str:
+        """Class qname of an expression, where provable."""
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and fi.cls:
+                return fi.cls
+            return local_types.get(expr.id, "")
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self" and fi.cls):
+            ci = self.classes.get(fi.cls)
+            return (ci.attr_types.get(expr.attr, "") if ci else "")
+        if isinstance(expr, ast.Call):
+            cq = self._resolve_symbol(fi.module, expr.func)
+            return cq if cq in self.classes else ""
+        return ""
+
+    # -- resolution --------------------------------------------------------
+
+    def _resolve_symbol(self, mod: str, node) -> str:
+        """Qualified name of a Name/Attribute expression in module `mod`."""
+        chain = attr_chain(node)
+        if not chain or chain.startswith("?"):
+            return ""
+        head, _, rest = chain.partition(".")
+        table = self.imports.get(mod, {})
+        if head in table:
+            base = table[head]
+        elif f"{mod}.{head}" in self.classes or f"{mod}.{head}" in self.functions:
+            base = f"{mod}.{head}"
+        elif head in self.modules:
+            base = head
+        else:
+            return ""
+        return f"{base}.{rest}" if rest else base
+
+    def class_of(self, qname: str):
+        return self.classes.get(qname)
+
+    def lookup_method(self, class_qname: str, name: str,
+                      _seen=None) -> FunctionInfo | None:
+        """Method resolution through repo-resolvable bases."""
+        seen = _seen or set()
+        if class_qname in seen:
+            return None
+        seen.add(class_qname)
+        ci = self.classes.get(class_qname)
+        if ci is None:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            hit = self.lookup_method(b, name, seen)
+            if hit is not None:
+                return hit
+        return None
+
+    def lookup_lock(self, class_qname: str, attr: str,
+                    _seen=None) -> LockDecl | None:
+        """Lock attr through bases, resolving Condition(lock) aliasing."""
+        seen = _seen or set()
+        if class_qname in seen:
+            return None
+        seen.add(class_qname)
+        ci = self.classes.get(class_qname)
+        if ci is None:
+            return None
+        decl = ci.locks.get(attr)
+        if decl is not None:
+            if decl.wraps and decl.wraps != attr:
+                aliased = self.lookup_lock(decl.owner, decl.wraps)
+                if aliased is not None:
+                    return aliased
+            return decl
+        for b in ci.bases:
+            hit = self.lookup_lock(b, attr, seen)
+            if hit is not None:
+                return hit
+        return None
+
+    def _local_types(self, fi: FunctionInfo) -> dict:
+        """var name -> class qname for `v = ClassName(...)` in the body."""
+        cached = self._local_type_cache.get(fi.qname)
+        if cached is not None:
+            return cached
+        out = {}
+        for node in ast.walk(fi.node):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                cq = self._resolve_symbol(fi.module, node.value.func)
+                if cq in self.classes:
+                    out[node.targets[0].id] = cq
+        self._local_type_cache[fi.qname] = out
+        return out
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call,
+                     local_types: dict | None = None):
+        """FunctionInfo of the repo function `call` invokes (or None).
+
+        Returns (callee, via_self): via_self is True when the call provably
+        targets the SAME instance (``self.m()``) — lock identity follows.
+        """
+        func = call.func
+        if isinstance(func, ast.Name):
+            target = self._resolve_symbol(fi.module, func)
+            if target in self.functions:
+                return self.functions[target], False
+            if target in self.classes:      # ClassName(...) -> __init__
+                hit = self.lookup_method(target, "__init__")
+                return hit, False
+            return None, False
+        if not isinstance(func, ast.Attribute):
+            return None, False
+
+        recv, meth = func.value, func.attr
+        # self.m() -> same class (and bases)
+        if isinstance(recv, ast.Name) and recv.id == "self" and fi.cls:
+            hit = self.lookup_method(fi.cls, meth)
+            if hit is not None:
+                return hit, True
+            return None, False
+        # self.attr.m() via inferred attribute type
+        if (isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self" and fi.cls):
+            ci = self.classes.get(fi.cls)
+            cq = ci.attr_types.get(recv.attr) if ci else None
+            if cq:
+                hit = self.lookup_method(cq, meth)
+                if hit is not None:
+                    return hit, False
+            return None, False
+        # var.m() via local constructor assignment
+        if isinstance(recv, ast.Name):
+            if local_types is None:
+                local_types = self._local_types(fi)
+            cq = local_types.get(recv.id)
+            if cq:
+                hit = self.lookup_method(cq, meth)
+                if hit is not None:
+                    return hit, False
+        # mod.f() / pkg.mod.f() / ClassName.m() / ClassName(...).m()
+        if isinstance(recv, ast.Call):
+            cq = self._resolve_symbol(fi.module, recv.func)
+            if cq in self.classes:
+                hit = self.lookup_method(cq, meth)
+                if hit is not None:
+                    return hit, False
+            return None, False
+        target = self._resolve_symbol(fi.module, func)
+        if target in self.functions:
+            return self.functions[target], False
+        if target in self.classes:
+            hit = self.lookup_method(target, "__init__")
+            return hit, False
+        return None, False
+
+    def callees(self, qname: str) -> list:
+        """[(callee_qname, line, via_self)] for every resolvable call."""
+        cached = self._callee_cache.get(qname)
+        if cached is not None:
+            return cached
+        fi = self.functions.get(qname)
+        out = []
+        if fi is not None:
+            local_types = self._local_types(fi)
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    callee, via_self = self.resolve_call(fi, node, local_types)
+                    if callee is not None and callee.qname != qname:
+                        out.append((callee.qname, node.lineno, via_self))
+        self._callee_cache[qname] = out
+        return out
+
+    def closure(self, qname: str, max_depth: int = 12) -> set:
+        """Every function qname reachable from `qname` (inclusive)."""
+        seen = {qname}
+        frontier = [(qname, 0)]
+        while frontier:
+            cur, d = frontier.pop()
+            if d >= max_depth:
+                continue
+            for callee, _line, _vs in self.callees(cur):
+                if callee not in seen:
+                    seen.add(callee)
+                    frontier.append((callee, d + 1))
+        return seen
+
+
+def _lock_ctor_kind(value) -> str | None:
+    """'Lock'/'RLock'/'Condition' when `value` constructs one, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    return _LOCK_KINDS.get(call_name(value))

@@ -11,4 +11,7 @@ from . import (  # noqa: F401
     threads,
     exceptions,
     envvars,
+    lock_order,
+    deadline_prop,
+    store_keys,
 )

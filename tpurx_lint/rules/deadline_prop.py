@@ -1,0 +1,173 @@
+"""TPURX012: interprocedural deadline propagation.
+
+TPURX005 is syntactic — it catches the wait with no bound.  This rule is the
+dataflow upgrade: a function that ACCEPTS a ``timeout``/``deadline``
+parameter made a promise to its caller, and every way of breaking that
+promise inside its body is a finding:
+
+1. **dead deadline** — the parameter is never read: the bound dies at the
+   API boundary (``def join(self, timeout): ... self._cv.wait()``).
+2. **dropped at a wait** — the body performs an unbounded blocking call even
+   though a deadline is in scope (fires together with TPURX005: here the
+   unbounding is a broken contract, not just a missing bound).
+3. **dropped at a call** — the body calls a repo function that itself
+   accepts a deadline parameter and whose closure blocks, without passing
+   any bound: three calls deep is where dropped deadlines hide.
+
+Abstract bodies (``raise NotImplementedError`` / ``...`` / docstring-only)
+are exempt — the contract is the override's to keep.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..blocking import unbounded_blocking_calls
+from ..callgraph import is_deadline_param
+from ..registry import Rule, register
+
+
+def _is_abstract_body(node) -> bool:
+    body = node.body
+    stmts = [s for s in body
+             if not (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))]
+    if not stmts:
+        return True
+    if len(stmts) == 1:
+        s = stmts[0]
+        if isinstance(s, ast.Pass):
+            return True
+        if isinstance(s, ast.Raise):
+            exc = s.exc
+            name = ""
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            if isinstance(exc, ast.Name):
+                name = exc.id
+            return name == "NotImplementedError"
+    return False
+
+
+def _param_reads(node, params: set) -> set:
+    """Deadline params that are actually read somewhere in the body."""
+    read = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id in params:
+            read.add(sub.id)
+    return read
+
+
+def _call_has_bound(call: ast.Call, callee_fi) -> bool:
+    """True when the call site passes SOME deadline argument to the callee."""
+    for kw in call.keywords:
+        if kw.arg is None:       # **kwargs — assume threaded
+            return True
+        if is_deadline_param(kw.arg):
+            return True
+    # positional reach: does any positional land on a deadline param?
+    args = callee_fi.node.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    for i, _a in enumerate(call.args):
+        if i < len(names) and is_deadline_param(names[i]):
+            return True
+        if isinstance(_a, ast.Starred):
+            return True
+    return False
+
+
+@register
+class DeadlinePropagationRule(Rule):
+    rule_id = "TPURX012"
+    name = "deadline-propagation"
+    rationale = (
+        "A function accepting timeout/deadline must thread it into every "
+        "blocking callee reachable in its body — an accepted-then-dropped "
+        "deadline is a caller-visible bound that silently never applies."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def finalize(self, project):
+        cg = project.callgraph()
+        self._blocks_cache = {}
+        self._cg = cg
+        for qname, fi in cg.functions.items():
+            if not self.applies_to(fi.pf.rel):
+                continue
+            if not fi.deadline_params or _is_abstract_body(fi.node):
+                continue
+            params = set(fi.deadline_params)
+            read = _param_reads(fi.node, params)
+
+            for p in fi.deadline_params:
+                if p not in read:
+                    yield fi.pf.finding(
+                        self.rule_id, fi.node.lineno,
+                        f"{qname}() accepts deadline parameter '{p}' but "
+                        f"never reads it — the caller's bound dies at this "
+                        f"boundary (thread it into the blocking calls below, "
+                        f"or drop the parameter)",
+                    )
+
+            for node, desc in unbounded_blocking_calls(fi.pf, fi.node):
+                yield fi.pf.finding(
+                    self.rule_id, node,
+                    f"{qname}() accepts a deadline "
+                    f"({', '.join(sorted(params))}) but this blocking call "
+                    f"drops it: {desc}",
+                )
+
+            local_types = cg._local_types(fi)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee, _vs = cg.resolve_call(fi, node, local_types)
+                if callee is None or not callee.deadline_params:
+                    continue
+                if callee.qname == qname:
+                    continue
+                if _call_has_bound(node, callee):
+                    continue
+                if not self._closure_blocks(callee.qname):
+                    continue
+                yield fi.pf.finding(
+                    self.rule_id, node,
+                    f"{qname}() holds a deadline "
+                    f"({', '.join(sorted(params))}) but calls "
+                    f"{callee.qname}() — which accepts "
+                    f"'{callee.deadline_params[0]}' and blocks — without "
+                    f"passing any bound: the deadline stops propagating here",
+                )
+
+    def _closure_blocks(self, qname: str, _depth=0) -> bool:
+        """Does the callee's call-graph closure contain any blocking call
+        (bounded or not)?  Suppressed wait sites are honored — a wait the
+        author marked load-bearing does not make every caller fire."""
+        cached = self._blocks_cache.get(qname)
+        if cached is not None:
+            return cached
+        self._blocks_cache[qname] = False     # recursion guard
+        cg = self._cg
+        fi = cg.functions.get(qname)
+        result = False
+        if fi is not None and _depth <= 6:
+            for node in ast.walk(fi.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("wait", "join", "communicate",
+                                               "result", "wait_stale",
+                                               "watch_stale")):
+                    if fi.pf.is_suppressed("TPURX005", node.lineno) \
+                            or fi.pf.is_suppressed("TPURX012", node.lineno):
+                        continue
+                    result = True
+                    break
+            if not result:
+                for callee, _line, _vs in cg.callees(qname):
+                    if self._closure_blocks(callee, _depth + 1):
+                        result = True
+                        break
+        self._blocks_cache[qname] = result
+        return result

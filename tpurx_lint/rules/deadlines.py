@@ -23,7 +23,7 @@ class DeadlineDisciplineRule(Rule):
         "with a reason) — an unbounded wait in a recovery path is a silent "
         "hang when the peer is the thing that failed."
     )
-    scope = ("tpu_resiliency/",)
+    scope = ("tpu_resiliency/", "tpurx_lint/")
 
     def check_file(self, pf):
         for node, desc in unbounded_blocking_calls(pf):

@@ -36,7 +36,7 @@ class ExceptionHygieneRule(Rule):
         "fault-handling modules — narrow the type, log it, or suppress with "
         "the reason the drop is safe."
     )
-    scope = ("tpu_resiliency/",)
+    scope = ("tpu_resiliency/", "tpurx_lint/")
 
     def check_file(self, pf):
         in_fault_tree = pf.rel.startswith(FAULT_HANDLING_PREFIXES)

@@ -1,0 +1,349 @@
+"""TPURX011: whole-program lock-order deadlock detection.
+
+Collects every lock acquisition (``with self._lock:``, ``with COND:``,
+``x.acquire()``) across the repo, propagates "acquired while holding" facts
+through the module-qualified call graph, builds the lock-order graph over
+(owner, attr) lock identities, and reports:
+
+- **cycles** — two call paths that take the same pair of locks in opposite
+  orders; a scheduler interleaving away from deadlock.  Reported PLAUSIBLE
+  (per-instance aliasing cannot be proven statically); a runtime sanitizer
+  witness (``tpurx-lint --witness``) promotes them to CONFIRMED or prunes
+  them when the observed order is consistent.
+- **self-deadlocks** — a non-reentrant ``Lock`` provably re-acquired on the
+  same instance (``self.X`` held, closure of self-calls re-acquires
+  ``self.X``).  These are definite: the acquire parks forever.
+
+RLock/Condition re-acquisition is reentrant and never reported.  Lock
+identity is per (class, attr): all instances share one node, matching the
+runtime witness's creation-site granularity.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_chain
+from ..callgraph import LockDecl
+from ..registry import Rule, register
+
+
+def _resolve_lock_expr(cg, fi, expr):
+    """(LockDecl, via_self) for a lock-typed expression, else (None, False)."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None, False
+    parts = chain.split(".")
+    if parts[0] == "self" and fi.cls:
+        if len(parts) == 2:
+            decl = cg.lookup_lock(fi.cls, parts[1])
+            return decl, True
+        if len(parts) == 3:
+            ci = cg.class_of(fi.cls)
+            cq = ci.attr_types.get(parts[1]) if ci else None
+            if cq:
+                return cg.lookup_lock(cq, parts[2]), False
+        return None, False
+    if len(parts) == 1:
+        return cg.locks.get(f"{fi.module}.{parts[0]}"), False
+    if len(parts) == 2:
+        # module-level lock through an import, or var.attr via local type
+        target = cg._resolve_symbol(fi.module, expr)
+        if target in cg.locks:
+            return cg.locks[target], False
+        local_types = cg._local_types(fi)
+        cq = local_types.get(parts[0])
+        if cq:
+            return cg.lookup_lock(cq, parts[1]), False
+    return None, False
+
+
+def _acquire_sites(cg, fi):
+    """[(LockDecl, line, via_self, body_nodes)] for every acquisition in fi.
+
+    ``body_nodes`` is the subtree held under the acquisition (With body) or
+    () for a bare ``.acquire()`` call (held region unknown — still a target
+    for incoming edges, never a source).
+    """
+    out = []
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                decl, via_self = _resolve_lock_expr(cg, fi, item.context_expr)
+                if decl is not None:
+                    out.append((decl, node.lineno, via_self, node.body))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "acquire"):
+            decl, via_self = _resolve_lock_expr(cg, fi, node.func.value)
+            if decl is not None:
+                out.append((decl, node.lineno, via_self, ()))
+    return out
+
+
+@register
+class LockOrderRule(Rule):
+    rule_id = "TPURX011"
+    name = "lock-order"
+    rationale = (
+        "Two threads taking the same pair of locks in opposite orders across "
+        "the call graph is the abort-ladder deadlock class; every acquisition "
+        "order is collected interprocedurally, cycles in the lock-order graph "
+        "are reported with both witness paths, and a runtime sanitizer "
+        "witness can confirm or prune them."
+    )
+    scope = ("tpu_resiliency/",)
+
+    def finalize(self, project):
+        cg = project.callgraph()
+        self._closure_cache = {}
+        self._param_acq_cache = {}
+        self._definite_seen = set()
+        self._cg = cg
+
+        edges = {}          # (a_id, b_id) -> (path_text, anchor_pf, line)
+        definite = []       # self-deadlock findings
+
+        for qname, fi in cg.functions.items():
+            if not self.applies_to(fi.pf.rel):
+                continue
+            for held, hline, via_self, body in _acquire_sites(cg, fi):
+                if not body:
+                    continue
+                self._edges_under(project, fi, held, hline, via_self, body,
+                                  edges, definite)
+
+        yield from definite
+        yield from self._cycle_findings(project, edges)
+
+    # -- edge collection ---------------------------------------------------
+
+    def _edges_under(self, project, fi, held, hline, held_self, body,
+                     edges, definite):
+        cg = self._cg
+        hold_site = f"{fi.pf.rel}:{hline}"
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        decl, via_self = _resolve_lock_expr(cg, fi,
+                                                            item.context_expr)
+                        if decl is None:
+                            continue
+                        self._record(project, fi, held, hold_site, hline,
+                                     held_self, decl, via_self,
+                                     f"{fi.pf.rel}:{node.lineno} "
+                                     f"(acquire {decl.lock_id})",
+                                     edges, definite)
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "acquire"):
+                    decl, via_self = _resolve_lock_expr(cg, fi, node.func.value)
+                    if decl is not None:
+                        self._record(project, fi, held, hold_site, hline,
+                                     held_self, decl, via_self,
+                                     f"{fi.pf.rel}:{node.lineno} "
+                                     f"(acquire {decl.lock_id})",
+                                     edges, definite)
+                elif isinstance(node, ast.Call):
+                    callee, call_self = cg.resolve_call(fi, node)
+                    if callee is None:
+                        continue
+                    # lock handed through a helper: a lock-typed argument the
+                    # callee acquires by parameter name counts as acquired here
+                    for pname, expr in self._call_bindings(callee, node):
+                        if pname not in self._param_acquires(callee.qname):
+                            continue
+                        decl, via_self = _resolve_lock_expr(cg, fi, expr)
+                        if decl is None:
+                            continue
+                        pline = self._param_acquires(callee.qname)[pname]
+                        self._record(project, fi, held, hold_site, hline,
+                                     held_self, decl, via_self,
+                                     f"{fi.pf.rel}:{node.lineno} (hands "
+                                     f"{decl.lock_id} to {callee.qname}) -> "
+                                     f"{callee.pf.rel}:{pline} "
+                                     f"(acquire {decl.lock_id})",
+                                     edges, definite)
+                    for lock_id, (decl, path, via_all) in \
+                            self._acq_closure(callee.qname).items():
+                        step = (f"{fi.pf.rel}:{node.lineno} "
+                                f"(calls {callee.qname})")
+                        self._record(project, fi, held, hold_site, hline,
+                                     held_self, decl,
+                                     call_self and via_all,
+                                     " -> ".join([step] + path),
+                                     edges, definite)
+
+    def _record(self, project, fi, held, hold_site, hline, held_self,
+                acq, acq_self, acq_path, edges, definite):
+        if acq.lock_id == held.lock_id:
+            if held.reentrant:
+                return
+            if held_self and acq_self:
+                dedup = (fi.pf.rel, hline, held.lock_id)
+                if dedup in self._definite_seen:
+                    return
+                self._definite_seen.add(dedup)
+                definite.append(fi.pf.finding(
+                    self.rule_id, hline,
+                    f"self-deadlock: non-reentrant Lock {held.lock_id} "
+                    f"(declared {held.site}) is re-acquired on the same "
+                    f"instance while held here — via {acq_path}; the second "
+                    f"acquire parks forever (use RLock or drop the lock "
+                    f"before the call)",
+                ))
+            return
+        key = (held.lock_id, acq.lock_id)
+        if key not in edges:
+            path = f"{hold_site} (acquire {held.lock_id}) -> {acq_path}"
+            edges[key] = (path, fi.pf, hline)
+
+    @staticmethod
+    def _call_bindings(callee, call: ast.Call):
+        """(param_name, arg_expr) pairs at this call site."""
+        args = callee.node.args
+        names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        out = list(zip(names, call.args))
+        out += [(kw.arg, kw.value) for kw in call.keywords if kw.arg]
+        return out
+
+    def _param_acquires(self, qname: str) -> dict:
+        """param name -> line, for params the function acquires directly."""
+        cached = self._param_acq_cache.get(qname)
+        if cached is not None:
+            return cached
+        out = {}
+        fi = self._cg.functions.get(qname)
+        if fi is not None:
+            args = fi.node.args
+            params = {a.arg for a in args.posonlyargs} | \
+                     {a.arg for a in args.args} | \
+                     {a.arg for a in args.kwonlyargs}
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        ce = item.context_expr
+                        if isinstance(ce, ast.Name) and ce.id in params:
+                            out.setdefault(ce.id, node.lineno)
+                elif (isinstance(node, ast.Call)
+                      and isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "acquire"
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id in params):
+                    out.setdefault(node.func.value.id, node.lineno)
+        self._param_acq_cache[qname] = out
+        return out
+
+    def _acq_closure(self, qname, _depth=0):
+        """lock_id -> (decl, path steps, via_self_all) acquired in closure."""
+        cached = self._closure_cache.get(qname)
+        if cached is not None:
+            return cached
+        self._closure_cache[qname] = {}   # recursion guard
+        cg = self._cg
+        fi = cg.functions.get(qname)
+        out = {}
+        if fi is None or _depth > 10:
+            self._closure_cache[qname] = out
+            return out
+        for decl, line, via_self, _body in _acquire_sites(cg, fi):
+            if decl.lock_id not in out:
+                out[decl.lock_id] = (
+                    decl,
+                    [f"{fi.pf.rel}:{line} (acquire {decl.lock_id})"],
+                    via_self)
+        for callee, line, call_self in cg.callees(qname):
+            for lock_id, (decl, path, via_all) in \
+                    self._acq_closure(callee, _depth + 1).items():
+                if lock_id not in out:
+                    step = f"{fi.pf.rel}:{line} (calls {callee})"
+                    out[lock_id] = (decl, [step] + path,
+                                    call_self and via_all)
+        self._closure_cache[qname] = out
+        return out
+
+    # -- cycle detection + witness verdicts --------------------------------
+
+    def _cycle_findings(self, project, edges):
+        adj = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        cycles = []
+        seen = set()
+        for (a, b) in sorted(edges):
+            if (b, a) in edges:
+                canon = tuple(sorted((a, b)))
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append([a, b])
+        for cyc in self._long_cycles(adj, edges):
+            canon = tuple(sorted(cyc))
+            if canon not in seen:
+                seen.add(canon)
+                cycles.append(cyc)
+
+        witness = getattr(project, "witness", None)
+        pruned = []
+        for cyc in cycles:
+            ring = " -> ".join(cyc + [cyc[0]])
+            paths = []
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                paths.append(f"[{a} then {b}] {edges[(a, b)][0]}")
+            verdict = "PLAUSIBLE"
+            if witness is not None:
+                verdict = self._witness_verdict(witness, cyc)
+            _path0, pf0, line0 = edges[(cyc[0], cyc[1])]
+            msg = (f"[{verdict}] potential lock-order deadlock: {ring}; "
+                   + "; ".join(paths))
+            f = pf0.finding(self.rule_id, line0, msg)
+            if verdict == "PRUNED":
+                pruned.append(f)
+            else:
+                yield f
+        if pruned:
+            existing = getattr(project, "witness_pruned", [])
+            project.witness_pruned = existing + pruned
+
+    def _long_cycles(self, adj, edges):
+        """One representative simple cycle (len >= 3) per discovered loop."""
+        out = []
+        for start in sorted(adj):
+            stack = [(start, [start])]
+            found = None
+            visited = set()
+            while stack and found is None:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, ())):
+                    if nxt == start and len(path) >= 3:
+                        found = list(path)
+                        break
+                    if nxt in visited or nxt in path or len(path) > 6:
+                        continue
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+            if found:
+                out.append(found)
+        return out
+
+    def _witness_verdict(self, witness, cyc) -> str:
+        """CONFIRMED: every edge observed at runtime.  PRUNED: the locks were
+        all exercised and some edge was only ever observed in the reverse
+        (consistent) order.  Otherwise PLAUSIBLE."""
+        cg = self._cg
+        sites = [cg.locks[l].site if l in cg.locks else None for l in cyc]
+        if any(s is None for s in sites):
+            return "PLAUSIBLE"
+        edges = [(sites[i], sites[(i + 1) % len(sites)])
+                 for i in range(len(sites))]
+        if all(e in witness.edges for e in edges):
+            return "CONFIRMED"
+        if all(s in witness.sites for s in sites):
+            for (a, b) in edges:
+                if (a, b) not in witness.edges and (b, a) in witness.edges:
+                    return "PRUNED"
+        return "PLAUSIBLE"

@@ -25,7 +25,7 @@ class BarePrintRule(Rule):
         "funnel, and level control — use utils.logging.get_logger, or mark a "
         "genuine argparse CLI with a file-level suppression."
     )
-    scope = ("tpu_resiliency/",)
+    scope = ("tpu_resiliency/", "tpurx_lint/")
     exclude = CLI_ALLOWLIST
 
     def check_file(self, pf):

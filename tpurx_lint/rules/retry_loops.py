@@ -54,7 +54,7 @@ class RetryDisciplineRule(Rule):
         "hand-rolled retries skip the shared jitter/deadline/telemetry "
         "policy; use retry_call / Retrier / RetryPolicy."
     )
-    scope = ("tpu_resiliency/",)
+    scope = ("tpu_resiliency/", "tpurx_lint/")
     exclude = ("tpu_resiliency/utils/retry.py",)
 
     def check_file(self, pf):

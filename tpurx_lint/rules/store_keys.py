@@ -1,0 +1,238 @@
+"""TPURX013: store-key lifecycle — ephemeral keys must have a GC path.
+
+Protocol rounds write per-round/per-rank keys into the control-plane store
+(``set``/``append``/``add`` with interpolated round, cycle, iteration, or
+rank components).  A key written every round and deleted never is a leak
+that grows O(rounds x ranks) until a 10k-rank job OOMs the shard — the
+``store/tree.py`` discipline (parents delete consumed child keys, the round
+fence doubles as the GC barrier) is the model.
+
+Mechanics: every write site's key expression is reduced to a template — the
+first stable literal fragment of an f-string, the resolved value of a local
+variable, a module-level constant, or the NAME of a key-helper function
+(``k_open(n)``-style, resolved through the call graph).  Delete evidence
+(``delete``/``multi_delete``, keys handed to ``tree_gather`` whose round
+fence GCs them) is collected project-wide.  An ephemeral write template with
+no matching delete template is a finding naming the leaking prefix.
+
+Fixed-key ``set``/``add`` (no interpolation) are bounded singletons and
+exempt; ``append`` grows content even on a fixed key and is never exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import attr_chain, call_name
+from ..registry import Rule, register
+
+_WRITE_OPS = {"set", "append", "add"}
+_DELETE_OPS = {"delete", "multi_delete", "delete_prefix"}
+
+# functions whose key argument is consumed by their own GC discipline
+_SELF_CLEANING = {"tree_gather"}
+
+
+def _receiver_is_store(func: ast.Attribute) -> bool:
+    chain = attr_chain(func.value)
+    tail = chain.rsplit(".", 1)[-1].lower()
+    return tail == "store" or tail.endswith("store")
+
+
+class KeyTemplate:
+    """Stable identity of a key expression for write/delete matching."""
+
+    __slots__ = ("ident", "ephemeral", "text")
+
+    def __init__(self, ident: str, ephemeral: bool, text: str):
+        self.ident = ident
+        self.ephemeral = ephemeral
+        self.text = text
+
+
+def _first_literal_ident(fragments) -> str:
+    """First nonempty path segment among the literal fragments."""
+    for frag in fragments:
+        for seg in frag.split("/"):
+            if seg:
+                return seg
+    return ""
+
+
+def _module_consts(pf) -> dict:
+    out = {}
+    for node in pf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _template_of(expr, cg, fi, local_templates, consts,
+                 _depth=0) -> KeyTemplate | None:
+    if _depth > 3 or expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        ident = _first_literal_ident([expr.value])
+        return KeyTemplate(ident, False, expr.value) if ident else None
+    if isinstance(expr, ast.JoinedStr):
+        frags = [v.value for v in expr.values
+                 if isinstance(v, ast.Constant) and isinstance(v.value, str)]
+        has_placeholder = any(isinstance(v, ast.FormattedValue)
+                              for v in expr.values)
+        ident = _first_literal_ident(frags)
+        # leading `{prefix}` placeholder: resolve the variable's own template
+        # so f"{prefix}/r{rank}" keys match deletes of the same prefix
+        if expr.values and isinstance(expr.values[0], ast.FormattedValue) \
+                and isinstance(expr.values[0].value, ast.Name):
+            lead = _template_of(expr.values[0].value, cg, fi, local_templates,
+                                consts, _depth + 1)
+            if lead is not None and lead.ident:
+                ident = lead.ident
+        if not ident:
+            return None
+        text = "".join(f if isinstance(v, ast.Constant) else "{*}"
+                       for v, f in zip(expr.values,
+                                       [getattr(v, "value", "{*}")
+                                        for v in expr.values]))
+        return KeyTemplate(ident, has_placeholder, text)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _template_of(expr.left, cg, fi, local_templates, consts,
+                            _depth + 1)
+        if left is not None:
+            return KeyTemplate(left.ident, True, left.text + "+{*}")
+        return None
+    if isinstance(expr, ast.Name):
+        if expr.id in local_templates:
+            return local_templates[expr.id]
+        if expr.id in consts:
+            ident = _first_literal_ident([consts[expr.id]])
+            return KeyTemplate(ident, False, consts[expr.id]) if ident else None
+        return None
+    if isinstance(expr, ast.Call):
+        # key-helper call: identity is the helper's name; ephemerality comes
+        # from its returned template when resolvable (default ephemeral)
+        callee, _vs = cg.resolve_call(fi, expr) if fi else (None, False)
+        name = call_name(expr).rsplit(".", 1)[-1]
+        if not name:
+            return None
+        ephemeral = True
+        if callee is not None:
+            for node in ast.walk(callee.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    t = _template_of(node.value, cg, callee, {}, consts,
+                                     _depth + 1)
+                    if t is not None:
+                        ephemeral = t.ephemeral or bool(expr.args)
+                    break
+        return KeyTemplate(f"{name}()", ephemeral, f"{name}(...)")
+    return None
+
+
+def _local_templates(fi, cg, consts) -> dict:
+    out = {}
+    for node in ast.walk(fi.node):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            t = _template_of(node.value, cg, fi, out, consts)
+            if t is not None:
+                out[node.targets[0].id] = t
+    return out
+
+
+@register
+class StoreKeyLifecycleRule(Rule):
+    rule_id = "TPURX013"
+    name = "store-key-lifecycle"
+    rationale = (
+        "Ephemeral control-plane keys written during a protocol round "
+        "(interpolated round/rank/cycle components, or any append) must "
+        "have a reachable delete/GC path, per the store/tree.py "
+        "consumed-child-key discipline — otherwise the store grows "
+        "O(rounds x ranks) until the shard OOMs."
+    )
+    scope = (
+        "tpu_resiliency/store/",
+        "tpu_resiliency/inprocess/",
+        "tpu_resiliency/checkpointing/local/",
+    )
+    # the store implementation itself (set/delete here are the ops, not
+    # protocol-round usage); tree.py is the sanctioned GC discipline home
+    exclude = (
+        "tpu_resiliency/store/client.py",
+        "tpu_resiliency/store/sharding.py",
+        "tpu_resiliency/store/server.py",
+        "tpu_resiliency/store/native.py",
+        "tpu_resiliency/store/protocol.py",
+        "tpu_resiliency/store/tree.py",
+    )
+
+    def finalize(self, project):
+        cg = project.callgraph()
+        writes = []          # (KeyTemplate, pf, line, op)
+        deletes = set()      # idents
+
+        for qname, fi in cg.functions.items():
+            consts = _module_consts(fi.pf)
+            locals_ = None
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                # self-cleaning rounds: their key arg counts as deleted
+                short = call_name(node).rsplit(".", 1)[-1]
+                if short in _SELF_CLEANING:
+                    if locals_ is None:
+                        locals_ = _local_templates(fi, cg, consts)
+                    for arg in list(node.args[1:2]) + [
+                            kw.value for kw in node.keywords
+                            if kw.arg in ("prefix", "key", "name")]:
+                        t = _template_of(arg, cg, fi, locals_, consts)
+                        if t is not None:
+                            deletes.add(t.ident)
+                    continue
+                func = node.func
+                if not isinstance(func, ast.Attribute):
+                    continue
+                if func.attr in _DELETE_OPS and _receiver_is_store(func):
+                    if locals_ is None:
+                        locals_ = _local_templates(fi, cg, consts)
+                    for key_expr in self._delete_key_exprs(node):
+                        t = _template_of(key_expr, cg, fi, locals_, consts)
+                        if t is not None:
+                            deletes.add(t.ident)
+                    continue
+                if (func.attr in _WRITE_OPS and len(node.args) >= 2
+                        and _receiver_is_store(func)
+                        and self.applies_to(fi.pf.rel)):
+                    if locals_ is None:
+                        locals_ = _local_templates(fi, cg, consts)
+                    t = _template_of(node.args[0], cg, fi, locals_, consts)
+                    if t is None:
+                        continue
+                    if not t.ephemeral and func.attr in ("set", "add"):
+                        continue   # bounded singleton
+                    writes.append((t, fi.pf, node.lineno, func.attr))
+
+        for t, pf, line, op in writes:
+            if t.ident in deletes:
+                continue
+            yield pf.finding(
+                self.rule_id, line,
+                f"store key {t.text!r} ({op}) is ephemeral but no "
+                f"delete/GC path exists for prefix '{t.ident}' anywhere in "
+                f"the repo — it leaks in the control-plane store every "
+                f"round (add a consumed-key delete per store/tree.py, or "
+                f"suppress with the reason the growth is bounded)",
+            )
+
+    @staticmethod
+    def _delete_key_exprs(node: ast.Call):
+        for arg in node.args[:1]:
+            if isinstance(arg, (ast.List, ast.Tuple, ast.Set)):
+                yield from arg.elts
+            elif isinstance(arg, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+                yield arg.elt
+            else:
+                yield arg

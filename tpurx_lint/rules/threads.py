@@ -109,7 +109,7 @@ class ThreadLifecycleRule(Rule):
         "attributes declared '# guarded-by: <lock>' must be accessed under "
         "'with self.<lock>:'."
     )
-    scope = ("tpu_resiliency/",)
+    scope = ("tpu_resiliency/", "tpurx_lint/")
 
     def check_file(self, pf):
         yield from self._check_threads(pf)

@@ -1,0 +1,88 @@
+"""SARIF 2.1.0 output for CI annotation pipelines.
+
+One run, one driver (``tpurx-lint``), stable rule IDs as SARIF
+``reportingDescriptor``s, one ``result`` per finding with a region and a
+content-keyed partial fingerprint (same (rule, path, stripped-line) key the
+baseline uses, so fingerprints survive line drift exactly like baseline
+entries do).  Baselined findings are emitted with ``suppressions`` so SARIF
+viewers show them as reviewed rather than hiding them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _fingerprint(f) -> str:
+    key = f"{f.rule}|{f.path}|{f.symbol}".encode()
+    return hashlib.sha256(key).hexdigest()[:32]
+
+
+def _result(f, level: str, suppressed: bool = False) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f.line)},
+            },
+        }],
+        "partialFingerprints": {"tpurxContentKey/v1": _fingerprint(f)},
+    }
+    if suppressed:
+        out["suppressions"] = [{
+            "kind": "external",
+            "justification": "grandfathered in tpurx_lint/baseline.json",
+        }]
+    return out
+
+
+def render(result, rules, root: str) -> dict:
+    """SARIF log dict for a ``LintResult`` (json.dumps it yourself)."""
+    driver_rules = []
+    for r in rules:
+        driver_rules.append({
+            "id": r.rule_id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": " ".join(r.rationale.split())},
+            "defaultConfiguration": {"level": "error"},
+        })
+    for meta_id, text in (("TPURX900", "malformed or reasonless suppression "
+                                       "directive"),
+                          ("TPURX999", "unparseable file")):
+        driver_rules.append({
+            "id": meta_id,
+            "name": meta_id.lower(),
+            "shortDescription": {"text": text},
+            "defaultConfiguration": {"level": "error"},
+        })
+
+    results = [_result(f, "error") for f in result.findings]
+    results += [_result(f, "error") for f in result.parse_errors]
+    results += [_result(f, "note", suppressed=True)
+                for f in result.baselined]
+
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpurx-lint",
+                "informationUri": "https://example.invalid/tpu-resiliency/docs/lint.md",
+                "rules": driver_rules,
+            }},
+            "originalUriBaseIds": {
+                "SRCROOT": {"uri": f"file://{root.rstrip('/')}/"},
+            },
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }

@@ -1,0 +1,76 @@
+"""Runtime sanitizer witness files, read back into the static analysis.
+
+``tpu_resiliency/utils/sanitize.py`` (opt-in via ``TPURX_SANITIZE=1``)
+records the actual cross-thread lock-acquisition DAG as JSONL: one ``edge``
+record per distinct (held-lock, acquired-lock) pair, keyed by each lock's
+CREATION site — which is exactly the declaration site the static lock table
+indexes, so the two views compare 1:1.  ``tpurx-lint --witness <file>``
+feeds the observed DAG to TPURX011: static cycles whose every edge was
+observed at runtime are promoted to CONFIRMED; cycles whose locks were all
+exercised but only ever in one consistent order are PRUNED as false
+positives; everything else stays PLAUSIBLE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class Witness:
+    """Parsed witness: observed acquisition edges + exercised lock sites."""
+
+    def __init__(self):
+        self.edges: set = set()     # (from_site, to_site), repo-relative
+        self.sites: set = set()
+        self.cycles: list = []      # [[site, ...], ...]
+        self.records = 0
+
+    @classmethod
+    def load(cls, paths, root: str) -> "Witness":
+        """Load one or more JSONL witness files; sites are normalized to
+        repo-relative (absolute paths under `root` are relativized)."""
+        w = cls()
+        root = os.path.abspath(root)
+        if isinstance(paths, str):
+            paths = [paths]
+        for path in paths:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    w._ingest(rec, root)
+        return w
+
+    def _ingest(self, rec: dict, root: str) -> None:
+        self.records += 1
+        event = rec.get("event")
+        if event == "edge":
+            a = _norm_site(rec.get("frm", {}).get("site", ""), root)
+            b = _norm_site(rec.get("to", {}).get("site", ""), root)
+            if a and b:
+                self.edges.add((a, b))
+                self.sites.update((a, b))
+        elif event == "cycle":
+            chain = [_norm_site(s, root) for s in rec.get("chain", [])]
+            self.cycles.append([s for s in chain if s])
+            self.sites.update(s for s in chain if s)
+
+
+def _norm_site(site: str, root: str) -> str:
+    if not site:
+        return ""
+    path, _, line = site.rpartition(":")
+    if os.path.isabs(path):
+        try:
+            rel = os.path.relpath(path, root)
+        except ValueError:
+            return site
+        if not rel.startswith(".."):
+            path = rel.replace(os.sep, "/")
+    return f"{path}:{line}"
