@@ -39,6 +39,17 @@ quarantined (``*.corrupt`` debris on disk,
 iteration is strictly OLDER than the corrupted one on every rank, and the
 fallback-depth gauge is nonzero.
 
+With ``--peer-mem-kill`` the soak runs the warm-restore campaign instead:
+the same ``LocalCheckpointManager`` gang saves every few steps, then at a
+drill step every non-serving rank drops its shm-resident copy and reloads
+the newest iteration while the serving rank — fault-armed via
+``TPURX_FAULT=peer_mem_stall`` — silently drops the peer-memory chunk
+requests it receives.  The gate asserts the stalled rung timed out and
+fell through to each rank's OWN DISK blob (``tpurx_ckpt_restore_source``
+disk bytes > 0, peer bytes == 0) with ``tpurx_ckpt_fallback_depth`` 0:
+a stalled peer degrades the restore to a colder source, never to an
+older iteration.
+
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
 for both rings from those events and ASSERTS bounds, so a regression in
@@ -203,6 +214,7 @@ cycle = int(os.environ["TPURX_CYCLE"])
 root = os.environ["SOAK_CKPT_ROOT"]
 save_every = int(os.environ.get("SOAK_LCKPT_EVERY", "10"))
 corrupt_step = int(os.environ.get("SOAK_CORRUPT_STEP", "35"))
+drill_step = int(os.environ.get("SOAK_PEER_DRILL_STEP", "0"))
 mode = os.environ.get("SOAK_CORRUPT_MODE", "bitflip")
 total = int(os.environ.get("SOAK_STEPS", "100000"))
 
@@ -212,6 +224,11 @@ def metric_sum(name):
     if m is None:
         return 0.0
     return sum(v.get("value", 0.0) for _l, v in m._sample_rows())
+
+
+def source_bytes(src):
+    return get_registry().value_of(
+        "tpurx_ckpt_restore_source_total", {"source": src})
 
 
 client = RankMonitorClient(); client.init_workload_monitoring()
@@ -255,6 +272,24 @@ for step in range(start, total):
     if step and step % save_every == 0:
         mgr.save(make_tree(step), iteration=step, is_async=False)
         print(f"soaklc[{rank}] saved iter={step}", flush=True)
+    if drill_step and step == drill_step and mgr.find_latest() is not None:
+        # peer-memory stall drill: the serving peer (TPURX_FAULT_RANKS)
+        # silently drops chunk requests, so every other rank — having shed
+        # its own resident copy — must try the peer-memory rung, time out,
+        # and fall through to its own disk blob WITHOUT burning a fallback
+        # rung (depth stays 0: same iteration, colder source)
+        it = mgr.find_latest()
+        peer0, disk0 = source_bytes("peer_memory"), source_bytes("local_disk")
+        if rank != 0:
+            mgr.drop_resident()
+        t0 = time.time()
+        tree2, it2 = mgr.load(make_tree(0), iteration=it)
+        depth = int(get_registry().get("tpurx_ckpt_fallback_depth").value)
+        assert int(tree2["rank_marker"][0]) == rank, "restored ANOTHER rank's data"
+        print(f"soaklc[{rank}] peer-drill it={it2} "
+              f"disk_b={int(source_bytes('local_disk') - disk0)} "
+              f"peer_b={int(source_bytes('peer_memory') - peer0)} "
+              f"depth={depth} s={time.time() - t0:.2f}", flush=True)
     if cycle == 0 and rank == 0 and step == corrupt_step:
         mutated = corrupt_checkpoint(root, Fault(mode))
         its = sorted({os.path.basename(os.path.dirname(p)) for p in mutated})
@@ -390,6 +425,11 @@ def main() -> None:
                         "of the newest local-checkpoint iteration mid-run; "
                         "the restarted gang must fallback-restore the "
                         "next-oldest valid iteration")
+    p.add_argument("--peer-mem-kill", action="store_true",
+                   help="warm-restore campaign: stall the peer-memory "
+                        "serving rank mid-restore drill; the other ranks' "
+                        "ladders must fall through to their own disk with "
+                        "fallback depth 0")
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
     p.add_argument("--chaos-store", action="store_true",
@@ -419,7 +459,10 @@ def main() -> None:
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
     with open(wl_path, "w") as f:
-        f.write(WORKLOAD_LCKPT if args.corrupt_blob else WORKLOAD)
+        f.write(
+            WORKLOAD_LCKPT if (args.corrupt_blob or args.peer_mem_kill)
+            else WORKLOAD
+        )
     ckpt = os.path.join(workdir, "progress.txt")
     profile = os.path.join(workdir, "profile.jsonl")
     journal = os.path.join(workdir, "store.journal")
@@ -449,16 +492,31 @@ def main() -> None:
             "JAX_PLATFORMS": "cpu",
         }
     )
-    if args.corrupt_blob:
+    if args.corrupt_blob or args.peer_mem_kill:
         env.update({
             "SOAK_CKPT_ROOT": os.path.join(workdir, "lckpt"),
-            "SOAK_CORRUPT_MODE": args.corrupt_blob,
             "SOAK_LCKPT_EVERY": "10",
-            "SOAK_CORRUPT_STEP": "35",
             # barriers/replication pause heartbeats briefly; keep the kill
             # threshold clear of normal collective latency
             "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "10.0",
         })
+    if args.corrupt_blob:
+        env.update({
+            "SOAK_CORRUPT_MODE": args.corrupt_blob,
+            "SOAK_CORRUPT_STEP": "35",
+        })
+    if args.peer_mem_kill:
+        env.update({
+            "SOAK_PEER_DRILL_STEP": "25",
+            # arm the stall fault on the SERVING rank only: rank 0 keeps
+            # its resident copy (so its advert attracts probes) but drops
+            # every peer-memory request it receives
+            "TPURX_FAULT": "peer_mem_stall",
+            "TPURX_FAULT_RANKS": "0",
+            "TPURX_CKPT_PEER_MEM_TIMEOUT": "2.0",
+        })
+        if not args.corrupt_blob:
+            env["SOAK_CORRUPT_STEP"] = "-1"  # drill only, no corruption leg
     if args.quorum:
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -620,6 +678,38 @@ def main() -> None:
     # checkpoint-integrity campaign (--corrupt-blob): the corrupt blobs must
     # be detected + quarantined and EVERY rank must fallback-restore an
     # iteration strictly older than the corrupted one
+    # warm-restore campaign (--peer-mem-kill): every non-serving rank's
+    # drill must have been served from its OWN DISK (peer rung timed out
+    # against the stalled server) at fallback depth 0 — the stall degrades
+    # the restore to a colder source, never to an older iteration
+    peer_report: dict = {}
+    peer_ok = True
+    if args.peer_mem_kill:
+        import re as re_mod
+
+        drills = [
+            tuple(int(x) for x in m)
+            for m in re_mod.findall(
+                r"soaklc\[(\d+)\] peer-drill it=(\d+) disk_b=(\d+) "
+                r"peer_b=(\d+) depth=(\d+)", out)
+        ]
+        nonserving = [d for d in drills if d[0] != 0]
+        peer_ok = bool(
+            drills
+            and {d[0] for d in drills} == set(range(args.nproc))
+            and nonserving
+            and all(disk > 0 and peer == 0 and depth == 0
+                    for _r, _it, disk, peer, depth in nonserving)
+        )
+        peer_report = {
+            "peer_mem_kill": True,
+            "peer_drills": drills,
+            "peer_ok": peer_ok,
+        }
+        if not args.corrupt_blob:
+            # lckpt workloads track progress through checkpoint iterations
+            monotone = True
+            final = max((d[1] for d in drills), default=0)
     ckpt_report: dict = {}
     ckpt_ok = True
     if args.corrupt_blob:
@@ -663,7 +753,9 @@ def main() -> None:
         monotone = True
         final = max((r[1] for r in restores), default=0)
     if args.corrupt_blob:
-        ok = bool(ckpt_ok and cycles >= 1)
+        ok = bool(ckpt_ok and peer_ok and cycles >= 1)
+    elif args.peer_mem_kill:
+        ok = bool(peer_ok and final > 0)
     else:
         ok = bool(monotone and final > 0 and bounds_ok and rings_ok
                   and ladder_ok and saves_ok)
@@ -690,6 +782,7 @@ def main() -> None:
                 "bounds_ok": bounds_ok,
                 "ladder_ok": ladder_ok,
                 "saves_ok": saves_ok,
+                **peer_report,
                 **ckpt_report,
                 "ok": ok,
             }

@@ -197,8 +197,12 @@ def test_load_checkpoint_detects_shard_corruption(tmp_path):
         )
         shard = sorted(glob.glob(os.path.join(d, "process_0", "*.bin")))[0]
         _bitflip(shard, off=777)
+        # resident=False: the disk-corruption lane — the warm shm-resident
+        # source would (correctly) never see the flipped bit
         with pytest.raises(CheckpointCorruptError, match="corrupt chunk"):
-            load_checkpoint(d, tree, reader=CachedMetadataReader())
+            load_checkpoint(
+                d, tree, reader=CachedMetadataReader(), resident=False
+            )
     finally:
         ckpt.close()
 

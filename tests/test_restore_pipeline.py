@@ -156,10 +156,12 @@ def test_corrupt_shard_cancels_and_names_shard(tmp_path):
         glob.glob(os.path.join(d, "process_0", "*.bin")), key=os.path.getsize
     )[-1]
     _bitflip(shard, off=4242)
+    # resident=False: this test exercises the DISK lane — the warm
+    # shm-resident source would (correctly) never see the flipped bit
     with pytest.raises(
         CheckpointCorruptError, match=os.path.basename(shard)
     ) as ei:
-        load_checkpoint(d, tree, threads=2)
+        load_checkpoint(d, tree, threads=2, resident=False)
     assert "corrupt chunk" in str(ei.value)
     assert not [
         t

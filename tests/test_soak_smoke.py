@@ -81,6 +81,31 @@ def test_soak_smoke_corrupt_blob_fallback_restore():
         assert depth >= 1 and corrupt >= 1 and quarantined >= 1 and debris >= 1
 
 
+def test_soak_smoke_peer_mem_kill_falls_to_disk():
+    """The peer-memory-stall fault class: at the drill step the serving
+    rank drops every peer-memory chunk request, so each other rank —
+    resident copy shed — must time the rung out and restore from its OWN
+    disk blob at fallback depth 0 (colder source, same iteration)."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "35", "--peer-mem-kill",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["peer_ok"], report
+    drills = report["peer_drills"]
+    assert {d[0] for d in drills} == {0, 1}, report
+    for rank, _it, disk_b, peer_b, depth in drills:
+        if rank != 0:  # rank 0 serves (and restores warm from its resident)
+            assert disk_b > 0 and peer_b == 0 and depth == 0, report
+
+
 def test_soak_smoke_store_outage_mid_save():
     """The store-outage-mid-save fault class: targeted store kills inside
     rank 0's store-backed save windows; the unified retry policy must ride

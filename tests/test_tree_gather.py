@@ -53,7 +53,7 @@ class TestTopology:
 
 
 def _run_tree_round(store_server, world, fanout, broadcast=False, payload_fn=None,
-                    combine=combine_json_merge, timeout=30.0):
+                    combine=combine_json_merge, timeout=30.0, **gather_kw):
     """Drive one tree round with `world` threads; returns (results, stores)."""
     results, stores, errors = {}, {}, []
 
@@ -69,7 +69,7 @@ def _run_tree_round(store_server, world, fanout, broadcast=False, payload_fn=Non
             results[rank] = tree_gather(
                 c, rank, world, prefix="t/round/0", payload=payload,
                 combine=combine, timeout=timeout, fanout=fanout,
-                broadcast=broadcast, site="test",
+                broadcast=broadcast, site="test", **gather_kw,
             )
         except Exception as exc:  # noqa: BLE001
             errors.append((rank, exc))
@@ -142,6 +142,95 @@ class TestTreeGather:
         for rank, c in stores.items():
             topo = TreeTopology(rank, world, fanout=fanout)
             assert c.inbound_payloads == len(topo.children) <= fanout
+
+
+class TestPayloadCap:
+    """Size-bounded partial aggregation (ROADMAP 2b): per-rank maps that
+    grow O(world) toward the root are stride-sampled down to the cap at
+    every tree level, with a ``_trimmed`` marker carrying the dropped
+    population so the root knows what it is NOT seeing."""
+
+    def test_payload_histogram_observes_combined_size(self, store_server):
+        from tpu_resiliency.telemetry import get_registry
+
+        reg = get_registry()
+        before = reg.value_of("tpurx_tree_payload_bytes", {"site": "test"})
+        _run_tree_round(store_server, 4, 2)
+        after = reg.value_of("tpurx_tree_payload_bytes", {"site": "test"})
+        assert after > before  # value_of yields the histogram sum
+
+    def test_trim_unit_keeps_marker_accounting_across_levels(self):
+        from tpu_resiliency.store.tree import trim_json_sampled
+
+        obj = {str(i): "x" * 32 for i in range(100)}
+        t1 = json.loads(trim_json_sampled(json.dumps(obj).encode(), 400))
+        assert t1["_trimmed"]["total"] == 100
+        kept1 = t1["_trimmed"]["kept"]
+        assert kept1 == len(t1) - 1 < 100
+        # re-trim at a higher level: survivors shrink again, but the true
+        # population survives the marker hand-off
+        t2 = json.loads(trim_json_sampled(json.dumps(t1).encode(), 150))
+        assert t2["_trimmed"]["total"] == 100
+        assert t2["_trimmed"]["kept"] == len(t2) - 1 <= kept1
+
+    def test_gather_trims_over_cap(self, store_server):
+        from tpu_resiliency.store.tree import trim_json_sampled
+
+        world, fanout = 16, 4
+        payload_fn = lambda r: json.dumps({str(r): "v" * 64}).encode()  # noqa: E731
+        full, _ = _run_tree_round(store_server, world, fanout,
+                                  payload_fn=payload_fn)
+        # cap above any internal node's combine but below the root's: only
+        # the root trims, so the marker accounting is exact
+        cap = len(full[0]) * 2 // 3
+        capped, _ = _run_tree_round(
+            store_server, world, fanout, payload_fn=payload_fn,
+            cap_bytes=cap, trim=trim_json_sampled,
+        )
+        merged = json.loads(capped[0])
+        assert len(capped[0]) < len(full[0])
+        marker = merged["_trimmed"]
+        assert marker["total"] == world
+        assert marker["kept"] == len(merged) - 1 < world
+
+    def test_aggregator_skips_trim_marker(self, store_server, monkeypatch):
+        """CrossRankAggregator opts into trimming: with a byte cap armed via
+        the env knob, the round still aggregates (the ``_trimmed`` marker is
+        bookkeeping, not a rank) and the observer feed filters it too."""
+        from tpu_resiliency.telemetry.aggregate import (
+            CrossRankAggregator, read_latest_snapshots,
+        )
+        from tpu_resiliency.telemetry.registry import Registry
+
+        monkeypatch.setenv("TPURX_TREE_PAYLOAD_CAP", "700")
+        world, fanout = 8, 4
+        results, errors = {}, []
+
+        def run(rank):
+            reg = Registry(enabled=True)
+            reg.counter("tpurx_cap8_total").inc(rank)
+            inner = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+            try:
+                aggr = CrossRankAggregator(inner, rank, world, fanout=fanout)
+                results[rank] = aggr.round(reg, timeout=30.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append((rank, exc))
+            finally:
+                inner.close()
+
+        threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors[:3]
+        assert results[0] is not None  # int(rank) never saw "_trimmed"
+        c = StoreClient("127.0.0.1", store_server.port)
+        latest = read_latest_snapshots(c)
+        c.close()
+        assert latest  # trimmed, but a representative subset survives
+        assert set(latest) < set(range(world)) or set(latest) == set(range(world))
+        assert all(isinstance(r, int) for r in latest)
 
 
 class TestRoundsRouteThroughTree:

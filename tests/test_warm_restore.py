@@ -1,0 +1,338 @@
+"""Warm restore ladder tests: shm-resident read source, digest-keyed delta
+saves, and the local manager's peer-memory rung.
+
+The resident registry (``async_ckpt/resident.py``) promotes the staging
+pool's committed generation to a read source; ``load_checkpoint`` must
+restore a complete generation without opening ANY checkpoint file.  Delta
+saves skip draining chunks whose crc matches the previous committed
+generation and record provenance so a cold restore of the delta directory
+still covers every byte.  The local manager's ladder tries its own resident
+blob, then clique peers' resident copies over the TCP exchange, then disk.
+"""
+
+import json
+import os
+import shutil
+import threading
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_resiliency.checkpointing.async_ckpt import resident as resident_mod
+from tpu_resiliency.checkpointing.async_ckpt import checkpointer as ckpt_mod
+from tpu_resiliency.checkpointing.async_ckpt import writer as writer_mod
+from tpu_resiliency.checkpointing.async_ckpt.checkpointer import (
+    AsyncCheckpointer,
+    load_checkpoint,
+)
+from tpu_resiliency.checkpointing.local.manager import LocalCheckpointManager
+from tpu_resiliency.checkpointing.local.replication import (
+    CliqueReplication,
+    PeerExchange,
+)
+from tpu_resiliency.store import StoreClient
+from tpu_resiliency.telemetry import get_registry
+
+
+def _source_bytes(source):
+    return get_registry().value_of(
+        "tpurx_ckpt_restore_source_total", {"source": source}
+    )
+
+
+def make_tree(seed=0, step=1):
+    k = jax.random.PRNGKey(seed)
+    return {
+        "w": jax.device_put(jax.random.normal(k, (64, 32))),
+        "b": jax.device_put(np.arange(256, dtype=np.float32)),
+        "step": np.int64(step),
+    }
+
+
+def assert_trees_equal(a, b):
+    la, _ = jax.tree_util.tree_flatten(a)
+    lb, _ = jax.tree_util.tree_flatten(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    resident_mod.invalidate()
+    yield
+    resident_mod.invalidate()
+
+
+def _forbid_file_reads(monkeypatch):
+    def _boom(*_a, **_k):
+        raise AssertionError("warm restore touched a checkpoint file")
+
+    monkeypatch.setattr(writer_mod, "ChunkReader", _boom)
+    monkeypatch.setattr(ckpt_mod, "read_metadata", _boom)
+    monkeypatch.setattr(ckpt_mod, "is_committed", _boom)
+
+
+class TestResidentRestore:
+    def test_warm_restore_no_file_opens(self, tmp_path, monkeypatch):
+        """In-process-restart smoke: after close(), a complete resident
+        generation satisfies the whole restore from memory — metadata
+        included — with every chunk verified against the committed index."""
+        tree = make_tree(1)
+        d = str(tmp_path / "ck")
+        cp = AsyncCheckpointer(digest=True, resident=True)
+        try:
+            cp.save(tree, d, extra_metadata={"iteration": 1})
+        finally:
+            cp.close()  # the resident generation outlives the checkpointer
+        rc = resident_mod.lookup(d)
+        assert rc is not None and rc.complete
+        _forbid_file_reads(monkeypatch)
+        stats = {}
+        restored = load_checkpoint(d, tree, threads=2, stats=stats)
+        assert_trees_equal(tree, restored)
+        assert stats["bytes_shm"] > 0
+        assert stats["bytes_shm"] == stats["bytes_read"]  # 100% warm
+
+    def test_resident_opt_out_reads_disk(self, tmp_path):
+        tree = make_tree(2)
+        d = str(tmp_path / "ck")
+        cp = AsyncCheckpointer(digest=True, resident=True)
+        try:
+            cp.save(tree, d, extra_metadata={"iteration": 1})
+        finally:
+            cp.close()
+        stats = {}
+        restored = load_checkpoint(d, tree, stats=stats, resident=False)
+        assert_trees_equal(tree, restored)
+        assert stats["bytes_shm"] == 0
+
+    def test_serial_path_ignores_resident(self, tmp_path):
+        tree = make_tree(3)
+        d = str(tmp_path / "ck")
+        cp = AsyncCheckpointer(digest=True, resident=True)
+        try:
+            cp.save(tree, d, extra_metadata={"iteration": 1})
+        finally:
+            cp.close()
+        assert resident_mod.lookup(d) is not None
+        restored = load_checkpoint(d, tree, serial=True)
+        assert_trees_equal(tree, restored)
+
+    def test_sharded_leaves_warm_and_cold(self, tmp_path):
+        """Row sharding exercises the direct-into-leaf-buffer path, column
+        sharding the scratch-then-place path — both must restore equal from
+        the shm source AND from disk after invalidation."""
+        devs = jax.devices()
+        assert len(devs) == 8
+        mesh = Mesh(np.array(devs), ("x",))
+        rows = jax.device_put(
+            np.arange(64 * 32, dtype=np.float32).reshape(64, 32),
+            NamedSharding(mesh, P("x", None)),
+        )
+        cols = jax.device_put(
+            np.arange(16 * 64, dtype=np.float32).reshape(16, 64),
+            NamedSharding(mesh, P(None, "x")),
+        )
+        tree = {"rows": rows, "cols": cols, "step": np.int64(4)}
+        d = str(tmp_path / "ck")
+        cp = AsyncCheckpointer(digest=True, resident=True)
+        try:
+            cp.save(tree, d, extra_metadata={"iteration": 1})
+        finally:
+            cp.close()
+        stats = {}
+        warm = load_checkpoint(d, tree, threads=2, stats=stats)
+        assert stats["bytes_shm"] == stats["bytes_read"] > 0
+        assert_trees_equal(tree, warm)
+        assert warm["rows"].sharding.is_equivalent_to(rows.sharding, 2)
+        assert warm["cols"].sharding.is_equivalent_to(cols.sharding, 2)
+        resident_mod.invalidate(d)
+        stats = {}
+        cold = load_checkpoint(d, tree, threads=2, stats=stats)
+        assert stats["bytes_shm"] == 0
+        assert_trees_equal(tree, cold)
+
+    def test_layout_change_invalidates_resident(self, tmp_path):
+        cp = AsyncCheckpointer(digest=True, resident=True)
+        d1, d2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+        try:
+            cp.save(make_tree(5), d1, extra_metadata={"iteration": 1})
+            assert resident_mod.lookup(d1) is not None
+            # different leaf set = different plan signature: the staging
+            # pool re-shapes, so the old generation must be evicted
+            other = {"v": jax.device_put(np.ones((8, 8), dtype=np.float32))}
+            cp.save(other, d2, extra_metadata={"iteration": 2})
+        finally:
+            cp.close()
+        assert resident_mod.lookup(d1) is None
+        assert resident_mod.lookup(d2) is not None
+
+
+class TestDeltaSaves:
+    def test_delta_skips_frozen_chunks_and_restores(self, tmp_path):
+        """Save, mutate ONE leaf, delta-save: frozen chunks are recorded by
+        provenance (no drain) and both warm and cold restores of the delta
+        directory cover every byte."""
+        cp = AsyncCheckpointer(digest=True, resident=True, delta=True)
+        d1, d2 = str(tmp_path / "c1"), str(tmp_path / "c2")
+        t1 = make_tree(6, step=1)
+        t2 = dict(t1, step=np.int64(2))  # w and b frozen
+        try:
+            cp.save(t1, d1, extra_metadata={"iteration": 1})
+            cp.save(t2, d2, extra_metadata={"iteration": 2})
+        finally:
+            cp.close()
+        with open(os.path.join(d2, f"process_{cp.process_index}.json")) as f:
+            idx = json.load(f)
+        based = [
+            c
+            for s in idx["shards"]
+            for c in s.get("chunks", [])
+            if len(c) > 3
+        ]
+        assert based, "delta save recorded no provenance chunks"
+        assert any(
+            os.path.abspath(d1) in b
+            for s in idx["shards"]
+            for b in s.get("bases", [])
+        )
+        # warm restore of the delta generation (resident covers it fully)
+        stats = {}
+        warm = load_checkpoint(d2, t2, threads=2, stats=stats)
+        assert stats["bytes_shm"] == stats["bytes_read"]
+        assert_trees_equal(t2, warm)
+        # cold restores must resolve provenance across generation dirs
+        resident_mod.invalidate()
+        assert_trees_equal(t2, load_checkpoint(d2, t2, threads=2))
+        assert_trees_equal(t2, load_checkpoint(d2, t2, serial=True))
+
+    def test_delta_then_layout_change_invalidates(self, tmp_path):
+        """Delta chain then a layout change: the resident generation of the
+        old layout is gone and the new layout restores clean."""
+        cp = AsyncCheckpointer(digest=True, resident=True, delta=True)
+        d1, d2, d3 = (str(tmp_path / n) for n in ("c1", "c2", "c3"))
+        t1 = make_tree(7, step=1)
+        t2 = dict(t1, step=np.int64(2))
+        other = {"v": jax.device_put(np.full((16,), 3.0, dtype=np.float32))}
+        try:
+            cp.save(t1, d1, extra_metadata={"iteration": 1})
+            cp.save(t2, d2, extra_metadata={"iteration": 2})
+            assert resident_mod.lookup(d2) is not None
+            cp.save(other, d3, extra_metadata={"iteration": 3})
+        finally:
+            cp.close()
+        assert resident_mod.lookup(d1) is None
+        assert resident_mod.lookup(d2) is None
+        rc = resident_mod.lookup(d3)
+        assert rc is not None
+        assert_trees_equal(other, load_checkpoint(d3, other, threads=2))
+        # the delta dir still restores from disk (provenance, not memory)
+        assert_trees_equal(t2, load_checkpoint(d2, t2, threads=2))
+
+
+# -- peer-memory rung --------------------------------------------------------
+
+
+def _run_ranks(world, fn):
+    errors, results = [], {}
+
+    def wrap(rank):
+        try:
+            results[rank] = fn(rank)
+        except Exception as exc:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            errors.append((rank, exc))
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    return results
+
+
+def _mgr_tree(rank):
+    return {
+        "w": np.arange(4096, dtype=np.float32) + rank,
+        "rank_marker": np.array([rank], dtype=np.int32),
+    }
+
+
+def test_peer_memory_restore(store_server, tmp_path):
+    """Rank 1 loses its disk AND its own resident copy; the ladder serves it
+    from rank 0's memory-resident replica over the exchange, then persists a
+    durable copy."""
+    world = 2
+    peer_before = _source_bytes("peer_memory")
+
+    def member(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="pxwm1")
+        repl = CliqueReplication(ex, world, replication_factor=2)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"node{rank}"), rank, world,
+            store=store, replication=repl,
+        )
+        try:
+            mgr.save(_mgr_tree(rank), iteration=7, is_async=False)
+            if rank == 1:
+                mgr.drop_resident()
+                shutil.rmtree(mgr.root)
+            tree, it = mgr.load(_mgr_tree(rank), iteration=7)
+            if rank == 1:
+                # durability repaired: the warm fetch left a disk copy
+                path = mgr._blob_path(7, 1)
+                assert os.path.exists(path) and os.path.exists(path + ".done")
+            return int(np.asarray(tree["rank_marker"])[0])
+        finally:
+            mgr.close()
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, member)
+    assert results == {0: 0, 1: 1}
+    assert _source_bytes("peer_memory") > peer_before
+    assert _source_bytes("local_resident") > 0
+
+
+def test_peer_memory_stall_falls_to_disk(store_server, tmp_path, monkeypatch):
+    """A stalled serving peer (drops requests) must NOT wedge the restore:
+    the rung times out and the ladder falls through to the rank's own disk
+    blob with fallback depth 0."""
+    monkeypatch.setenv("TPURX_FAULT", "peer_mem_stall")
+    monkeypatch.setenv("TPURX_FAULT_RANKS", "0")  # only rank 0 drops requests
+    monkeypatch.setenv("TPURX_CKPT_PEER_MEM_TIMEOUT", "1.5")
+    world = 2
+    disk_before = _source_bytes("local_disk")
+    peer_before = _source_bytes("peer_memory")
+
+    def member(rank):
+        store = StoreClient("127.0.0.1", store_server.port, timeout=15.0)
+        ex = PeerExchange(store, rank, namespace="pxwm2")
+        repl = CliqueReplication(ex, world, replication_factor=2)
+        mgr = LocalCheckpointManager(
+            str(tmp_path / f"node{rank}"), rank, world,
+            store=store, replication=repl,
+        )
+        try:
+            mgr.save(_mgr_tree(rank), iteration=9, is_async=False)
+            if rank == 1:
+                mgr.drop_resident()  # forces the ladder past the memory rung
+            tree, _ = mgr.load(_mgr_tree(rank), iteration=9)
+            return int(np.asarray(tree["rank_marker"])[0])
+        finally:
+            mgr.close()
+            ex.close()
+            store.close()
+
+    results = _run_ranks(world, member)
+    assert results == {0: 0, 1: 1}
+    assert _source_bytes("peer_memory") == peer_before  # rung never served
+    assert _source_bytes("local_disk") > disk_before
+    assert get_registry().value_of("tpurx_ckpt_fallback_depth") == 0

@@ -57,6 +57,7 @@ from .core import (  # noqa: F401 - CheckpointSaveError re-exported for callers
     store_sync_fn,
 )
 from ...utils.dtypes import coerce_dtype
+from . import resident as resident_mod
 from .staging import StagedTree, plan_signature, shard_payload, stage_pytree
 from .writer import (
     _RestoreEngine,
@@ -65,6 +66,7 @@ from .writer import (
     read_metadata,
     resolve_restore_threads,
     resolve_write_threads,
+    shard_filename,
     write_metadata,
     write_process_shards_streamed,
 )
@@ -126,6 +128,9 @@ class _StagingJob:
     plan_sig: str
     ticket: int
     stream: Any = None                    # core.StreamHandle feeding the worker
+    # delta baseline for this save: {(leaf_idx, shard_idx):
+    #   {(off, len): (crc, base_path)}} from the previous committed index
+    delta_base: Optional[Dict] = None
     done: threading.Event = dataclasses.field(default_factory=threading.Event)
     staged: Optional[StagedTree] = None
     # `cleaned` guards the staged-tree handoff between the stager thread and
@@ -146,6 +151,8 @@ class AsyncCheckpointer:
         stage_mode: Optional[str] = None,
         pool_size: int = 2,
         digest: Optional[bool] = None,
+        delta: Optional[bool] = None,
+        resident: Optional[bool] = None,
     ):
         if stage_mode not in (None, "snapshot", "sync"):
             raise ValueError(
@@ -163,6 +170,19 @@ class AsyncCheckpointer:
         # chunk-digest recording in the drain (None = env TPURX_CKPT_DIGEST,
         # default on); per-save override via async_save(digest=...)
         self.digest = digest
+        # delta saves (None = env TPURX_CKPT_DELTA, default off); per-save
+        # override via async_save(delta=...).  Needs digests: the chunk crc
+        # is the unchanged-vs-previous-generation match key.
+        self.delta = delta
+        # shm-resident committed generation as warm restore source
+        # (None = env TPURX_CKPT_RESIDENT, default on)
+        self.resident = resident
+        # previous committed generation's chunk index, for delta matching:
+        # {"sig": plan_sig, "chunks": {(leaf, shard): {(off, len):
+        #   (crc, physical_path)}}} — provenance-resolved, so chains never
+        # form (every entry points at the file that HOLDS the bytes)
+        self._delta_baseline: Optional[Dict[str, Any]] = None
+        self._published_dirs: set = set()
         if process_index is None:
             try:
                 import jax
@@ -191,6 +211,7 @@ class AsyncCheckpointer:
         save_id: Optional[str] = None,
         stage_mode: Optional[str] = None,
         digest: Optional[bool] = None,
+        delta: Optional[bool] = None,
     ) -> int:
         """Snapshot + hand off to the stager (default), or stage inline
         (``stage_mode="sync"``).  Returns a monotonic save ticket.  Call
@@ -223,14 +244,29 @@ class AsyncCheckpointer:
             # references the trainer can mutate in place after we return
             tree = device_snapshot(tree)  # async dispatch; no D2H yet
         job = _StagingJob(tree=tree, plan_sig=sig, ticket=self._save_seq)
+        if digest is None:
+            digest = self.digest
+        effective_digest = (
+            digest if digest is not None else env.CKPT_DIGEST.get()
+        )
+        if delta is None:
+            delta = self.delta if self.delta is not None else env.CKPT_DELTA.get()
+        base = self._delta_baseline
+        if (delta and effective_digest and base is not None
+                and base["sig"] == sig):
+            job.delta_base = base["chunks"]
         finalize_fns: List[Callable] = []
         if self.rank == 0:
             extra = extra_metadata
             finalize_fns.append(
                 lambda: self._merger.finalize(ckpt_dir, job.staged, extra, save_id)
             )
-        if digest is None:
-            digest = self.digest
+        # every rank: fold the committed index back into the trainer — the
+        # delta baseline for the next save, and (when enabled) the resident
+        # publish binding index digests to the staged shm buffers
+        finalize_fns.append(
+            lambda: self._after_commit(ckpt_dir, job, save_id, sig)
+        )
         req = AsyncRequest(
             async_fn=write_process_shards_streamed,
             async_fn_args=(
@@ -312,6 +348,17 @@ class AsyncCheckpointer:
         """Stage ``job.tree`` into shm, streaming the plan then each shard to
         the worker the moment its bytes land — the drain overlaps staging."""
         stream = job.stream
+
+        def _payload(info):
+            p = shard_payload(info)
+            if job.delta_base is not None:
+                ent = job.delta_base.get((info.leaf_idx, info.shard_idx))
+                if ent:
+                    # delta plan frame: the previous generation's chunk crcs
+                    # + physical paths ride the shard payload to the worker
+                    p["delta"] = ent
+            return p
+
         try:
             pooled = self._pool_acquire(job.plan_sig)
             try:
@@ -322,7 +369,7 @@ class AsyncCheckpointer:
                     plan_sig=job.plan_sig,
                     on_plan=lambda total: stream.send(("plan", total)),
                     on_shard_staged=lambda info: stream.send(
-                        ("shards", [shard_payload(info)])
+                        ("shards", [_payload(info)])
                     ),
                 )
             except BaseException:
@@ -365,7 +412,11 @@ class AsyncCheckpointer:
         with self._pool_lock:
             for i, st in enumerate(self._pool):
                 if st.plan_sig == sig:
-                    return self._pool.pop(i)
+                    st = self._pool.pop(i)
+                    # the new save is about to overwrite these buffers: any
+                    # resident generation still reading them is stale NOW
+                    resident_mod.invalidate_tree(st)
+                    return st
         return None
 
     def _pool_release(self, staged: StagedTree) -> None:
@@ -373,15 +424,94 @@ class AsyncCheckpointer:
             if staged.plan_sig and len(self._pool) < self.pool_size:
                 self._pool.append(staged)
                 return
-        staged.close(unlink=True)
+        # pool declined the tree; if a resident generation still reads from
+        # it, the registry takes ownership (closed at invalidation) —
+        # closing here would unmap shm under the warm restore source
+        if not resident_mod.retire_tree(staged):
+            staged.close(unlink=True)
 
     def _drain_pool(self) -> None:
         with self._pool_lock:
             pool, self._pool = self._pool, []
         for st in pool:
-            st.close(unlink=True)
+            if not resident_mod.retire_tree(st):
+                st.close(unlink=True)
 
     # -- finalize ---------------------------------------------------------
+
+    def _after_commit(
+        self, ckpt_dir: str, job: _StagingJob, save_id: str, sig: str
+    ) -> None:
+        """Per-rank finalize hook: fold the worker-reported committed index
+        (the done frame's ``shards_index``) back into the trainer — it
+        becomes the delta baseline for the next save and, when resident
+        sourcing is on, the digest seal of the published warm generation.
+        Best-effort: a save whose index doesn't surface (digest off, legacy
+        worker) simply publishes nothing and clears the baseline."""
+        stats = self.queue.caller.stats(job.stream.call_idx) or {}
+        shards_idx = stats.get("shards_index") or []
+        digested = bool(stats.get("digest")) and all(
+            s.get("chunks") is not None for s in shards_idx
+        )
+        if not shards_idx or not digested:
+            self._delta_baseline = None
+            return
+        pdir = os.path.abspath(
+            os.path.join(ckpt_dir, f"process_{self.process_index}")
+        )
+        base_chunks: Dict[Tuple[int, int], Dict] = {}
+        for s in shards_idx:
+            own = os.path.join(
+                pdir, shard_filename(s["leaf_idx"], s["shard_idx"])
+            )
+            bases = s.get("bases") or []
+            base_chunks[(s["leaf_idx"], s["shard_idx"])] = {
+                (int(r[0]), int(r[1])): (
+                    int(r[2]), str(bases[r[3]]) if len(r) > 3 else own
+                )
+                for r in s["chunks"]
+            }
+        self._delta_baseline = {"sig": sig, "chunks": base_chunks}
+        self._publish_resident(ckpt_dir, job, save_id, sig, shards_idx)
+
+    def _publish_resident(
+        self, ckpt_dir: str, job: _StagingJob, save_id: str, sig: str,
+        shards_idx: List[Dict],
+    ) -> None:
+        enabled = (
+            env.CKPT_RESIDENT.get() if self.resident is None else self.resident
+        )
+        staged = job.staged
+        if not enabled or staged is None:
+            return
+        bufs = staged.shm_buffers()
+        name_of = {
+            (i.leaf_idx, i.shard_idx): i.shm_name
+            for i in staged.shards
+            if i.replica_owner and i.shm_name
+        }
+        shards: Dict[Tuple[int, int], Dict] = {}
+        for s in shards_idx:
+            key = (s["leaf_idx"], s["shard_idx"])
+            buf = bufs.get(name_of.get(key, ""))
+            if buf is None:
+                return  # index/staging mismatch: publish nothing
+            shards[key] = {**s, "buf": buf}
+        rc = resident_mod.ResidentCheckpoint(
+            ckpt_dir=ckpt_dir,
+            save_id=save_id,
+            plan_sig=sig,
+            process_index=self.process_index,
+            shards=shards,
+            leaf_paths=list(staged.leaf_paths),
+            treedef_repr=staged.treedef_repr,
+            # a single-process save owns every byte of the tree; only then
+            # can a restore skip the filesystem (metadata included)
+            complete=self.world_size == 1,
+            tree=staged,
+        )
+        resident_mod.publish(rc)
+        self._published_dirs.add(os.path.abspath(ckpt_dir))
 
     def maybe_finalize(self, blocking: bool = False) -> List[int]:
         done = self.queue.maybe_finalize_async_calls(blocking=blocking)
@@ -473,7 +603,7 @@ class _MetadataMerger:
                 src = fresh.get(
                     (s["process_index"], s["leaf_idx"], s["shard_idx"])
                 )
-                for k in ("crc", "chunks"):
+                for k in ("crc", "chunks", "bases"):
                     if src is not None and k in src:
                         s[k] = src[k]
                     else:
@@ -546,6 +676,7 @@ def load_checkpoint(
     threads: Optional[int] = None,
     serial: bool = False,
     stats: Optional[Dict[str, Any]] = None,
+    resident: Optional[bool] = None,
 ) -> Any:
     """Load into the structure (and shardings) of ``template``.
 
@@ -565,12 +696,33 @@ def load_checkpoint(
 
     ``serial=True`` keeps the one-leaf-at-a-time reference path (the
     restore bench's A/B baseline).  ``stats``, if given, is filled with the
-    engine's accounting (``bytes_read`` / ``chunks`` / ``shards`` /
-    ``leaves`` / ``verify_ns`` / ``restore_ns`` / ``threads``).
+    engine's accounting (``bytes_read`` / ``bytes_shm`` / ``chunks`` /
+    ``shards`` / ``leaves`` / ``verify_ns`` / ``restore_ns`` /
+    ``threads``).
+
+    **Warm restore**: when the committed generation for ``ckpt_dir`` is
+    still shm-resident (published at finalize, see ``resident.py``) and
+    ``resident`` is not False (None = ``TPURX_CKPT_RESIDENT``), shards are
+    sourced from memory instead of disk — for a complete (single-process)
+    generation no checkpoint file is opened at all, metadata included.
+    Every chunk is still verified against the committed index crcs;
+    ``stats["bytes_shm"]`` reports how much of the restore came warm.
+    ``serial=True`` always reads from disk (it is the A/B baseline).
     """
-    if not is_committed(ckpt_dir):
-        raise FileNotFoundError(f"no committed checkpoint at {ckpt_dir}")
-    meta = (reader or _default_reader).read(ckpt_dir)
+    use_res = env.CKPT_RESIDENT.get() if resident is None else resident
+    rc = resident_mod.lookup(ckpt_dir) if (use_res and not serial) else None
+    res_bufs: Optional[Dict[Tuple[int, int, int], memoryview]] = None
+    if rc is not None:
+        res_bufs = {
+            (rc.process_index, l, s): buf
+            for (l, s), buf in rc.buffers().items()
+        }
+    if rc is not None and rc.complete and res_bufs:
+        meta = rc.as_meta()  # committed index from memory: zero file opens
+    else:
+        if not is_committed(ckpt_dir):
+            raise FileNotFoundError(f"no committed checkpoint at {ckpt_dir}")
+        meta = (reader or _default_reader).read(ckpt_dir)
 
     import jax.tree_util as jtu
 
@@ -593,7 +745,7 @@ def load_checkpoint(
         return jtu.tree_unflatten(treedef, out_leaves)
     engine = _RestoreEngine(
         ckpt_dir, meta, num_threads=resolve_restore_threads(threads),
-        leaf_indices=range(len(leaves)),
+        leaf_indices=range(len(leaves)), resident=res_bufs,
     )
     try:
         while True:

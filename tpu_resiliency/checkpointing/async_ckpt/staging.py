@@ -89,6 +89,10 @@ class StagedTree:
                 pass
         self._shms.clear()
 
+    def shm_buffers(self) -> Dict[str, memoryview]:
+        """shm segment name -> its live buffer view (resident read source)."""
+        return {shm.name: shm.buf for shm in self._shms}
+
 
 def _leaf_paths(tree: Any) -> Tuple[Any, List[str], List[Any]]:
     import jax.tree_util as jtu

@@ -131,6 +131,31 @@ _RESTORE_VERIFY_NS = histogram(
 _RESTORE_THREADS = gauge(
     "tpurx_ckpt_restore_threads", "Reader pool size used by the last restore"
 )
+_RESTORE_SOURCE = counter(
+    "tpurx_ckpt_restore_source_total",
+    "Restored bytes by warm-ladder rung (shm = resident generation, disk = "
+    "shard files; the local-manager ladder adds its own rung labels)",
+    labels=("source",),
+)
+_DELTA_SKIPPED_BYTES = counter(
+    "tpurx_ckpt_delta_skipped_bytes_total",
+    "Bytes a delta save did NOT drain because the chunk crc matched the "
+    "previous committed generation",
+)
+
+
+def _join_pool(threads: List["threading.Thread"], what: str,
+               timeout_s: float = 60.0) -> List[str]:
+    """Join an engine's worker pool with a wall-clock bound.
+
+    Workers exit deterministically once ``_closed``/``_error`` is set (their
+    cv waits are 5s-bounded predicate loops), so a thread still alive after
+    ``timeout_s`` is wedged in a syscall — return its name so the caller can
+    surface that instead of parking the trainer forever."""
+    deadline = time.monotonic() + timeout_s
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    return [t.name for t in threads if t.is_alive()]
 
 
 def default_chunk_bytes() -> int:
@@ -194,7 +219,17 @@ class _ShardSink:
         self.lock = threading.Lock()
         self.chunks_left = 0           # set by the engine before enqueueing
         self.digest = digest
+        # delta baseline: {(off, len): (crc, base_path)} from the previous
+        # committed generation — chunks whose fresh crc matches skip the
+        # write entirely and record provenance instead.  Requires digests
+        # (the crc IS the match key); popped so the index never carries it.
+        _delta = payload.pop("delta", None)
+        self.delta: Optional[Dict[Tuple[int, int], Tuple[int, str]]] = (
+            _delta if digest else None
+        )
         self.chunk_digests: List[Tuple[int, int, int]] = []  # (off, len, crc)
+        self.base_spans: List[Tuple[int, int, int, str]] = []  # + base path
+        self.bytes_skipped = 0
         self.crc_ns = 0                # CPU ns spent digesting (stats)
         self.fd_direct = -1
         self.fd_buf = -1
@@ -221,26 +256,47 @@ class _ShardSink:
                     self.fd_direct = os.open(
                         self.tmp, os.O_WRONLY | os.O_CREAT | os.O_DIRECT, 0o644
                     )
-                    try:
-                        os.posix_fallocate(self.fd_direct, 0, self.aligned_end)
-                    except OSError:
-                        pass  # no fallocate: extending pwrites still work
+                    if self.delta is None:
+                        # delta shards stay sparse where chunks are skipped —
+                        # preallocating the full extent would pay the blocks
+                        # the delta exists to avoid
+                        try:
+                            os.posix_fallocate(
+                                self.fd_direct, 0, self.aligned_end
+                            )
+                        except OSError:
+                            pass  # no fallocate: extending pwrites still work
                 except (OSError, AttributeError):
                     self.fd_direct = -1  # tmpfs & friends: buffered fallback
             if self.fd_direct < 0 or self.aligned_end < self.nbytes or self.nbytes == 0:
                 self.fd_buf = os.open(self.tmp, os.O_WRONLY | os.O_CREAT, 0o644)
             self._opened = True
 
-    def write_chunk(self, off: int, length: int) -> None:
+    def write_chunk(self, off: int, length: int) -> bool:
+        """Drain one chunk.  Returns True if bytes hit the file, False when
+        a delta baseline proved the chunk unchanged (provenance recorded
+        instead of a write)."""
         self._ensure_open()
         mv = self.shm.buf[off : off + length]
         try:
             if self.digest and length:
                 t0 = time.monotonic_ns()
                 c = crc32(mv)
+                crc_spent = time.monotonic_ns() - t0
+                base = None
+                if self.delta is not None:
+                    ent = self.delta.get((off, length))
+                    if ent is not None and int(ent[0]) == c:
+                        base = str(ent[1])
                 with self.lock:
-                    self.chunk_digests.append((off, length, c))
-                    self.crc_ns += time.monotonic_ns() - t0
+                    self.crc_ns += crc_spent
+                    if base is not None:
+                        self.base_spans.append((off, length, c, base))
+                        self.bytes_skipped += length
+                    else:
+                        self.chunk_digests.append((off, length, c))
+                if base is not None:
+                    return False
             if self.fd_direct >= 0 and off < self.aligned_end:
                 fd = self.fd_direct
             else:
@@ -248,23 +304,42 @@ class _ShardSink:
             written = 0
             while written < length:
                 written += os.pwrite(fd, mv[written:], off + written)
+            return True
         finally:
             mv.release()
 
     def complete(self) -> None:
         """Last chunk landed: one durability pass + atomic rename; the
         chunk digests recorded along the way fold into the payload so the
-        process index carries them."""
+        process index carries them.  Delta shards additionally extend the
+        file to full logical size (skipped regions stay sparse holes) and
+        record per-chunk provenance: a 4th element indexing into the
+        payload's ``bases`` path list names the file physically holding
+        that chunk's bytes."""
         self._ensure_open()  # zero-chunk (empty) shards still create a file
+        if self.delta is not None and self.base_spans:
+            fd = self.fd_buf if self.fd_buf >= 0 else self.fd_direct
+            os.ftruncate(fd, self.nbytes)
         for fd in (self.fd_direct, self.fd_buf):
             if fd >= 0:
                 os.fdatasync(fd)
                 os.close(fd)
         self.fd_direct = self.fd_buf = -1
         if self.digest:
-            spans = sorted(self.chunk_digests)
-            self.payload["chunks"] = [list(s) for s in spans]
-            self.payload["crc"] = combine_crcs([c for _o, _l, c in spans])
+            bases: List[str] = []
+            base_idx: Dict[str, int] = {}
+            rows: List[List] = [list(s) for s in self.chunk_digests]
+            for off, length, c, path in self.base_spans:
+                i = base_idx.get(path)
+                if i is None:
+                    i = base_idx[path] = len(bases)
+                    bases.append(path)
+                rows.append([off, length, c, i])
+            rows.sort(key=lambda r: r[0])
+            self.payload["chunks"] = rows
+            self.payload["crc"] = combine_crcs([r[2] for r in rows])
+            if bases:
+                self.payload["bases"] = bases
         os.replace(self.tmp, self.final)
         self._close_shm()
 
@@ -323,6 +398,8 @@ class _WriteEngine:
         self._t0_ns = time.monotonic_ns()
         self.total_bytes: Optional[int] = None  # announced plan total, if any
         self.bytes_written = 0
+        self.bytes_skipped = 0       # delta: crc-matched chunks not drained
+        self.chunks_skipped = 0
         self.payloads_done: List[Dict[str, Any]] = []
         self._sinks: List[_ShardSink] = []
         self._cv = threading.Condition()
@@ -389,8 +466,12 @@ class _WriteEngine:
                 # 5s instead of parking the drain forever
                 self._cv.wait(timeout=5.0)
             err = self._error
-        for t in self._threads:
-            t.join()
+        wedged = _join_pool(self._threads, "ckpt drain")
+        if err is None and wedged:
+            err = TimeoutError(
+                f"ckpt drain: writer thread(s) {wedged} did not exit "
+                f"(wedged in I/O); save aborted"
+            )
         if err is not None:
             self._discard_all()
             raise err
@@ -421,11 +502,19 @@ class _WriteEngine:
         self._report_progress(force=True)
         return {
             "bytes_written": self.bytes_written,
+            "bytes_skipped": self.bytes_skipped,
+            "chunks_skipped": self.chunks_skipped,
             "shards": len(self.payloads_done),
             "drain_ns": elapsed_ns,
             "crc_ns": sum(s.crc_ns for s in self._sinks),
-            "crc_chunks": sum(len(s.chunk_digests) for s in self._sinks),
+            "crc_chunks": sum(
+                len(s.chunk_digests) + len(s.base_spans) for s in self._sinks
+            ),
             "digest": self.digest,
+            # resident publish frame: the sealed per-shard index rides the
+            # done frame back to the trainer, which rebinds it to the staged
+            # shm buffers as the warm (memory-resident) restore source
+            "shards_index": index["shards"],
         }
 
     def abort(self, exc: Optional[BaseException] = None) -> None:
@@ -434,8 +523,10 @@ class _WriteEngine:
                 self._error = exc or RuntimeError("write aborted")
             self._closed = True
             self._cv.notify_all()
-        for t in self._threads:
-            t.join()
+        wedged = _join_pool(self._threads, "ckpt drain abort")
+        if wedged:
+            log.warning("ckpt drain abort: thread(s) %s still wedged in I/O",
+                        wedged)
         self._discard_all()
 
     def _discard_all(self) -> None:
@@ -477,16 +568,23 @@ class _WriteEngine:
                 return
             sink, off, length = task
             try:
-                sink.write_chunk(off, length)
-                _WRITE_BYTES.inc(length)
-                _WRITE_CHUNKS.inc()
+                wrote = sink.write_chunk(off, length)
+                if wrote:
+                    _WRITE_BYTES.inc(length)
+                    _WRITE_CHUNKS.inc()
+                else:
+                    _DELTA_SKIPPED_BYTES.inc(length)
                 with sink.lock:
                     sink.chunks_left -= 1
                     last = sink.chunks_left == 0
                 if last:
                     sink.complete()
                 with self._cv:
-                    self.bytes_written += length
+                    if wrote:
+                        self.bytes_written += length
+                    else:
+                        self.bytes_skipped += length
+                        self.chunks_skipped += 1
                     self._pending_chunks -= 1
                     if last:
                         self.payloads_done.append(sink.payload)
@@ -511,7 +609,9 @@ class _WriteEngine:
         if total is None:
             total = sum(s.nbytes for s in self._sinks)
         try:
-            self._progress_cb(self.bytes_written, total)
+            # skipped (delta) bytes count as drained: progress must reach
+            # the announced plan total for the save to read as complete
+            self._progress_cb(self.bytes_written + self.bytes_skipped, total)
         except Exception as exc:  # noqa: BLE001 - progress is best-effort
             log.debug("progress callback failed: %r", exc)
 
@@ -636,13 +736,7 @@ def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
     out = np.empty(global_shape, dtype=dtype)
     for s in shards:
         pdir = os.path.join(ckpt_dir, f"process_{s['process_index']}")
-        raw = read_verified_shard(
-            os.path.join(pdir, shard_filename(leaf_idx, s["shard_idx"])),
-            nbytes=s.get("nbytes"),
-            crc=s.get("crc"),
-            chunks=s.get("chunks"),
-            site="global_shard",
-        )
+        raw = _read_shard_resolved(ckpt_dir, pdir, s)
         arr = from_bytes(raw, s["dtype"], s["shape"])
         slices = tuple(slice(a, b) for a, b in s["index"])
         out[slices] = arr
@@ -652,6 +746,54 @@ def read_leaf(ckpt_dir: str, meta: Dict[str, Any], leaf_idx: int) -> np.ndarray:
             f"{global_shape}"
         )
     return out
+
+
+def _read_shard_resolved(ckpt_dir: str, pdir: str, s: Dict[str, Any]) -> bytes:
+    """Serial whole-shard read honoring delta provenance: spans whose index
+    row names a base generation are read from that file, the rest from the
+    shard's own file; every span is crc-verified and the composed digest
+    checked, exactly like the provenance-free path."""
+    path = os.path.join(pdir, shard_filename(s["leaf_idx"], s["shard_idx"]))
+    bases = [
+        b if os.path.isabs(b) else os.path.join(ckpt_dir, b)
+        for b in (s.get("bases") or [])
+    ]
+    if not bases:
+        return read_verified_shard(
+            path,
+            nbytes=s.get("nbytes"),
+            crc=s.get("crc"),
+            chunks=s.get("chunks"),
+            site="global_shard",
+        )
+    name = os.path.basename(path)
+    nbytes = int(s["nbytes"])
+    chunks = s["chunks"]
+    spans = span_plan(nbytes, chunks, site="global_shard", name=name)
+    base_of = {int(c[0]): int(c[3]) for c in chunks if len(c) > 3}
+    out = bytearray(nbytes)
+    readers: Dict[int, ChunkReader] = {}
+    try:
+        crcs = []
+        for off, length, want in spans:
+            b = base_of.get(off, -1)
+            r = readers.get(b)
+            if r is None:
+                r = ChunkReader(
+                    path if b < 0 else bases[b], site="global_shard"
+                )
+                r.check_size(nbytes)
+                readers[b] = r
+            mv = memoryview(out)[off : off + length]
+            r.pread_into(mv, off, length)
+            crcs.append(
+                verify_chunk(mv, want, "global_shard", name=name, off=off)
+            )
+        verify_composed(crcs, s.get("crc"), "global_shard", name=name)
+    finally:
+        for r in readers.values():
+            r.close()
+    return bytes(out)
 
 
 # -- parallel verified restore engine ----------------------------------------
@@ -683,15 +825,22 @@ class _LeafRestore:
 
 
 class _ShardSource:
-    """One shard file being read (possibly by many threads) into its
+    """One shard being read (possibly by many threads) into its
     destination — straight into the leaf's final buffer when the shard's
     index box is C-contiguous there (whole-leaf shards, leading-axis
-    sharding), else into an aligned scratch placed on completion."""
+    sharding), else into an aligned scratch placed on completion.
+
+    Byte sources, in warm-ladder order: a **resident shm buffer** (the
+    committed generation still staged in memory — no file is opened at
+    all), else the shard file — with delta-provenance spans routed to
+    their recorded base files (``chunks`` rows carrying a 4th element
+    index into the shard's ``bases`` path list).  Every span is crc-
+    verified against the committed index regardless of source."""
 
     SITE = "restore_shard"
 
     def __init__(self, ckpt_dir: str, s: Dict[str, Any], leaf: _LeafRestore,
-                 dtype: np.dtype):
+                 dtype: np.dtype, res_buf: Optional[memoryview] = None):
         self.meta = s
         self.leaf = leaf
         self.name = shard_filename(s["leaf_idx"], s["shard_idx"])
@@ -709,7 +858,27 @@ class _ShardSource:
         self.slices = tuple(slice(a, b) for a, b in s["index"])
         self.crc = s.get("crc")
         self.chunks = s.get("chunks")
-        self.reader = ChunkReader(self.path, site=self.SITE)
+        self.bases: List[str] = [
+            b if os.path.isabs(b) else os.path.join(ckpt_dir, b)
+            for b in (s.get("bases") or [])
+        ]
+        # provenance routing: span offset -> base index (absent = own file)
+        self.chunk_base: Dict[int, int] = {
+            int(c[0]): int(c[3])
+            for c in (self.chunks or ())
+            if len(c) > 3
+        }
+        # the resident source must cover the shard exactly and be sealed by
+        # per-chunk digests (verify-on-read needs the index crcs)
+        if res_buf is not None and (
+            len(res_buf) != self.nbytes or not self.chunks
+        ) and self.nbytes:
+            res_buf = None
+        self.res_buf = res_buf
+        self.from_shm = res_buf is not None
+        # one lazily-opened reader per physical file: -1 is the shard's own
+        # file, >=0 indexes ``bases``; none at all on the resident path
+        self._readers: Dict[int, ChunkReader] = {}
         # span list: recorded write chunks when present (per-span crc);
         # one whole-file span when only the composed digest survived (a
         # sequential crc cannot be parallelized); synthesized spans with
@@ -742,20 +911,31 @@ class _ShardSource:
         self.chunks_left = len(self.spans)
         self.span_crcs: List[Tuple[int, int]] = []  # (off, crc)
         self.crc_ns = 0
-        self._size_checked = False
+
+    def _reader_for(self, off: int) -> ChunkReader:
+        base = self.chunk_base.get(off, -1)
+        with self.lock:
+            r = self._readers.get(base)
+            if r is None:
+                path = self.path if base < 0 else self.bases[base]
+                r = ChunkReader(path, site=self.SITE)
+                # every source file — own shard (delta files are truncated
+                # up to full size) or base generation — is full logical size
+                r.check_size(self.nbytes)
+                self._readers[base] = r
+            return r
 
     def read_span(self, off: int, length: int, want: Optional[int]) -> int:
-        """Worker-thread unit: pread the span into its final destination and
+        """Worker-thread unit: read the span into its final destination and
         crc it in-flight.  Returns the verify CPU ns spent."""
-        if not self._size_checked:
-            with self.lock:
-                if not self._size_checked:
-                    self.reader.check_size(self.nbytes)
-                    self._size_checked = True
         if length == 0:
             return 0
         mv = memoryview(self.dst)[off : off + length]
-        self.reader.pread_into(mv, off, length)
+        if self.res_buf is not None:
+            # verify the destination copy (catches the memcpy too)
+            mv[:] = self.res_buf[off : off + length]
+        else:
+            self._reader_for(off).pread_into(mv, off, length)
         spent = 0
         if want is not None or self.chunks:
             t0 = time.monotonic_ns()
@@ -766,9 +946,15 @@ class _ShardSource:
                 self.crc_ns += spent
         return spent
 
+    def close_readers(self) -> None:
+        with self.lock:
+            readers, self._readers = list(self._readers.values()), {}
+        for r in readers:
+            r.close()
+
     def complete(self) -> None:
         """Last span landed: composed-digest verdict, then placement."""
-        self.reader.close()
+        self.close_readers()
         if self.chunks:
             crcs = [c for _off, c in sorted(self.span_crcs)]
             verify_composed(crcs, self.crc, self.SITE, name=self.name)
@@ -801,12 +987,18 @@ class _RestoreEngine:
         meta: Dict[str, Any],
         num_threads: Optional[int] = None,
         leaf_indices: Optional[Iterable[int]] = None,
+        resident: Optional[Dict[Tuple[int, int, int], memoryview]] = None,
     ):
         from ...utils.dtypes import resolve_dtype
 
         self.ckpt_dir = ckpt_dir
         self.num_threads = resolve_restore_threads(num_threads)
         _RESTORE_THREADS.set(self.num_threads)
+        # (process_index, leaf_idx, shard_idx) -> committed-generation shm
+        # view; shards found here are sourced from memory, the rest from
+        # disk (shard_idx alone is only unique within one process)
+        self._resident = resident or {}
+        self.bytes_shm = 0
         #: (leaf_idx, np.ndarray) per completed leaf, then a terminal
         #: ``(None, error-or-None)`` once the pool drains
         self.ready: "queue_mod.Queue[Tuple[Optional[int], Any]]" = (
@@ -840,7 +1032,12 @@ class _RestoreEngine:
             self._leaves[leaf_idx] = leaf
             # big shards first so the pool saturates immediately
             for s in sorted(shards, key=lambda s: -(s.get("nbytes") or 0)):
-                src = _ShardSource(ckpt_dir, s, leaf, dtype)
+                src = _ShardSource(
+                    ckpt_dir, s, leaf, dtype,
+                    res_buf=self._resident.get(
+                        (s["process_index"], s["leaf_idx"], s["shard_idx"])
+                    ),
+                )
                 self._sources.append(src)
                 leaf.shards_left += 1
                 leaf.boxes.append(s["index"])
@@ -899,9 +1096,14 @@ class _RestoreEngine:
                         self._finish_shard(src)
                     _RESTORE_BYTES.inc(length)
                     _RESTORE_CHUNKS.inc()
+                    _RESTORE_SOURCE.labels(
+                        source="shm" if src.from_shm else "disk"
+                    ).inc(length)
                     with self._cv:
                         self.bytes_read += length
                         self.chunks_read += 1
+                        if src.from_shm:
+                            self.bytes_shm += length
                         self._pending -= 1
                         if self._pending <= 0:
                             self._cv.notify_all()
@@ -951,6 +1153,7 @@ class _RestoreEngine:
     def stats(self) -> Dict[str, Any]:
         return {
             "bytes_read": self.bytes_read,
+            "bytes_shm": self.bytes_shm,
             "chunks": self.chunks_read,
             "shards": len(self._sources),
             "leaves": len(self._leaves),
@@ -966,7 +1169,9 @@ class _RestoreEngine:
             if self._error is None and self._pending > 0:
                 self._error = exc or RuntimeError("restore aborted")
             self._cv.notify_all()
-        for t in self._threads:
-            t.join()
+        wedged = _join_pool(self._threads, "ckpt restore close")
+        if wedged:
+            log.warning("ckpt restore close: reader thread(s) %s still "
+                        "wedged in I/O", wedged)
         for src in self._sources:
-            src.reader.close()
+            src.close_readers()

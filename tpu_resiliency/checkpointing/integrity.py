@@ -270,7 +270,9 @@ def span_plan(
     if chunks:
         spans: List[Tuple[int, int, Optional[int]]] = []
         end = 0
-        for off, length, want in sorted(tuple(c) for c in chunks):
+        # rows may carry a 4th element (delta provenance: index of the base
+        # file holding the bytes) — tiling validation only needs the span
+        for off, length, want in sorted(tuple(c)[:3] for c in chunks):
             if off != end or off + length > nbytes:
                 _CORRUPT.labels(site=site).inc()
                 raise CheckpointCorruptError(

@@ -40,6 +40,15 @@ boundary verifies it —
   (``integrity.verify_blob_file``), so a sweep's peak memory is one scratch
   chunk, never a resident copy of the biggest retained blob.
 
+Warm restore ladder: the last replicated generation's blobs stay
+memory-resident (own + clique replicas), so ``load`` tries memory before
+disk — own resident copy → clique peers' resident copies over the TCP
+exchange (advert-filtered via the store, chunk-striped across holders,
+crc-verified on both ends) → own disk blob → peer disk retrieval.
+``tpurx_ckpt_restore_source_total{source}`` records the serving rung in
+bytes, and a successful peer-memory fetch is persisted to disk so the
+warm path repairs durability instead of masking its absence.
+
 File layout: <root>/iter_<I>/rank_<R>.tpurx (+ .done marker per blob;
 quarantined blobs keep their bytes as ``rank_<R>.tpurx.corrupt`` for
 post-mortem but never count toward holdings).
@@ -51,16 +60,26 @@ import json
 import os
 import re
 import shutil
+import struct
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
+from zlib import crc32
 
 from ...store.tree import combine_json_merge, tree_gather
 from ...telemetry import counter, gauge
 from ...utils import env as _envknobs
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
-from ..async_ckpt.writer import resolve_restore_threads
+# _RESTORE_SOURCE is shared with the shard writer's engine ("shm"/"disk"
+# labels); the manager ladder adds local_resident / peer_memory /
+# local_disk / peer_disk
+from ..async_ckpt.writer import (
+    _RESTORE_SOURCE,
+    default_chunk_bytes,
+    resolve_restore_threads,
+)
 from ..integrity import (
     CORRUPT_SENTINEL,
     CheckpointCorruptError,
@@ -68,13 +87,22 @@ from ..integrity import (
     read_verified_blob,
     verify_blob,
     verify_blob_file,
+    verify_chunk,
 )
-from .replication import CliqueReplication
+from .replication import REQ_BIT, CliqueReplication
 from .state_dict import TensorAwareTree
 
 log = get_logger("local_ckpt")
 
 _ITER_RE = re.compile(r"^iter_(\d+)$")
+
+_CRC = struct.Struct("<I")
+# Peer-memory reply tags: bits 30+29 set, bit 31 clear — disjoint from save
+# replication (low bits), from retrieval exchange rounds (0x40000000 with the
+# attempt counter in bits 24-29) and from the REQ_BIT request space, so a
+# chunk reply can never satisfy an exchange-plan receive or vice versa.
+_REPLY_BASE = 0x60000000
+_SEQ_MASK = 0x1FFFFFFF
 
 _FALLBACK_DEPTH = gauge(
     "tpurx_ckpt_fallback_depth",
@@ -101,7 +129,7 @@ class LocalCheckpointManager:
         replication: Optional[CliqueReplication] = None,
         keep_last: int = 2,
         session: str = "default",
-        peer_timeout: float = 120.0,
+        peer_timeout: Optional[float] = None,
         scrub_interval: Optional[float] = None,
         store_namespace: str = "localckpt",
     ):
@@ -113,7 +141,9 @@ class LocalCheckpointManager:
         self.keep_last = keep_last
         # bounds ONE peer-retrieval exchange round (election + transfer);
         # a dead holder surfaces as a timeout feeding re-election instead
-        # of wedging the restore
+        # of wedging the restore.  Ctor arg overrides TPURX_CKPT_PEER_TIMEOUT.
+        if peer_timeout is None:
+            peer_timeout = _envknobs.CKPT_PEER_TIMEOUT.get()
         self.peer_timeout = peer_timeout
         # Store-key namespace for holdings/barriers/verdicts.  Restarted
         # incarnations should pass a cycle-fenced namespace (e.g.
@@ -130,6 +160,18 @@ class LocalCheckpointManager:
         self._valid_gen = 0
         self._scrubber: Optional[threading.Thread] = None
         self._scrub_stop = threading.Event()
+        # warm restore ladder state: the last replicated generation's blobs
+        # stay memory-resident ({data_rank: blob}, includes clique replicas)
+        # so a same-host restart restores from memory and clique peers can
+        # source our blob over the exchange without touching disk
+        self._warm_lock = threading.Lock()
+        self._resident: Optional[Tuple[int, Dict[int, bytes]]] = None
+        self._req_seq = 0
+        # the peer-memory rung needs the TCP exchange; ICI-backed
+        # replication strategies replicate on-device and have none
+        self._exchange = getattr(replication, "exchange", None)
+        if self._exchange is not None:
+            self._exchange.request_handler = self._serve_peer_request
         if scrub_interval is None:
             scrub_interval = _envknobs.CKPT_SCRUB_INTERVAL.get()
         if scrub_interval:
@@ -194,6 +236,10 @@ class LocalCheckpointManager:
             blobs = self.replication.replicate(blob, tag=iteration & 0x3FFFFFFF)
         else:
             blobs = {self.rank: blob}
+        # warm ladder: keep this generation's blobs memory-resident and
+        # advertise the holding BEFORE the (possibly async) disk write — a
+        # restore racing the write can already be served from memory
+        self._retain_resident(iteration, blobs)
 
         def _write_and_publish():
             d = self._iter_dir(iteration)
@@ -389,6 +435,254 @@ class LocalCheckpointManager:
         if self._scrubber is not None:
             self._scrubber.join(timeout=10)
             self._scrubber = None
+
+    # -- warm restore ladder: resident blobs + peer memory -----------------
+
+    def close(self) -> None:
+        """Stop background work and withdraw the peer-memory advert.  The
+        resident blobs die with the process either way; deleting the advert
+        keeps restarted peers from requesting generations this incarnation
+        no longer holds."""
+        self.stop_scrubber()
+        if self._exchange is not None:
+            self._exchange.request_handler = None
+        with self._warm_lock:
+            self._resident = None
+        if self.store is not None:
+            try:
+                self.store.delete(f"{self._ns}/resident/{self.rank}")
+            except Exception:  # noqa: BLE001 - advert cleanup is best-effort
+                log.debug("resident advert delete failed", exc_info=True)
+
+    def _retain_resident(self, iteration: int, blobs: Dict[int, bytes]) -> None:
+        if not _envknobs.CKPT_RESIDENT.get():
+            return
+        with self._warm_lock:
+            self._resident = (iteration, dict(blobs))
+        if self.store is not None:
+            self.store.set(f"{self._ns}/resident/{self.rank}", str(iteration))
+
+    def _fault_armed(self, fault_class: str) -> bool:
+        """Soak-harness fault gate (class[:arg] spec, optional rank filter)."""
+        spec = _envknobs.FAULT.get() or ""
+        if spec.split(":", 1)[0] != fault_class:
+            return False
+        ranks = _envknobs.FAULT_RANKS.get()
+        if ranks:
+            return self.rank in {int(r) for r in ranks.split(",") if r.strip()}
+        return True
+
+    def _next_seq(self) -> int:
+        with self._warm_lock:
+            self._req_seq += 1
+            return self._req_seq & _SEQ_MASK
+
+    def _serve_peer_request(self, sender: int, tag: int, payload: bytes) -> None:
+        """Peer-memory request handler (runs on the exchange's connection
+        threads).  ``meta`` replies {have, nbytes}; ``chunk`` replies 4-byte
+        crc32 + the raw span.  Anything we cannot serve is dropped — the
+        requester's receive times out and its ladder falls through to disk,
+        which is the designed degradation for a cold or dead peer."""
+        del tag  # the reply tag rides the request payload
+        if self._fault_armed("peer_mem_stall"):
+            log.warning(
+                "peer_mem_stall fault armed: dropping peer-memory request "
+                "from rank %s", sender,
+            )
+            return
+        req = json.loads(payload.decode())
+        reply_tag = int(req["reply_tag"])
+        # reply straight to the requester's advertised address: resolving it
+        # through the shared store client could block behind this manager's
+        # own thread long-polling a collective round on the same socket
+        reply_addr = req["reply_addr"]
+        res = self._resident
+        blob: Optional[bytes] = None
+        if res is not None and res[0] == int(req["iteration"]):
+            blob = res[1].get(int(req["data_rank"]))
+        if req["op"] == "meta":
+            meta = {"have": blob is not None,
+                    "nbytes": 0 if blob is None else len(blob)}
+            self._exchange.send_addr(
+                reply_addr, reply_tag, json.dumps(meta).encode()
+            )
+        elif req["op"] == "chunk" and blob is not None:
+            off, length = int(req["off"]), int(req["len"])
+            data = blob[off:off + length]
+            self._exchange.send_addr(
+                reply_addr, reply_tag, _CRC.pack(crc32(data)) + data
+            )
+
+    def _peer_memory_fetch(self, iteration: int) -> Optional[bytes]:
+        """Fetch this rank's blob for ``iteration`` out of clique peers'
+        MEMORY-resident copies: advert-filtered meta round, then the blob is
+        striped chunk-wise round-robin across every holder with
+        ``TPURX_CKPT_PEER_STREAMS`` concurrent streams.  Each chunk is crc32d
+        by the sender and verified on arrival; the assembled blob must pass
+        the frame-footer check.  Any timeout/corruption returns None — the
+        ladder falls through to disk.  Bounded end-to-end by
+        ``TPURX_CKPT_PEER_MEM_TIMEOUT`` (0 disables the rung)."""
+        if self.store is None or self._exchange is None:
+            return None
+        budget = _envknobs.CKPT_PEER_MEM_TIMEOUT.get()
+        if not budget:
+            return None
+        peers = [m for m in self.replication.members() if m != self.rank]
+        if not peers:
+            return None
+        deadline = time.monotonic() + budget
+        ex = self._exchange
+
+        def _ask(peer: int, op_payload: Dict, timeout: float) -> Optional[bytes]:
+            seq = self._next_seq()
+            reply_tag = _REPLY_BASE | seq
+            op_payload["reply_tag"] = reply_tag
+            op_payload["reply_addr"] = ex.advertised_addr
+            ex.send(peer, REQ_BIT | seq, json.dumps(op_payload).encode(),
+                    timeout=timeout)
+            return ex.recv(peer, reply_tag, timeout=timeout)
+
+        def _probe(peer: int) -> Optional[Tuple[int, int]]:
+            """(peer, nbytes) if the peer's resident copy can serve us."""
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                advert = self.store.get(
+                    f"{self._ns}/resident/{peer}",
+                    timeout=min(2.0, remaining),
+                ).decode()
+                if int(advert) != iteration:
+                    return None
+                remaining = max(0.1, deadline - time.monotonic())
+                meta = json.loads(_ask(
+                    peer,
+                    {"op": "meta", "iteration": iteration,
+                     "data_rank": self.rank},
+                    remaining,
+                ).decode())
+                if meta.get("have") and meta["nbytes"] > 0:
+                    return peer, int(meta["nbytes"])
+            except (TimeoutError, OSError, ValueError, KeyError):
+                pass
+            return None
+
+        with ThreadPoolExecutor(
+            max_workers=len(peers), thread_name_prefix="tpurx-peermem-probe"
+        ) as pool:
+            probed = [p for p in pool.map(_probe, peers) if p is not None]
+        if not probed:
+            return None
+        sizes = {n for _p, n in probed}
+        if len(sizes) != 1:
+            log.warning(
+                "peer-memory holders disagree on blob size for iteration %s "
+                "(%s); skipping the rung", iteration, sorted(sizes),
+            )
+            return None
+        nbytes = sizes.pop()
+        holders = [p for p, _n in probed]
+        chunk = default_chunk_bytes()
+        tiles = [(off, min(chunk, nbytes - off))
+                 for off in range(0, nbytes, chunk)]
+        buf = bytearray(nbytes)
+
+        def _fetch_tile(idx: int) -> bool:
+            off, length = tiles[idx]
+            peer = holders[idx % len(holders)]
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                reply = _ask(
+                    peer,
+                    {"op": "chunk", "iteration": iteration,
+                     "data_rank": self.rank, "off": off, "len": length},
+                    remaining,
+                )
+                if reply is None or len(reply) != _CRC.size + length:
+                    return False
+                (want,) = _CRC.unpack_from(reply)
+                data = memoryview(reply)[_CRC.size:]
+                verify_chunk(data, want, site="peer_mem",
+                             name=f"rank_{self.rank}.tpurx", off=off)
+                buf[off:off + length] = data
+                return True
+            except (TimeoutError, OSError, CheckpointCorruptError) as exc:
+                log.warning(
+                    "peer-memory chunk fetch failed (iteration %s, peer %s, "
+                    "off %s): %s", iteration, peer, off, exc,
+                )
+                return False
+
+        streams = max(1, _envknobs.CKPT_PEER_STREAMS.get())
+        if len(tiles) == 1:
+            ok = [_fetch_tile(0)]
+        else:
+            with ThreadPoolExecutor(
+                max_workers=min(streams, len(tiles)),
+                thread_name_prefix="tpurx-peermem-fetch",
+            ) as pool:
+                ok = list(pool.map(_fetch_tile, range(len(tiles))))
+        if not all(ok):
+            return None
+        try:
+            verify_blob(buf, site="peer_mem")
+        except CheckpointCorruptError as exc:
+            log.warning(
+                "peer-memory blob for iteration %s failed footer "
+                "verification (%s); falling through to disk", iteration, exc,
+            )
+            return None
+        return bytes(buf)
+
+    def _persist_fetched(self, iteration: int, blob: bytes) -> None:
+        """A peer-memory restore leaves no durable copy behind — write one
+        (and republish holdings) so the next restore and peers' exchange
+        plans can use it."""
+        d = self._iter_dir(iteration)
+        os.makedirs(d, exist_ok=True)
+        path = self._blob_path(iteration, self.rank)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        with open(path + ".done", "w") as f:
+            f.write("ok")
+        self._publish_holdings()
+
+    def _resident_blob(self, iteration: int) -> Optional[bytes]:
+        """Own rung of the warm ladder: the memory-resident copy, footer-
+        verified (a corrupt one is dropped, never quarantines disk)."""
+        if not _envknobs.CKPT_RESIDENT.get():
+            return None
+        with self._warm_lock:
+            res = self._resident
+        if res is None or res[0] != iteration:
+            return None
+        blob = res[1].get(self.rank)
+        if blob is None:
+            return None
+        try:
+            verify_blob(blob, site="local_resident")
+        except CheckpointCorruptError as exc:
+            log.warning(
+                "resident blob for iteration %s failed verification (%s); "
+                "dropping it and falling through", iteration, exc,
+            )
+            with self._warm_lock:
+                if self._resident is res:
+                    res[1].pop(self.rank, None)
+            return None
+        return blob
+
+    def drop_resident(self) -> None:
+        """TEST/soak hook: forget the resident generation (forces the ladder
+        past the memory rung) without touching the advert or disk."""
+        with self._warm_lock:
+            self._resident = None
 
     # -- find_latest -------------------------------------------------------
 
@@ -597,24 +891,40 @@ class LocalCheckpointManager:
         return set(range(self.world_size)) <= covered
 
     def _obtain_blob(self, iteration: int) -> bytes:
-        """This rank's blob for ``iteration``: the local copy when intact
-        (verified; corrupt → quarantined), else retrieved from peers."""
-        path = self._blob_path(iteration, self.rank)
-        blob: Optional[bytes] = None
-        if os.path.exists(path) and os.path.exists(path + ".done"):
-            try:
-                blob = read_verified_blob(path, site="local_blob")
-            except CheckpointCorruptError as exc:
-                log.warning(
-                    "own blob for iteration %s corrupt (%s); quarantining "
-                    "and retrieving from peers", iteration, exc,
-                )
-                self._quarantine(iteration, self.rank, site="local_blob")
+        """This rank's blob for ``iteration``, through the warm restore
+        ladder: own memory-resident copy (footer-verified) → clique peers'
+        resident copies over the exchange (chunk-striped, crc-checked on
+        both ends) → own disk blob (verified; corrupt → quarantined) → peer
+        disk retrieval.  ``tpurx_ckpt_restore_source_total`` records which
+        rung served, in bytes."""
+        source = "local_resident"
+        blob = self._resident_blob(iteration)
         if blob is None:
+            blob = self._peer_memory_fetch(iteration)
+            if blob is not None:
+                source = "peer_memory"
+                # a peer-memory restore leaves no durable copy: write one
+                # so the next restore (and peers' exchange plans) can use it
+                self._persist_fetched(iteration, blob)
+        if blob is None:
+            source = "local_disk"
+            path = self._blob_path(iteration, self.rank)
+            if os.path.exists(path) and os.path.exists(path + ".done"):
+                try:
+                    blob = read_verified_blob(path, site="local_blob")
+                except CheckpointCorruptError as exc:
+                    log.warning(
+                        "own blob for iteration %s corrupt (%s); quarantining "
+                        "and retrieving from peers", iteration, exc,
+                    )
+                    self._quarantine(iteration, self.rank, site="local_blob")
+        if blob is None:
+            source = "peer_disk"
             blob = self._retrieve_from_peers(iteration)
         elif self.store is not None and self.replication is not None:
             # still participate in the exchange plan as a sender
             self._retrieve_from_peers(iteration, have_own=True)
+        _RESTORE_SOURCE.labels(source=source).inc(len(blob))
         return blob
 
     def _retrieve_from_peers(self, iteration: int, have_own: bool = False) -> Optional[bytes]:

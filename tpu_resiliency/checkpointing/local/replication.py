@@ -20,7 +20,7 @@ import socket
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ...utils import env
 from ...utils.logging import get_logger
@@ -37,6 +37,15 @@ SEND_POLICY = RetryPolicy(max_attempts=5, base_delay=0.2, max_delay=2.0,
 
 _U64 = struct.Struct("<Q")
 _TAG = struct.Struct("<I")
+
+# Tag-space partition (32-bit tags).  Bit 31 marks a REQUEST frame: instead
+# of landing in the receive inbox, it is dispatched to the exchange's
+# ``request_handler`` (peer-memory checkpoint sourcing).  The handler replies
+# on the paired reply tag (bit 31 clear, bit 30 set), which DOES ride the
+# inbox like any other blob.  Save replication uses tags with both high bits
+# clear and retrieval exchange rounds use 0x40000000|..., so the spaces
+# never collide.
+REQ_BIT = 0x80000000
 
 
 def clique_members(rank: int, world_size: int, factor: int, jump: int = 1) -> List[int]:
@@ -70,13 +79,21 @@ class PeerExchange:
         self.port = self._server.getsockname()[1]
         self._inbox: Dict[Tuple[int, int], bytes] = {}
         self._inbox_cv = threading.Condition()
+        # Inbound REQUEST frames (tag bit 31 set) are dispatched here instead
+        # of the inbox; the handler runs on the connection thread and is
+        # responsible for sending its own reply via ``send``.  Unset handler
+        # (or a handler that raises) drops the request — the requester's recv
+        # times out and falls through its ladder, which is the designed
+        # degradation for a peer that cannot serve.
+        self.request_handler: Optional[Callable[[int, int, bytes], None]] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._serve, name=f"tpurx-peerx-{rank}", daemon=True
         )
         self._thread.start()
+        self.advertised_addr = f"{self._my_addr()}:{self.port}"
         # tpurx: disable=TPURX013 -- one endpoint key per rank, overwritten on every (re)bind: bounded by world_size
-        self.store.set(f"{self.ns}/addr/{rank}", f"{self._my_addr()}:{self.port}")
+        self.store.set(f"{self.ns}/addr/{rank}", self.advertised_addr)
 
     def _my_addr(self) -> str:
         """The address peers can reach us at.  gethostbyname(hostname) maps to
@@ -130,6 +147,17 @@ class PeerExchange:
             payload = self._recv_exact(conn, n)
             if payload is None:
                 return
+            if tag & REQ_BIT:
+                handler = self.request_handler
+                if handler is not None:
+                    try:
+                        handler(int(sender), int(tag), payload)
+                    except Exception:  # noqa: BLE001 - requester times out
+                        log.exception(
+                            "peer request handler failed (sender=%s tag=%#x)",
+                            sender, tag,
+                        )
+                return
             with self._inbox_cv:
                 self._inbox[(int(sender), int(tag))] = payload
                 self._inbox_cv.notify_all()
@@ -171,6 +199,21 @@ class PeerExchange:
                 return
             except OSError as exc:
                 retrier.backoff(exc)
+
+    def send_addr(self, addr: str, tag: int, payload: bytes, timeout: float = 60.0) -> None:
+        """Send to an explicit ``host:port``, bypassing store resolution.
+        Request handlers reply from connection threads with this: a store
+        lookup there can block behind the owner thread's long-poll on the
+        SAME store client (e.g. a tree-gather wait), stalling the reply past
+        the requester's deadline.  No retry — a failed reply means the
+        requester times out and falls through, which is the designed
+        degradation."""
+        host, _, port = addr.rpartition(":")
+        with socket.create_connection((host, int(port)), timeout=timeout) as conn:
+            conn.sendall(
+                _U64.pack(self.rank) + _U64.pack(len(payload)) + _TAG.pack(tag)
+            )
+            conn.sendall(payload)
 
     def recv(self, from_rank: int, tag: int, timeout: float = 60.0) -> bytes:
         deadline = time.monotonic() + timeout

@@ -30,7 +30,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional, Sequence
 
-from ..telemetry import counter, gauge
+from ..telemetry import BYTE_BUCKETS, counter, gauge, histogram
 from ..utils import env
 from .client import StoreTimeout
 
@@ -47,6 +47,14 @@ _FANIN = gauge(
     "Inbound payloads consumed by this rank in the last tree round "
     "(bounded by the fanout; O(world_size) would mean a regression to "
     "flat gathers)",
+)
+_PAYLOAD_BYTES = histogram(
+    "tpurx_tree_payload_bytes",
+    "Size of the combined payload one tree node publishes upward, per call "
+    "site (before any trim) — the distribution that grows O(world) toward "
+    "the root when a caller's per-rank maps are unbounded",
+    labels=("site",),
+    buckets=BYTE_BUCKETS,
 )
 
 
@@ -118,6 +126,8 @@ def tree_gather(
     site: str = "generic",
     stats: Optional[dict] = None,
     gc_prefix: Optional[str] = None,
+    cap_bytes: Optional[int] = None,
+    trim: Optional[Callable[[bytes, int], bytes]] = None,
 ) -> Optional[bytes]:
     """One reduction round over the tree.
 
@@ -139,7 +149,17 @@ def tree_gather(
     crashed round stranded) are reclaimed without a read fence.
 
     ``stats`` (out-param, same idiom as ``load_checkpoint``): ``inbound``
-    (payload count consumed here), ``children``, ``depth``.
+    (payload count consumed here), ``children``, ``depth``, and ``trimmed``
+    (True when this node's combined payload was cut down).
+
+    ``cap_bytes`` / ``trim``: payload-size bound for callers whose per-rank
+    maps grow O(world) toward the root (outlier maps, per-rank snapshots).
+    When the combined payload at ANY node exceeds the cap (``cap_bytes``,
+    else ``TPURX_TREE_PAYLOAD_CAP``; 0 = unbounded), it is handed to
+    ``trim(payload, cap)`` before being published upward — so the bound
+    holds at every level, not just the root.  Callers that cannot tolerate
+    loss (holdings/verdict rounds) simply don't pass ``trim``; the
+    ``tpurx_tree_payload_bytes`` histogram still records their growth.
     """
     topo = TreeTopology(rank, world_size, fanout)
     deadline = time.monotonic() + timeout
@@ -173,9 +193,16 @@ def tree_gather(
     else:
         combined = payload
     _FANIN.set(inbound)
+    _PAYLOAD_BYTES.labels(site=site).observe(len(combined))
+    cap = env.TREE_PAYLOAD_CAP.get() if cap_bytes is None else cap_bytes
+    trimmed = False
+    if trim is not None and cap and len(combined) > cap:
+        combined = trim(combined, cap)
+        trimmed = True
     if stats is not None:
         stats.update(
-            inbound=inbound, children=list(topo.children), depth=topo.depth()
+            inbound=inbound, children=list(topo.children), depth=topo.depth(),
+            trimmed=trimmed,
         )
     if rank == 0:
         if broadcast:
@@ -208,3 +235,31 @@ def combine_json_merge(payloads: Sequence[bytes]) -> bytes:
 
 def combine_int_max(payloads: Sequence[bytes]) -> bytes:
     return str(max(int(raw) for raw in payloads)).encode()
+
+
+def trim_json_sampled(payload: bytes, cap_bytes: int) -> bytes:
+    """``trim`` companion to :func:`combine_json_merge`: stride-sample the
+    object's keys down toward ``cap_bytes``, recording what was dropped.
+
+    Per-rank maps (telemetry snapshots, outlier tables) grow O(world) toward
+    the root; sampling keeps a representative spread across the sorted key
+    space instead of silently favoring low ranks.  The count of dropped
+    entries is carried in a ``"_trimmed": {"kept", "total"}`` marker —
+    accumulated across tree levels, so the root knows the true population
+    size even after several trims.  Consumers must skip ``_``-prefixed keys.
+    """
+    import json
+    import math
+
+    obj = json.loads(payload if isinstance(payload, str) else payload.decode())
+    prior = obj.pop("_trimmed", None)
+    # entries present here, plus those a lower level already dropped (the
+    # survivors of that trim are in ``obj``, so don't double-count them)
+    total = len(obj) + ((prior["total"] - prior["kept"]) if prior else 0)
+    keys = sorted(obj, key=str)
+    # proportional estimate: keep the fraction of keys that fits the cap
+    keep = max(1, (cap_bytes * len(keys)) // max(1, len(payload)))
+    stride = math.ceil(len(keys) / keep)
+    out = {k: obj[k] for k in keys[::stride]}
+    out["_trimmed"] = {"kept": len(out), "total": total}
+    return json.dumps(out).encode()

@@ -22,7 +22,7 @@ from __future__ import annotations
 import json
 from typing import Dict, List, Optional, Tuple
 
-from ..store.tree import combine_json_merge, tree_gather
+from ..store.tree import combine_json_merge, tree_gather, trim_json_sampled
 from .registry import Registry, get_registry
 
 K_PREFIX = "telemetry"
@@ -200,11 +200,19 @@ class CrossRankAggregator:
             gc_prefix=(
                 f"{K_PREFIX}/round/{round_idx - 2}/" if round_idx >= 2 else None
             ),
+            # per-rank snapshot maps grow O(world) toward the root: when
+            # TPURX_TREE_PAYLOAD_CAP is set, sample them at every level
+            # rather than shipping the full population through one node
+            trim=trim_json_sampled,
         )
         if self.rank != 0:
             return None
         self.store.set(K_LATEST, merged)
-        snapshots = {int(r): snap for r, snap in json.loads(merged).items()}
+        snapshots = {
+            int(r): snap
+            for r, snap in json.loads(merged).items()
+            if not r.startswith("_")  # skip the trim bookkeeping marker
+        }
         return aggregate_snapshots(snapshots)
 
 
@@ -216,4 +224,8 @@ def read_latest_snapshots(store) -> Dict[int, dict]:
     raw = store.try_get(K_LATEST)
     if raw is None:
         return {}
-    return {int(r): snap for r, snap in json.loads(raw.decode()).items()}
+    return {
+        int(r): snap
+        for r, snap in json.loads(raw.decode()).items()
+        if not r.startswith("_")
+    }

@@ -179,6 +179,12 @@ TREE_FANOUT = Knob(
     "TPURX_TREE_FANOUT", int, 16,
     "Fan-out of the rank→host→job reduction tree used by every "
     "cross-rank gather round.", group="store")
+TREE_PAYLOAD_CAP = Knob(
+    "TPURX_TREE_PAYLOAD_CAP", int, 0,
+    "Byte cap on the combined payload a tree-gather node publishes upward; "
+    "over-cap payloads are trimmed (stride-sampled with a '_trimmed' "
+    "marker) at every level when the caller opts into a trim function. "
+    "0 = unbounded.", group="store")
 STORE_TEST_COMPACT_CRASH = Knob(
     "TPURX_STORE_TEST_COMPACT_CRASH", int, None,
     "TEST-ONLY fault hook: crash the store journal compactor after N "
@@ -253,6 +259,30 @@ PEER_ADDR = Knob(
     "TPURX_PEER_ADDR", str, None,
     "Override of the replication peer address map: "
     "'rank:host:port,rank:host:port'.", group="checkpoint")
+CKPT_RESIDENT = Knob(
+    "TPURX_CKPT_RESIDENT", bool, True,
+    "Keep the last committed checkpoint generation memory-resident (the "
+    "staging shm pool / replica blobs) as the warm restore source.",
+    group="checkpoint")
+CKPT_DELTA = Knob(
+    "TPURX_CKPT_DELTA", bool, False,
+    "Delta saves: skip draining chunks whose crc32 matches the previous "
+    "committed index (requires digests; per-save delta= overrides; the "
+    "index records per-chunk provenance so restores cover every byte).",
+    group="checkpoint")
+CKPT_PEER_STREAMS = Knob(
+    "TPURX_CKPT_PEER_STREAMS", int, 4,
+    "Concurrent chunk streams of one peer-memory restore fetch.",
+    group="checkpoint")
+CKPT_PEER_MEM_TIMEOUT = Knob(
+    "TPURX_CKPT_PEER_MEM_TIMEOUT", float, 10.0,
+    "Deadline of the peer-memory restore rung before the ladder falls "
+    "through to disk (0 disables the rung).", group="checkpoint")
+CKPT_PEER_TIMEOUT = Knob(
+    "TPURX_CKPT_PEER_TIMEOUT", float, 120.0,
+    "Deadline of one peer-retrieval exchange round (election + transfer); "
+    "the LocalCheckpointManager peer_timeout ctor arg overrides.",
+    group="checkpoint")
 
 # -- telemetry / logging ----------------------------------------------------
 TELEMETRY = Knob(
