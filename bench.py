@@ -363,6 +363,10 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "store_fanin_p50_us", "store_fanin_p50_sharded_us",
         "store_shard_speedup", "store_fanin_ok", "store_fanin_gate_waived",
         "store_rdzv_close_ms", "store_rdzv_close_sharded_ms",
+        "rdzv10k_ranks", "rdzv10k_shards", "rdzv_close_10k_ms",
+        "rdzv_close_10k_pr6_ms", "rdzv10k_speedup", "rdzv10k_ok",
+        "rdzv10k_gate_waived", "barrier_arrival_rtts", "rdzv_join_rtts",
+        "store_promote_ms",
         "tm_store_ops", "tm_store_op_p50_us", "tm_store_op_p99_us",
         "tm_store_shard_ops", "tm_store_shard_failovers", "tm_tree_rounds",
         "tm_ckpt_saves", "tm_ckpt_stage_mb", "tm_restarts",
@@ -1402,6 +1406,27 @@ def bench_store_fanin(time_left_fn) -> dict:
             p.kill()
 
 
+def bench_rendezvous_10k(time_left_fn) -> dict:
+    """10k-rank rendezvous close A/B: affinity-routed one-RTT rounds vs
+    the prior protocol (3-RTT joins, per-key host reads, count-marker
+    waits) over an EQUAL shard fleet, plus the measured mutation-RTT
+    counts and the spare-promotion latency.  Single-source: the sweep
+    lives in benchmarks/bench_control_plane.py (standalone:
+    ``python benchmarks/bench_control_plane.py --native --shards 4``).
+    Gate: >=2x close speedup, waived on a 1-core host like the other
+    subprocess lanes."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.bench_control_plane import rendezvous_10k_sweep
+
+    ranks = 10000 if time_left_fn() > 120 else 2000
+    try:
+        return rendezvous_10k_sweep(shards=4, ranks=ranks, native=True)
+    except Exception as exc:  # no C++ toolchain: measure the python servers
+        print(f"bench: rdzv10k native shards unavailable ({exc!r}); "
+              f"python shards", file=sys.stderr, flush=True)
+        return rendezvous_10k_sweep(shards=4, ranks=ranks, native=False)
+
+
 def _telemetry_keys() -> dict:
     """Derive bench keys from the in-process telemetry registry — the same
     series production scrapes from the per-rank exporter, so bench numbers
@@ -1664,6 +1689,14 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional lane, never fatal
                 print(f"bench: store fan-in arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 60:
+            try:
+                _PARTIAL.update(bench_rendezvous_10k(time_left))
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: rdzv 10k arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
     except _ChildDeadline:
         print("bench: child hit its internal deadline — finalizing from "

@@ -17,8 +17,11 @@ Emits one JSON line per (size, metric).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
+import os
 import sys
+import tempfile
 import threading
 import time
 
@@ -125,11 +128,328 @@ def bench_consensus(port: int, n: int, calls: int = 4) -> dict:
     }
 
 
+# -- 10k-rank sweep: affinity + one-RTT rounds vs the PR 6 protocol ----------
+
+
+def _spawn_fleet(shards: int, native: bool):
+    """K shard servers, each its own OS process (real parallelism either
+    way: the native wrapper runs the C++ binary, the python path uses
+    ``spawn_shard_subprocess``).  Returns (endpoints, stop_fn)."""
+    from tpu_resiliency.store.sharding import free_port, spawn_shard_subprocess
+
+    if native:
+        from tpu_resiliency.store.native import NativeStoreServer
+
+        servers = [
+            NativeStoreServer(host="127.0.0.1", port=0).start()
+            for _ in range(shards)
+        ]
+        endpoints = [f"127.0.0.1:{s.port}" for s in servers]
+
+        def stop():
+            for s in servers:
+                s.stop()
+    else:
+        from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+        env = {"JAX_PLATFORMS": "cpu"}
+        disarm_platform_sitecustomize(env)
+        procs, endpoints = [], []
+        for _ in range(shards):
+            port = free_port()
+            procs.append(spawn_shard_subprocess(port, env=env))
+            endpoints.append(f"127.0.0.1:{port}")
+
+        def stop():
+            for p in procs:
+                p.kill()
+    return endpoints, stop
+
+
+def _run_pool(worker, ranks: int, workers: int) -> None:
+    """Drive ``ranks`` simulated clients from a bounded thread pool: each
+    thread registers its slice sequentially, so 10k ranks costs 10k ops
+    over ~32 sockets, not 10k threads."""
+    per, extra = divmod(ranks, workers)
+    threads = [
+        threading.Thread(
+            target=worker, args=(tid, per + (1 if tid < extra else 0)),
+            daemon=True,
+        )
+        for tid in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def rdzv_close_fast_ms(endpoints, ranks: int, workers: int = 32) -> float:
+    """The shipped path: affinity-routed one-RTT ADD_SET joins against the
+    real host (WAIT_GE arrival fence + batched desc reads)."""
+    from tpu_resiliency.fault_tolerance.rendezvous import (
+        _desc_json_with_arrival_slot,
+        k_join_count,
+        k_node,
+    )
+    from tpu_resiliency.store.sharding import ShardedStoreClient
+
+    sweeper = ShardedStoreClient(endpoints, timeout=120.0)
+    for k in sweeper.list_keys("rdzv/"):
+        sweeper.delete(k)
+    sweeper.close()
+    host_client = ShardedStoreClient(endpoints, timeout=600.0)
+    host = RendezvousHost(
+        host_client, min_nodes=ranks, max_nodes=ranks, settle_time=0.2
+    )
+    host.bootstrap()
+    n = host.open_round()
+    base = NodeDesc.create(node_id="sweep", slots=1)
+
+    def worker(tid: int, count: int) -> None:
+        c = ShardedStoreClient(endpoints, timeout=600.0)
+        group = c.affinity(f"rdzv/{n}")  # single-shard handle (asserted)
+        try:
+            for i in range(count):
+                nid = f"n-{tid}-{i}"
+                group.add_set(
+                    k_join_count(n), 1, k_node(n, nid),
+                    _desc_json_with_arrival_slot(
+                        dataclasses.replace(base, node_id=nid)
+                    ),
+                )
+        finally:
+            c.close()
+
+    t0 = time.monotonic()
+    threads = _run_pool(worker, ranks, workers)
+    host.close_round_when_ready(timeout=600.0)
+    close_ms = (time.monotonic() - t0) * 1e3
+    for t in threads:
+        t.join(timeout=60)
+    host_client.close()
+    return close_ms
+
+
+def rdzv_close_pr6_ms(endpoints, ranks: int, workers: int = 32) -> float:
+    """The pre-affinity protocol at equal shard count: three-RTT joins
+    (ADD counter, SET node record, SET exact-count marker), per-key host
+    desc reads, count-marker arrival waits, per-key routing (affinity
+    off).  The emulation is CHARITABLE to the old path — each desc is
+    read once (the cache the old host already had) and the per-wake
+    ``list_keys`` cost is kept, so a measured win understates the real
+    one."""
+    from tpu_resiliency.fault_tolerance.rendezvous import (
+        assign_group_ranks,
+        k_closed,
+        k_count,
+        k_done,
+        k_join_count,
+        k_node,
+        k_open,
+        k_result,
+    )
+    from tpu_resiliency.store.client import StoreTimeout
+    from tpu_resiliency.store.sharding import ShardedStoreClient
+
+    c0 = ShardedStoreClient(endpoints, timeout=600.0, affinity=False)
+    for k in c0.list_keys("rdzv/"):
+        c0.delete(k)
+    n = 0
+    c0.set(k_open(n), b"1")
+    base = NodeDesc.create(node_id="sweep", slots=1)
+
+    def worker(tid: int, count: int) -> None:
+        c = ShardedStoreClient(endpoints, timeout=600.0, affinity=False)
+        try:
+            for i in range(count):
+                nid = f"p-{tid}-{i}"
+                arrival = c.add(k_join_count(n), 1)
+                c.set(
+                    k_node(n, nid),
+                    dataclasses.replace(
+                        base, node_id=nid, arrival=arrival
+                    ).to_json(),
+                )
+                c.set(k_count(n, arrival), b"1")
+        finally:
+            c.close()
+
+    t0 = time.monotonic()
+    threads = _run_pool(worker, ranks, workers)
+    desc_cache: dict = {}
+    while True:
+        count = int(c0.try_get(k_join_count(n)) or b"0")
+        for key in c0.list_keys(f"rdzv/{n}/node/"):
+            if key not in desc_cache:
+                raw = c0.try_get(key)  # PER-KEY read: the serial O(N) cost
+                if raw is not None:
+                    desc_cache[key] = NodeDesc.from_json(raw)
+        if len(desc_cache) >= ranks:
+            break
+        try:
+            c0.wait([k_count(n, count + 1)], timeout=2.0)
+        except StoreTimeout:
+            pass
+    c0.set(k_closed(n), b"1")
+    nodes = list(desc_cache.values())
+    assignment = assign_group_ranks(nodes, ranks, ranks)
+    participants = sorted(
+        (nid for nid, a in assignment.items() if a["group_rank"] is not None),
+        key=lambda nid: assignment[nid]["group_rank"],
+    )
+    c0.set(k_result(n), json.dumps({
+        "assignment": assignment,
+        "participants": participants,
+        "slots": {d.node_id: d.slots for d in nodes},
+        "cycle": 0,
+    }))
+    c0.set(k_done(n), b"1")
+    close_ms = (time.monotonic() - t0) * 1e3
+    for t in threads:
+        t.join(timeout=60)
+    c0.close()
+    return close_ms
+
+
+def measure_protocol_rtts(port: int) -> dict:
+    """Count the MUTATION round trips one barrier arrival and one
+    rendezvous registration actually send — the 1-RTT claim, measured."""
+    from tpu_resiliency.fault_tolerance.rendezvous import k_join_count, k_node
+    from tpu_resiliency.store.protocol import Op
+    from tpu_resiliency.store import reentrant_barrier
+
+    muts = {
+        Op.SET, Op.ADD, Op.APPEND, Op.COMPARE_SET, Op.DELETE, Op.MULTI_SET,
+        Op.APPEND_CHECK, Op.ADD_SET,
+    }
+
+    class Counting(StoreClient):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.ops = []
+
+        def _roundtrip(self, op, args, io_timeout):
+            self.ops.append(Op(op))
+            return super()._roundtrip(op, args, io_timeout)
+
+    c = Counting("127.0.0.1", port, timeout=30.0)
+    reentrant_barrier(c, "rtt-probe", 0, 1, timeout=10.0)
+    barrier_rtts = sum(1 for op in c.ops if op in muts)
+    c.ops.clear()
+    c.add_set(k_join_count(900), 1, k_node(900, "probe"), b"{}")
+    join_rtts = sum(1 for op in c.ops if op in muts)
+    for key in ("barrier/rtt-probe/arrivals", "barrier/rtt-probe/done",
+                k_join_count(900), k_node(900, "probe")):
+        c.delete(key)
+    c.close()
+    return {"barrier_arrival_rtts": barrier_rtts, "rdzv_join_rtts": join_rtts}
+
+
+def measure_promote_ms() -> float:
+    """SIGKILL a shard and clock the full recovery: journal-restored spare
+    on a FRESH port + CAS'd epoch bump on the published map."""
+    from tpu_resiliency.store import promote_spare
+    from tpu_resiliency.store.sharding import (
+        SHARD_MAP_KEY,
+        ShardMap,
+        free_port,
+        spawn_shard_subprocess,
+    )
+    from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+    env = {"JAX_PLATFORMS": "cpu"}
+    disarm_platform_sitecustomize(env)
+    with tempfile.TemporaryDirectory(prefix="tpurx-promote-") as tmp:
+        ports = [free_port(), free_port()]
+        spare_port = free_port()
+        journals = [os.path.join(tmp, f"j{i}") for i in range(2)]
+        procs = [
+            spawn_shard_subprocess(p, journal=j, env=env)
+            for p, j in zip(ports, journals)
+        ]
+        spare = None
+        try:
+            seed = StoreClient("127.0.0.1", ports[0], timeout=10.0)
+            seed.set(SHARD_MAP_KEY, ShardMap(
+                [f"127.0.0.1:{p}" for p in ports],
+                spares=[f"127.0.0.1:{spare_port}"],
+            ).to_json())
+            # victim carries state so the replay is not measuring an
+            # empty journal
+            direct = StoreClient("127.0.0.1", ports[1], timeout=10.0)
+            for i in range(512):
+                direct.set(f"state/{i}", b"x" * 64)
+            direct.close()
+            procs[1].kill()
+            procs[1].wait(timeout=10)
+            t0 = time.monotonic()
+            spare = spawn_shard_subprocess(
+                spare_port, journal=journals[1], env=env
+            )
+            promote_spare(seed, 1, f"127.0.0.1:{spare_port}")
+            promote_ms = (time.monotonic() - t0) * 1e3
+            seed.close()
+            return promote_ms
+        finally:
+            for p in procs:
+                p.kill()
+            if spare is not None:
+                spare.kill()
+
+
+def rendezvous_10k_sweep(
+    shards: int = 4,
+    ranks: int = 10000,
+    native: bool = False,
+    workers: int = 32,
+) -> dict:
+    """The acceptance sweep: fast vs PR 6 rendezvous close at ``ranks``
+    simulated clients over an equal shard fleet, plus the measured per-op
+    RTT counts and the spare-promotion latency.  Gate: >=2x close speedup
+    (waived on a 1-core host, house style)."""
+    endpoints, stop = _spawn_fleet(shards, native)
+    try:
+        fast_ms = rdzv_close_fast_ms(endpoints, ranks, workers)
+        pr6_ms = rdzv_close_pr6_ms(endpoints, ranks, workers)
+        rtts = measure_protocol_rtts(int(endpoints[0].rsplit(":", 1)[1]))
+    finally:
+        stop()
+    speedup = pr6_ms / max(1e-9, fast_ms)
+    waived = (os.cpu_count() or 1) < 2 and speedup < 2.0
+    out = {
+        "rdzv10k_ranks": ranks,
+        "rdzv10k_shards": shards,
+        "rdzv_close_10k_ms": round(fast_ms, 1),
+        "rdzv_close_10k_pr6_ms": round(pr6_ms, 1),
+        "rdzv10k_speedup": round(speedup, 2),
+        "rdzv10k_ok": bool(speedup >= 2.0 or waived),
+    }
+    if waived:
+        out["rdzv10k_gate_waived"] = "1-core host"
+    out.update(rtts)
+    out["store_promote_ms"] = round(measure_promote_ms(), 1)
+    return out
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--sizes", default="64,128,256")
     p.add_argument("--native", action="store_true")
+    p.add_argument(
+        "--shards", type=int, default=0,
+        help="run the 10k-rank sweep over this many shards instead of the "
+             "--sizes ladder",
+    )
+    p.add_argument("--ranks", type=int, default=10000)
+    p.add_argument("--workers", type=int, default=32)
     args = p.parse_args()
+
+    if args.shards > 0:
+        print(json.dumps(rendezvous_10k_sweep(
+            shards=args.shards, ranks=args.ranks, native=args.native,
+            workers=args.workers,
+        )), flush=True)
+        return
 
     if args.native:
         from tpu_resiliency.store.native import NativeStoreServer

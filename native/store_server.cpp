@@ -45,12 +45,33 @@
 
 namespace {
 
+// BEGIN GENERATED OP TABLE (source: tpu_resiliency/store/protocol.py;
+// regenerate: python -m tpu_resiliency.store.protocol --cpp)
 enum Op : uint8_t {
-  OP_SET = 1, OP_GET = 2, OP_TRY_GET = 3, OP_ADD = 4, OP_APPEND = 5,
-  OP_COMPARE_SET = 6, OP_WAIT = 7, OP_CHECK = 8, OP_DELETE = 9,
-  OP_NUM_KEYS = 10, OP_PING = 11, OP_LIST_KEYS = 12, OP_MULTI_SET = 13,
-  OP_MULTI_GET = 14, OP_MULTI_TRY_GET = 15,
+  OP_SET = 1,
+  OP_GET = 2,
+  OP_TRY_GET = 3,
+  OP_ADD = 4,
+  OP_APPEND = 5,
+  OP_COMPARE_SET = 6,
+  OP_WAIT = 7,
+  OP_CHECK = 8,
+  OP_DELETE = 9,
+  OP_NUM_KEYS = 10,
+  OP_PING = 11,
+  OP_LIST_KEYS = 12,
+  OP_MULTI_SET = 13,
+  OP_MULTI_GET = 14,
+  OP_MULTI_TRY_GET = 15,
+  OP_APPEND_CHECK = 16,
+  OP_ADD_SET = 17,
+  OP_WAIT_GE = 18,
+  OP__LAST = 18,
 };
+// END GENERATED OP TABLE
+
+// protocol.ADD_SLOT: spliced into ADD_SET's set_value (first occurrence)
+constexpr char kAddSlot[] = "%TPURX_N%";
 
 enum Status : uint8_t {
   ST_OK = 0, ST_KEY_MISS = 1, ST_TIMEOUT = 2, ST_ERROR = 3, ST_CAS_FAIL = 4,
@@ -65,8 +86,9 @@ struct Waiter {
   Conn* conn;                       // null once cancelled
   std::vector<std::string> keys;    // keys still missing
   Clock::time_point deadline;
-  uint8_t op;                       // OP_GET or OP_WAIT
-  std::string get_key;              // for OP_GET
+  uint8_t op;                       // OP_GET, OP_WAIT, or OP_WAIT_GE
+  std::string get_key;              // for OP_GET / OP_WAIT_GE
+  long long threshold = 0;          // for OP_WAIT_GE
   uint64_t id;
 };
 
@@ -329,6 +351,16 @@ void do_set(const std::string& key, const std::string& value) {
 
 // ---- waiters ---------------------------------------------------------------
 
+bool parse_int(const std::string& s, long long* out);
+
+long long int_value_of(const std::string& key) {
+  // WAIT_GE semantics: a missing or non-integer key counts as 0
+  long long cur = 0;
+  auto it = g_store.data.find(key);
+  if (it != g_store.data.end()) parse_int(it->second, &cur);
+  return cur;
+}
+
 void complete_waiter(uint64_t id, bool timed_out) {
   auto it = g_store.waiters.find(id);
   if (it == g_store.waiters.end()) return;
@@ -354,6 +386,8 @@ void complete_waiter(uint64_t id, bool timed_out) {
       reply(w.conn, ST_ERROR, {"key vanished"});
     else
       reply(w.conn, ST_OK, {d->second});
+  } else if (w.op == OP_WAIT_GE) {
+    reply(w.conn, ST_OK, {std::to_string(int_value_of(w.get_key))});
   } else {
     reply(w.conn, ST_OK, {});
   }
@@ -368,6 +402,15 @@ void notify_key(const std::string& key) {
     auto wit = g_store.waiters.find(id);
     if (wit == g_store.waiters.end()) continue;
     Waiter& w = wit->second;
+    if (w.op == OP_WAIT_GE) {
+      // threshold waiter: the key existing is not enough — the value must
+      // have reached the threshold, else re-park for the next bump
+      if (int_value_of(w.get_key) >= w.threshold)
+        complete_waiter(id, /*timed_out=*/false);
+      else
+        g_store.key_waiters[w.get_key].push_back(id);
+      continue;
+    }
     // drop this key; if all satisfied, complete
     auto& ks = w.keys;
     for (size_t i = 0; i < ks.size();) {
@@ -385,7 +428,8 @@ void notify_key(const std::string& key) {
 }
 
 void park_waiter(Conn* c, uint8_t op, std::vector<std::string> missing,
-                 const std::string& get_key, int64_t timeout_ms) {
+                 const std::string& get_key, int64_t timeout_ms,
+                 long long threshold = 0) {
   uint64_t id = g_store.next_waiter_id++;
   Waiter w;
   w.conn = c;
@@ -393,6 +437,7 @@ void park_waiter(Conn* c, uint8_t op, std::vector<std::string> missing,
   w.deadline = Clock::now() + Ms(timeout_ms);
   w.op = op;
   w.get_key = get_key;
+  w.threshold = threshold;
   w.id = id;
   g_store.key_waiters[w.keys.front()].push_back(id);
   g_store.deadlines.emplace(w.deadline, id);
@@ -553,6 +598,72 @@ void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
       }
       return reply(c, ST_OK, pairs);
     }
+    case OP_APPEND_CHECK: {
+      // one-RTT barrier arrival: append + population check + done-key set
+      // as one atomic step (see store/server.py for the reference semantics)
+      if (args.size() < 5)
+        return reply(c, ST_ERROR, {"APPEND_CHECK wants >=5 args"});
+      long long required;
+      if (!parse_int(args[4], &required))
+        return reply(c, ST_ERROR, {"required not an integer"});
+      std::string& v = data[args[0]];
+      v.append(args[1]);
+      journal_append(args[0], &v);
+      size_t new_len = v.size();
+      std::unordered_set<std::string> seen;
+      size_t start = 0;
+      while (start < v.size()) {
+        size_t comma = v.find(',', start);
+        if (comma == std::string::npos) comma = v.size();
+        if (comma > start) seen.insert(v.substr(start, comma - start));
+        start = comma + 1;
+      }
+      bool done;
+      if (args.size() > 5) {  // narrowed participant set: exact membership
+        done = true;
+        for (size_t i = 5; i < args.size(); ++i)
+          if (!seen.count(args[i])) {
+            done = false;
+            break;
+          }
+      } else {  // full population: distinct tokens (dedup re-entries)
+        done = static_cast<long long>(seen.size()) >= required;
+      }
+      notify_key(args[0]);
+      // do_set may rehash `data` — the reference v is dead past this point
+      if (done) do_set(args[2], args[3]);
+      return reply(c, ST_OK, {std::to_string(new_len), done ? "1" : "0"});
+    }
+    case OP_ADD_SET: {
+      // one-RTT rendezvous join: counter bump + record write, splicing the
+      // post-add value into the record at the first kAddSlot marker
+      if (args.size() != 4)
+        return reply(c, ST_ERROR, {"ADD_SET wants 4 args"});
+      long long amount, cur = 0;
+      if (!parse_int(args[1], &amount))
+        return reply(c, ST_ERROR, {"ADD_SET amount not an integer"});
+      auto it = data.find(args[0]);
+      if (it != data.end() && !parse_int(it->second, &cur))
+        return reply(c, ST_ERROR, {"value not an integer"});
+      long long nv = cur + amount;
+      do_set(args[0], std::to_string(nv));
+      std::string sv = args[3];
+      size_t slot = sv.find(kAddSlot);
+      if (slot != std::string::npos)
+        sv.replace(slot, sizeof(kAddSlot) - 1, std::to_string(nv));
+      do_set(args[2], sv);
+      return reply(c, ST_OK, {std::to_string(nv)});
+    }
+    case OP_WAIT_GE: {
+      long long threshold, timeout_ms;
+      if (args.size() != 3 || !parse_int(args[1], &threshold) ||
+          !parse_int(args[2], &timeout_ms))
+        return reply(c, ST_ERROR, {"WAIT_GE wants key,threshold,timeout_ms"});
+      long long cur = int_value_of(args[0]);
+      if (cur >= threshold) return reply(c, ST_OK, {std::to_string(cur)});
+      park_waiter(c, OP_WAIT_GE, {args[0]}, args[0], timeout_ms, threshold);
+      return;
+    }
     default:
       return reply(c, ST_ERROR, {"unknown op"});
   }
@@ -586,7 +697,7 @@ bool try_parse_frame(Conn* c) {
     off += len;
   }
   c->in.erase(0, off);
-  if (op < OP_SET || op > OP_MULTI_TRY_GET) {
+  if (op < OP_SET || op > OP__LAST) {
     // unparseable stream from here on: drop the connection (matches the
     // Python server's behavior)
     c->closed = true;

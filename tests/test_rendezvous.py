@@ -353,14 +353,16 @@ def test_round_gc_reclaims_old_rounds(rdzv_store):
             request_restart(store, "loop")
             host.open_round()
     # rounds older than current-2 are gone; recent rounds remain
-    old_keys = [
-        k for k in store.list_keys("rdzv/")
-        if any(k.decode().startswith(f"rdzv/{kind}/{n}") or f"/{n}/" in k.decode()
-               for kind in ("open", "done", "result") for n in (0, 1))
-    ]
-    assert not any(b"rdzv/result/0" in k or b"rdzv/result/1" in k
-                   for k in store.list_keys("rdzv/result/"))
-    assert store.check(["rdzv/result/4"])
+    from tpu_resiliency.fault_tolerance.rendezvous import k_result
+
+    gone = {k_result(0).encode(), k_result(1).encode()}
+    assert not gone & set(store.list_keys("rdzv/"))
+    assert not any(
+        k.decode().split("/")[1] in ("0", "1")
+        for k in store.list_keys("rdzv/")
+        if k.decode().split("/")[1].isdigit()
+    )
+    assert store.check([k_result(4)])
 
 
 def test_heterogeneous_slots_allowed_when_configured():

@@ -1,0 +1,330 @@
+"""One-RTT atomic store ops (APPEND_CHECK / ADD_SET / WAIT_GE): semantics on
+both server implementations, Python<->C++ op-table parity, and the op-count
+proof that barrier arrival and rendezvous registration are each a single
+mutation round trip."""
+
+import dataclasses
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_resiliency.store import StoreClient, StoreServer, reentrant_barrier
+from tpu_resiliency.store.client import StoreTimeout
+from tpu_resiliency.store.protocol import (
+    ADD_SLOT,
+    CPP_OP_TABLE_BEGIN,
+    CPP_OP_TABLE_END,
+    Op,
+    render_cpp_op_enum,
+)
+
+_REPO = Path(__file__).resolve().parents[1]
+
+# every op that mutates the keyspace (reads, waits, and checks are free to
+# repeat; mutations are what the 1-RTT claim counts)
+_MUTATIONS = {
+    Op.SET, Op.ADD, Op.APPEND, Op.COMPARE_SET, Op.DELETE, Op.MULTI_SET,
+    Op.APPEND_CHECK, Op.ADD_SET,
+}
+
+
+class CountingStoreClient(StoreClient):
+    """Records every opcode sent — the instrument behind the 1-RTT asserts."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.ops = []
+
+    def _roundtrip(self, op, args, io_timeout):
+        self.ops.append(Op(op))
+        return super()._roundtrip(op, args, io_timeout)
+
+    def mutations(self):
+        return [op for op in self.ops if op in _MUTATIONS]
+
+
+@pytest.fixture(params=["py", "native"])
+def fast_store(request):
+    """The new ops against BOTH servers: one protocol, two implementations."""
+    if request.param == "py":
+        server = StoreServer(host="127.0.0.1", port=0).start_in_thread()
+    else:
+        from tpu_resiliency.store.native import NativeStoreServer
+
+        server = NativeStoreServer(host="127.0.0.1", port=0).start()
+    client = StoreClient("127.0.0.1", server.port, timeout=10.0)
+    yield client
+    client.close()
+    server.stop()
+
+
+# -- op semantics, both servers ----------------------------------------------
+
+
+class TestAppendCheck:
+    def test_distinct_token_count_completes(self, fast_store):
+        c = fast_store
+        for i, expect_done in ((0, False), (1, False), (2, True)):
+            new_len, done = c.append_check(
+                "ac/arrivals", f"{i},", "ac/done", b"ok", required=3
+            )
+            assert done is expect_done
+        assert c.get("ac/done") == b"ok"
+        assert c.get("ac/arrivals") == b"0,1,2,"
+
+    def test_reentry_deduplicates(self, fast_store):
+        c = fast_store
+        _, done = c.append_check("re/a", "0,", "re/done", b"1", required=2)
+        assert not done
+        # the same rank re-entering must not count twice
+        _, done = c.append_check("re/a", "0,", "re/done", b"1", required=2)
+        assert not done
+        assert c.try_get("re/done") is None
+        _, done = c.append_check("re/a", "1,", "re/done", b"1", required=2)
+        assert done
+
+    def test_explicit_tokens_ignore_outsiders(self, fast_store):
+        c = fast_store
+        toks = ["3", "5"]
+        _, done = c.append_check("tk/a", "9,", "tk/done", b"1", tokens=toks)
+        assert not done  # rank 9 is outside the narrowed set
+        _, done = c.append_check("tk/a", "3,", "tk/done", b"1", tokens=toks)
+        assert not done
+        _, done = c.append_check("tk/a", "5,", "tk/done", b"1", tokens=toks)
+        assert done
+
+    def test_returns_new_length(self, fast_store):
+        new_len, _ = fast_store.append_check("ln/a", "12,", "ln/d", b"1",
+                                             required=9)
+        assert new_len == 3
+        new_len, _ = fast_store.append_check("ln/a", "7,", "ln/d", b"1",
+                                             required=9)
+        assert new_len == 5
+
+
+class TestAddSet:
+    def test_counter_spliced_into_record(self, fast_store):
+        c = fast_store
+        n = c.add_set("as/count", 1, "as/node/a",
+                      b'{"arrival": ' + ADD_SLOT + b"}")
+        assert n == 1
+        assert json.loads(c.get("as/node/a")) == {"arrival": 1}
+        n = c.add_set("as/count", 1, "as/node/b",
+                      b'{"arrival": ' + ADD_SLOT + b"}")
+        assert n == 2
+        assert json.loads(c.get("as/node/b")) == {"arrival": 2}
+
+    def test_value_without_slot_set_verbatim(self, fast_store):
+        c = fast_store
+        assert c.add_set("nv/count", 5, "nv/k", b"plain") == 5
+        assert c.get("nv/k") == b"plain"
+
+    def test_only_first_slot_spliced(self, fast_store):
+        c = fast_store
+        c.add_set("fs/count", 1, "fs/k", ADD_SLOT + b"|" + ADD_SLOT)
+        assert c.get("fs/k") == b"1|" + ADD_SLOT
+
+
+class TestWaitGe:
+    def test_immediate_when_satisfied(self, fast_store):
+        fast_store.set("ge/k", b"7")
+        assert fast_store.wait_ge("ge/k", 5, timeout=5.0) == 7
+
+    def test_missing_key_counts_as_zero(self, fast_store):
+        assert fast_store.wait_ge("ge/missing", 0, timeout=5.0) == 0
+        with pytest.raises(StoreTimeout):
+            fast_store.wait_ge("ge/missing", 1, timeout=0.3)
+
+    def test_blocks_until_threshold(self, fast_store):
+        port = fast_store.port
+
+        def bump():
+            c = StoreClient("127.0.0.1", port)
+            for _ in range(3):
+                time.sleep(0.05)
+                c.add("ge/ctr", 1)
+            c.close()
+
+        t = threading.Thread(target=bump)
+        t.start()
+        assert fast_store.wait_ge("ge/ctr", 3, timeout=10.0) >= 3
+        t.join()
+
+    def test_woken_by_add_set(self, fast_store):
+        port = fast_store.port
+
+        def join():
+            c = StoreClient("127.0.0.1", port)
+            time.sleep(0.1)
+            c.add_set("ws/count", 1, "ws/node/x", b"desc")
+            c.close()
+
+        t = threading.Thread(target=join)
+        t.start()
+        assert fast_store.wait_ge("ws/count", 1, timeout=10.0) == 1
+        # the record is readable the instant the counter moves
+        assert fast_store.get("ws/node/x") == b"desc"
+        t.join()
+
+    def test_below_threshold_stays_parked(self, fast_store):
+        fast_store.set("bt/k", b"1")
+        port = fast_store.port
+
+        def nudge():
+            c = StoreClient("127.0.0.1", port)
+            time.sleep(0.05)
+            c.set("bt/k", b"2")  # wakes waiters, but still < 5
+            c.close()
+
+        t = threading.Thread(target=nudge)
+        t.start()
+        with pytest.raises(StoreTimeout):
+            fast_store.wait_ge("bt/k", 5, timeout=0.6)
+        t.join()
+
+
+# -- Python <-> C++ op-table parity ------------------------------------------
+
+
+class TestOpTableParity:
+    def test_generated_block_is_verbatim_in_cpp_source(self):
+        """The C++ enum is GENERATED from the Python Op table; the source
+        must contain the current rendering byte-for-byte, so adding an op in
+        one place and not the other fails here, not at runtime."""
+        src = (_REPO / "native" / "store_server.cpp").read_text()
+        block = render_cpp_op_enum()
+        assert block in src, (
+            "native/store_server.cpp op table is stale — regenerate with "
+            "'python -m tpu_resiliency.store.protocol --cpp'"
+        )
+        # exactly one generated block
+        assert src.count(CPP_OP_TABLE_BEGIN) == 1
+        assert src.count(CPP_OP_TABLE_END) == 1
+
+    def test_cpp_guard_uses_sentinel(self):
+        """The unknown-op guard must reject via OP__LAST (which the
+        generator maintains), not a hand-written literal that rots."""
+        src = (_REPO / "native" / "store_server.cpp").read_text()
+        assert "op > OP__LAST" in src
+
+    def test_sentinel_tracks_highest_op(self):
+        assert f"OP__LAST = {max(int(op) for op in Op)}," in render_cpp_op_enum()
+
+    def test_every_python_op_in_rendering(self):
+        block = render_cpp_op_enum()
+        for op in Op:
+            assert f"OP_{op.name} = {int(op)}," in block
+
+
+# -- the 1-RTT claim, asserted by op count -----------------------------------
+
+
+class TestOneRoundTripProtocols:
+    def test_barrier_arrival_is_one_mutation(self, store_server):
+        """Every reentrant-barrier participant — including the completer —
+        issues exactly ONE mutation round trip (APPEND_CHECK).  The legacy
+        path cost the completer three (APPEND, then read, then SET done)."""
+        world = 3
+        clients = [
+            CountingStoreClient("127.0.0.1", store_server.port, timeout=10.0)
+            for _ in range(world)
+        ]
+        threads = [
+            threading.Thread(
+                target=reentrant_barrier, args=(c, "rtt", i, world),
+                kwargs={"timeout": 15.0},
+            )
+            for i, c in enumerate(clients)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        for c in clients:
+            assert c.mutations() == [Op.APPEND_CHECK], c.ops
+            c.close()
+
+    def test_rendezvous_join_is_one_mutation(self, store_server):
+        """Joiner registration is ONE mutation round trip (ADD_SET carrying
+        both the counter bump and the node record).  The legacy path cost
+        three (ADD, SET node, SET count-marker)."""
+        from tpu_resiliency.fault_tolerance.rendezvous import (
+            NodeDesc,
+            RendezvousHost,
+            RendezvousJoiner,
+        )
+
+        host_client = StoreClient("127.0.0.1", store_server.port, timeout=30.0)
+        host = RendezvousHost(
+            host_client, min_nodes=2, max_nodes=2, settle_time=0.1
+        )
+        host.bootstrap()
+        host.open_round()
+        closer = threading.Thread(
+            target=lambda: host.close_round_when_ready(timeout=30.0)
+        )
+        closer.start()
+        clients = [
+            CountingStoreClient("127.0.0.1", store_server.port, timeout=30.0)
+            for _ in range(2)
+        ]
+        results = {}
+
+        def join(i):
+            results[i] = RendezvousJoiner(
+                clients[i], NodeDesc.create(node_id=f"rtt-{i}", slots=1),
+                open_poll_interval=0.05,
+            ).join(timeout=30.0)
+
+        joiners = [threading.Thread(target=join, args=(i,)) for i in range(2)]
+        for t in joiners:
+            t.start()
+        for t in joiners:
+            t.join(timeout=30)
+        closer.join(timeout=30)
+        assert len(results) == 2
+        for c in clients:
+            assert c.mutations() == [Op.ADD_SET], c.ops
+            c.close()
+        host_client.close()
+
+
+# -- the arrival-slot splice --------------------------------------------------
+
+
+class TestDescJsonArrivalSlot:
+    def test_slot_splices_to_valid_json(self):
+        from tpu_resiliency.fault_tolerance.rendezvous import (
+            NodeDesc,
+            _desc_json_with_arrival_slot,
+        )
+
+        desc = NodeDesc.create(node_id="n0", slots=4)
+        raw = _desc_json_with_arrival_slot(desc)
+        assert raw.count(ADD_SLOT) == 1
+        spliced = raw.replace(ADD_SLOT, b"42", 1)
+        got = NodeDesc.from_json(spliced)
+        assert got.arrival == 42
+        assert got.node_id == desc.node_id and got.slots == desc.slots
+
+    def test_hostile_field_cannot_forge_slot(self):
+        """A node_id that CONTAINS the arrival-field text must not divert
+        the splice: JSON string escaping means the raw byte sequence
+        '"arrival": 0' cannot occur inside a string value."""
+        from tpu_resiliency.fault_tolerance.rendezvous import (
+            NodeDesc,
+            _desc_json_with_arrival_slot,
+        )
+
+        evil = dataclasses.replace(
+            NodeDesc.create(node_id="x", slots=1),
+            node_id='n"arrival": 0',
+        )
+        raw = _desc_json_with_arrival_slot(evil)
+        assert raw.count(ADD_SLOT) == 1
+        got = NodeDesc.from_json(raw.replace(ADD_SLOT, b"7", 1))
+        assert got.arrival == 7
+        assert got.node_id == 'n"arrival": 0'

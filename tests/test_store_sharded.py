@@ -550,3 +550,251 @@ class TestShardKillMidRound:
         finally:
             for p in procs:
                 p.kill()
+
+
+# -- epoch + spares: map round-trip and promotion routing ---------------------
+
+
+class TestShardMapEpoch:
+    def test_epoch_and_spares_json_roundtrip(self):
+        m = ShardMap(
+            [("127.0.0.1", 1), ("127.0.0.1", 2)],
+            vnodes=32, epoch=3, spares=["127.0.0.1:9", "127.0.0.1:10"],
+        )
+        m2 = ShardMap.from_json(m.to_json())
+        assert m2.epoch == 3
+        assert m2.spares == [("127.0.0.1", 9), ("127.0.0.1", 10)]
+        for i in range(100):
+            k = f"key/{i}".encode()
+            assert m.shard_for(k) == m2.shard_for(k)
+        # pre-epoch maps (older control planes) parse as epoch 0, no spares
+        legacy = ShardMap.from_json(
+            json.dumps({"endpoints": ["h:1", "h:2"], "vnodes": 64})
+        )
+        assert legacy.epoch == 0 and legacy.spares == []
+
+    def test_with_promoted_keeps_routing_and_consumes_spare(self):
+        m = ShardMap(
+            [("h", 1), ("h", 2), ("h", 3)], spares=["h:9", "h:10"]
+        )
+        p = m.with_promoted(1, "h:9")
+        assert p.epoch == m.epoch + 1
+        assert p.endpoints[1] == ("h", 9)
+        assert p.spares == [("h", 10)]
+        # the ring is keyed by shard INDEX: swapping an endpoint must not
+        # move a single key
+        for i in range(500):
+            k = f"key/{i}".encode()
+            assert m.shard_for(k) == p.shard_for(k)
+
+
+# -- affinity groups ----------------------------------------------------------
+
+
+class TestAffinity:
+    def test_affinity_token_shapes(self):
+        from tpu_resiliency.store import affinity_token
+
+        assert affinity_token(b"rdzv/7/node/a") == b"rdzv/7"
+        assert affinity_token(b"rdzv/7/open") == b"rdzv/7"
+        assert affinity_token(b"barrier/b1/g2/done") == b"barrier/b1"
+        # fixed pointers and non-round keys keep per-key routing
+        assert affinity_token(b"rdzv/active_round") is None
+        assert affinity_token(b"rdzv/shutdown") is None
+        assert affinity_token(b"rdzv/7") is None
+        assert affinity_token(b"other/7/x") is None
+
+    def test_round_keys_colocate_on_one_shard(self, shard_group):
+        c = shard_group.client()
+        idxs = {
+            c._shard_idx(k) for k in (
+                "rdzv/5/open", "rdzv/5/closed", "rdzv/5/join_count",
+                "rdzv/5/node/a", "rdzv/5/node/b", "rdzv/5/result",
+                "rdzv/5/done",
+            )
+        }
+        assert len(idxs) == 1
+        c.close()
+
+    def test_affinity_handle_ops_and_rejection(self, shard_group):
+        from tpu_resiliency.store import AffinityGroup
+
+        c = shard_group.client(timeout=10.0)
+        g = c.affinity("rdzv/9")
+        assert isinstance(g, AffinityGroup)
+        g.set("rdzv/9/open", b"1")
+        assert g.get("rdzv/9/open") == b"1"
+        assert g.add("rdzv/9/join_count", 1) == 1
+        new_len, done = g.append_check(
+            "rdzv/9/arrivals", "0,", "rdzv/9/done", b"1", required=1
+        )
+        assert done and g.get("rdzv/9/done") == b"1"
+        with pytest.raises(StoreError):
+            g.set("rdzv/8/open", b"1")  # outside the group
+        with pytest.raises(StoreError):
+            g.wait(["barrier/x/done"], timeout=0.1)
+        c.close()
+
+    def test_multi_key_ops_require_colocation(self, shard_group):
+        c = shard_group.client(timeout=10.0)
+        # append_check across two DIFFERENT affinity groups must be refused
+        # loudly (single-shard atomicity cannot hold across shards) ...
+        pairs = (
+            (f"rdzv/{a}/arrivals", f"rdzv/{b}/done")
+            for a in range(32) for b in range(32) if a != b
+        )
+        for log_key, done_key in pairs:
+            if c._shard_idx(log_key) != c._shard_idx(done_key):
+                with pytest.raises(StoreError):
+                    c.append_check(log_key, "0,", done_key, b"1", required=99)
+                break
+        else:
+            pytest.skip("all probed rounds co-hashed (tiny fleet)")
+        # ... while same-group pairs work
+        _, done = c.append_check(
+            "rdzv/3/arrivals", "0,", "rdzv/3/done", b"1", required=1
+        )
+        assert done
+        c.close()
+
+    def test_parallel_wait_spans_shards_within_deadline(self, shard_group):
+        c = shard_group.client(timeout=10.0)
+        keys = [f"pw/{i}" for i in range(12)]  # spreads over all 4 shards
+        assert len({c._shard_idx(k) for k in keys}) > 1
+
+        def setter():
+            time.sleep(0.8)
+            s = shard_group.client()
+            s.multi_set({k: b"1" for k in keys})
+            s.close()
+
+        t = threading.Thread(target=setter)
+        t.start()
+        t0 = time.monotonic()
+        c.wait(keys, timeout=10.0)
+        elapsed = time.monotonic() - t0
+        t.join()
+        # per-shard fences ran concurrently: the fence costs ~the setter
+        # delay, not a serial accumulation of it across shards
+        assert elapsed < 5.0, elapsed
+        # and a multi-shard timeout is honored as ONE budget, not per shard
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeout):
+            c.wait([f"pw/never/{i}" for i in range(8)], timeout=0.6)
+        assert time.monotonic() - t0 < 4.0
+        c.close()
+
+
+# -- spare promotion: epoch-bumped failover to a FRESH endpoint ---------------
+
+
+class TestSparePromotion:
+    def test_sigkill_promote_and_inflight_ops_recover(self, tmp_path):
+        """The acceptance gate: SIGKILL a shard, promote a spare on a NEW
+        port (CAS'd epoch bump, journal-restored), and in-flight WAIT and
+        COMPARE_SET ride their existing failover episodes onto the spare —
+        the dead endpoint is never reused."""
+        from tpu_resiliency.store import promote_spare
+        from tpu_resiliency.store.sharding import SHARD_MAP_KEY
+
+        ports = [free_port(), free_port()]
+        spare_port = free_port()
+        journals = [str(tmp_path / f"pj{i}") for i in range(2)]
+        procs = [
+            spawn_shard_subprocess(p, journal=j)
+            for p, j in zip(ports, journals)
+        ]
+        spare_proc = None
+        endpoints = [f"127.0.0.1:{p}" for p in ports]
+        spare_ep = f"127.0.0.1:{spare_port}"
+        try:
+            seed = StoreClient("127.0.0.1", ports[0], timeout=10.0)
+            seed.set(SHARD_MAP_KEY, ShardMap(endpoints, spares=[spare_ep]).to_json())
+            c = ShardedStoreClient.from_bootstrap(
+                "127.0.0.1", ports[0], timeout=60.0
+            )
+            assert c.map.spares == [("127.0.0.1", spare_port)]
+            victim = c.map.shard_for(b"promo/key")
+            c.set("promo/seeded", b"1")  # lands somewhere; journaled if on victim
+
+            waited = {}
+
+            def block():
+                try:
+                    c.wait(["promo/key"], timeout=90.0)
+                    waited["ok"] = True
+                except Exception as exc:  # noqa: BLE001
+                    waited["err"] = exc
+
+            t = threading.Thread(target=block)
+            t.start()
+            time.sleep(0.5)  # parked on the doomed shard
+            procs[victim].send_signal(signal.SIGKILL)
+            procs[victim].wait(timeout=10)
+
+            # the watchdog's moves: spare on a FRESH port, victim's journal
+            spare_proc = spawn_shard_subprocess(
+                spare_port, journal=journals[victim]
+            )
+            map_client = StoreClient(
+                "127.0.0.1", spare_port if victim == 0 else ports[0],
+                timeout=10.0,
+            )
+            promoted = promote_spare(map_client, victim, spare_ep)
+            map_client.close()
+            assert promoted.epoch == 1
+            assert promoted.endpoints[victim] == ("127.0.0.1", spare_port)
+            assert promoted.spares == []
+
+            # in-flight CAS from a client that still holds the OLD map rides
+            # the failover episode onto the spare (base-client reconnect
+            # budget ~10s precedes the episode, hence the generous timeout)
+            ok, v = c.compare_set_ex("promo/key", b"", b"claimed")
+            assert ok and v == b"claimed"
+            t.join(timeout=60)
+            assert waited.get("ok"), waited
+            # the client adopted the bumped map: fresh endpoint, no reuse
+            assert c.map.epoch == 1
+            assert c.endpoints[victim] == ("127.0.0.1", spare_port)
+            c.close()
+        finally:
+            for p in procs:
+                p.kill()
+            if spare_proc is not None:
+                spare_proc.kill()
+
+    def test_bootstrap_via_spare_when_seed_dead(self, tmp_path):
+        """A client whose map names spares can rediscover the bumped map
+        from a spare endpoint even when its cached shard endpoint is gone."""
+        from tpu_resiliency.store import promote_spare
+        from tpu_resiliency.store.sharding import SHARD_MAP_KEY
+
+        port, spare_port = free_port(), free_port()
+        journal = str(tmp_path / "bj0")
+        proc = spawn_shard_subprocess(port, journal=journal)
+        spare_ep = f"127.0.0.1:{spare_port}"
+        spare_proc = None
+        try:
+            seed = StoreClient("127.0.0.1", port, timeout=10.0)
+            seed.set(
+                SHARD_MAP_KEY,
+                ShardMap([f"127.0.0.1:{port}"], spares=[spare_ep]).to_json(),
+            )
+            c = ShardedStoreClient.from_bootstrap("127.0.0.1", port, timeout=45.0)
+            c.set("b/x", b"1")
+            seed.close()
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            spare_proc = spawn_shard_subprocess(spare_port, journal=journal)
+            mc = StoreClient("127.0.0.1", spare_port, timeout=10.0)
+            promote_spare(mc, 0, spare_ep)
+            mc.close()
+            # every cached endpoint is dead; discovery must fall through to
+            # the map's spare list
+            assert c.get("b/x", timeout=40.0) == b"1"
+            assert c.map.epoch == 1
+            c.close()
+        finally:
+            proc.kill()
+            if spare_proc is not None:
+                spare_proc.kill()

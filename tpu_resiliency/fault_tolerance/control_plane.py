@@ -16,10 +16,12 @@ from __future__ import annotations
 
 import argparse
 import signal
+import subprocess
 import sys
+import threading
 import time
 
-from ..store import StoreClient, StoreServer
+from ..store import StoreClient, StoreError, StoreServer
 from ..utils.logging import get_logger, setup_logger
 from .launcher import HostRoundLoop
 from .rendezvous import K_SHUTDOWN, RendezvousHost
@@ -38,11 +40,12 @@ def run(
     journal: str | None = None,
     require_equal_slots: bool = True,
     shards: int = 1,
+    spares: int = 0,
 ) -> int:
-    if shards > 1:
+    if shards > 1 or spares > 0:
         return _run_sharded(
             host, port, min_nodes, max_nodes, round_timeout, settle_time,
-            journal, require_equal_slots, shards,
+            journal, require_equal_slots, max(shards, 1), spares,
         )
     if native:
         from ..store.native import NativeStoreServer
@@ -107,6 +110,49 @@ def run(
         server.stop()
 
 
+def _promote_dead_shards(procs, endpoints, spare_ports, journal) -> None:
+    """One watchdog sweep: any subprocess shard that exited is replaced by
+    a spare on a FRESH endpoint — the spare replays the dead shard's
+    journal, then a CAS'd epoch bump on the published map re-points the
+    shard index at it (:func:`promote_spare`).  Clients riding a
+    ``store_shard_failover`` episode against the dead endpoint re-fetch the
+    map and land on the spare; the dead endpoint is never reused."""
+    from ..store.sharding import promote_spare, spawn_shard_subprocess
+
+    for i, proc in enumerate(procs):
+        if proc is None or proc.poll() is None:
+            continue
+        rc = proc.returncode
+        if not spare_ports:
+            log.error(
+                "shard %d (%s) died (rc=%s) with no spare endpoints left; "
+                "its keyspace is down until the control plane restarts",
+                i, endpoints[i], rc,
+            )
+            procs[i] = None
+            continue
+        spare_port = spare_ports.pop(0)
+        spare_ep = f"127.0.0.1:{spare_port}"
+        log.warning(
+            "shard %d (%s) died (rc=%s): restoring its journal on spare %s",
+            i, endpoints[i], rc, spare_ep,
+        )
+        procs[i] = spawn_shard_subprocess(
+            spare_port,
+            journal=f"{journal}.shard{i}" if journal else None,
+        )
+        # the map key lives on the seed shard (index 0); when the seed
+        # itself died, its journal-restored spare now serves that key
+        seed_ep = spare_ep if i == 0 else endpoints[0]
+        seed_host, seed_port = seed_ep.rsplit(":", 1)
+        map_client = StoreClient(seed_host, int(seed_port), timeout=10.0)
+        try:
+            promote_spare(map_client, i, spare_ep)
+        finally:
+            map_client.close()
+        endpoints[i] = spare_ep
+
+
 def _run_sharded(
     host: str,
     port: int,
@@ -117,6 +163,7 @@ def _run_sharded(
     journal: str | None,
     require_equal_slots: bool,
     shards: int,
+    spares: int = 0,
 ) -> int:
     """Host K store shards (consistent-hash keyspace, per-shard journal) +
     the rendezvous round loop.  Shard 0 binds the advertised ``port`` — the
@@ -125,26 +172,46 @@ def _run_sharded(
     list or call ``ShardedStoreClient.from_bootstrap(addr, port)`` knowing
     only the seed.  Per-shard journals keep every shard independently
     journal-replayable: one shard dying mid-restart is a reconnect, not a
-    control-plane loss."""
-    from ..store.server import StoreServer
-    from ..store.sharding import ShardMap, ShardedStoreClient, publish_shard_map
+    control-plane loss.
 
-    servers = []
+    With ``spares > 0`` the shards run as subprocesses (so one can die
+    without taking the control plane with it) and a watchdog promotes a
+    spare endpoint — fresh port, dead shard's journal — via a CAS'd epoch
+    bump on the published map whenever a shard exits."""
+    from ..store.server import StoreServer
+    from ..store.sharding import (
+        ShardMap, ShardedStoreClient, publish_shard_map,
+        spawn_shard_subprocess,
+    )
+
+    # Deterministic shard ports (seed+i, spares after): a control plane
+    # RESTART re-binds the same ports so live clients reconnect in place.
+    # A shard dying while the control plane stays up is the other failure
+    # mode: with spares configured its keyspace moves to a fresh spare
+    # endpoint via a CAS'd epoch bump on the published map — the dead
+    # endpoint is never reused, clients re-fetch the map mid-failover.
+    servers = []  # in-thread shards (spares == 0)
+    procs = []    # subprocess shards (spares > 0): independently killable
     for i in range(shards):
-        # deterministic ports (seed+i): the failover contract is same-
-        # endpoint replacement, so a restarted control plane must re-bind
-        # the SAME ports for live clients to reconnect to their shards
-        servers.append(
-            StoreServer(
-                host=host,
-                port=port + i,
-                journal_path=f"{journal}.shard{i}" if journal else None,
-                journal_strip_prefixes=[K_SHUTDOWN.encode()],
-            ).start_in_thread()
-        )
-    endpoints = [f"127.0.0.1:{s.port}" for s in servers]
-    seed = StoreClient("127.0.0.1", servers[0].port)
-    publish_shard_map(seed, ShardMap(endpoints))
+        shard_journal = f"{journal}.shard{i}" if journal else None
+        if spares > 0:
+            procs.append(
+                spawn_shard_subprocess(port + i, journal=shard_journal)
+            )
+        else:
+            servers.append(
+                StoreServer(
+                    host=host,
+                    port=port + i,
+                    journal_path=shard_journal,
+                    journal_strip_prefixes=[K_SHUTDOWN.encode()],
+                ).start_in_thread()
+            )
+    endpoints = [f"127.0.0.1:{port + i}" for i in range(shards)]
+    spare_ports = [port + shards + i for i in range(spares)]
+    spare_eps = [f"127.0.0.1:{p}" for p in spare_ports]
+    seed = StoreClient("127.0.0.1", port)
+    publish_shard_map(seed, ShardMap(endpoints, spares=spare_eps))
     seed.close()
     restored = sum(s.replayed_keys for s in servers)
     if journal and restored:
@@ -153,7 +220,9 @@ def _run_sharded(
             "(%d keys): cycle numbering and rendezvous rounds continue",
             shards, restored,
         )
-    client = ShardedStoreClient(endpoints, timeout=round_timeout)
+    client = ShardedStoreClient(
+        endpoints, timeout=round_timeout, spares=spare_eps,
+    )
     rdzv = RendezvousHost(
         client, min_nodes=min_nodes, max_nodes=max_nodes,
         settle_time=settle_time, require_equal_slots=require_equal_slots,
@@ -161,9 +230,9 @@ def _run_sharded(
     loop = HostRoundLoop(rdzv, round_timeout)
     loop.start()
     log.info(
-        "sharded control plane up: %d shards on %s (seed %s:%s) — set "
-        "TPURX_STORE_SHARDS=%s",
-        shards, host, host, servers[0].port, ",".join(endpoints),
+        "sharded control plane up: %d shards on %s (seed %s:%s, %d spares) "
+        "— set TPURX_STORE_SHARDS=%s",
+        shards, host, host, port, spares, ",".join(endpoints),
     )
     stop = {"flag": False}
 
@@ -172,9 +241,33 @@ def _run_sharded(
 
     signal.signal(signal.SIGTERM, _sig)
     signal.signal(signal.SIGINT, _sig)
+
+    # The watchdog runs on its own thread: the shutdown poll below can sit
+    # inside a failover episode for tens of seconds when a shard is down,
+    # and promotion must not wait behind it.
+    watchdog_stop = threading.Event()
+
+    def _watchdog():
+        while not watchdog_stop.wait(0.5):
+            try:
+                _promote_dead_shards(procs, endpoints, spare_ports, journal)
+            except Exception:
+                log.exception("shard watchdog sweep failed; retrying")
+
+    watchdog = None
+    if procs:
+        watchdog = threading.Thread(
+            target=_watchdog, name="shard-watchdog", daemon=True,
+        )
+        watchdog.start()
     try:
         while not stop["flag"]:
-            shutdown = client.try_get(K_SHUTDOWN)
+            try:
+                shutdown = client.try_get(K_SHUTDOWN)
+            except StoreError:
+                # shard outage mid-poll: the watchdog is promoting a spare;
+                # keep the control plane up and poll again
+                shutdown = None
             if shutdown is not None:
                 log.info("workload shut down: %s", shutdown.decode())
                 time.sleep(5.0)  # linger so late agents observe the flag
@@ -182,9 +275,19 @@ def _run_sharded(
             time.sleep(0.5)
         return 0
     finally:
+        watchdog_stop.set()
+        if watchdog is not None:
+            watchdog.join(timeout=5.0)
         loop.stop()
         for s in servers:
             s.stop()
+        for proc in procs:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
 
 
 def main(argv=None) -> None:
@@ -213,6 +316,12 @@ def main(argv=None) -> None:
         help="host this many store shards (consistent-hash keyspace, "
              "per-shard journal); shard 0 binds --port as the bootstrap seed",
     )
+    p.add_argument(
+        "--spares", type=int, default=0,
+        help="hold this many spare store endpoints (ports after the shard "
+             "range); shards run as subprocesses and a dead shard is "
+             "re-pointed to a spare via a CAS'd epoch bump on the shard map",
+    )
     args = p.parse_args(argv)
     sys.exit(
         run(
@@ -221,6 +330,7 @@ def main(argv=None) -> None:
             journal=args.journal,
             require_equal_slots=not args.allow_heterogeneous,
             shards=args.shards,
+            spares=args.spares,
         )
     )
 

@@ -32,6 +32,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..store.client import StoreTimeout
+from ..store.protocol import ADD_SLOT
 from ..telemetry import counter, gauge, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
@@ -54,14 +55,18 @@ _STANDBY = gauge(
     "tpurx_rendezvous_standby_nodes", "Standby (hot-spare) nodes in the last round"
 )
 
-# Store key schema (all round-fenced)
+# Store key schema.  Fixed pointers (round counter, cycle, shutdown flag)
+# keep flat names; every per-round key is ROUND-FIRST (``rdzv/{n}/...``) so
+# the sharded client's affinity routing hashes one round's keys as a unit —
+# that co-location is what lets the one-RTT ops (ADD_SET join, WAIT_GE
+# close) execute on a single shard.
 K_ACTIVE_ROUND = "rdzv/active_round"
 K_CYCLE = "rdzv/cycle"
 K_SHUTDOWN = "rdzv/shutdown"
 
 
 def k_restart_req(n: int) -> str:
-    return f"rdzv/restart_req/{n}"
+    return f"rdzv/{n}/restart_req"
 
 
 def k_shutdown_ack(node_id: str) -> str:
@@ -92,34 +97,59 @@ def is_next_round_open(store, current_round: int) -> bool:
 
 
 def k_open(n: int) -> str:
-    return f"rdzv/open/{n}"
+    return f"rdzv/{n}/open"
 
 
 def k_closed(n: int) -> str:
-    return f"rdzv/closed/{n}"
+    return f"rdzv/{n}/closed"
 
 
 def k_count(n: int, c: int) -> str:
     """Exact-count marker: the c-th joiner of round n sets this key, so the
     host can block on 'count reached c' with one store WAIT instead of
-    polling the counter (event-driven round close)."""
-    return f"rdzv/count/{n}/{c}"
+    polling the counter.  LEGACY path only: stores with ``wait_ge`` block on
+    the join counter itself and joiners skip the marker write entirely."""
+    return f"rdzv/{n}/count/{c}"
 
 
 def k_join_count(n: int) -> str:
-    return f"rdzv/join_count/{n}"
+    return f"rdzv/{n}/join_count"
 
 
 def k_node(n: int, node_id: str) -> str:
-    return f"rdzv/node/{n}/{node_id}"
+    return f"rdzv/{n}/node/{node_id}"
 
 
 def k_result(n: int) -> str:
-    return f"rdzv/result/{n}"
+    return f"rdzv/{n}/result"
 
 
 def k_done(n: int) -> str:
-    return f"rdzv/done/{n}"
+    return f"rdzv/{n}/done"
+
+
+def gc_round(store, n: int) -> None:
+    """Delete every key round ``n`` may have created (idempotent).
+
+    Only call on SETTLED rounds — the host GCs round ``i - keep`` when
+    round ``i`` opens, mirroring ``gc_barrier``'s two-rounds-later
+    discipline.  Per-node and per-count keys are enumerated from the store
+    and deleted through the same helpers that wrote them."""
+    # one delete per helper (not a loop over a tuple): TPURX013 matches
+    # write sites to deletes by key-helper identity, and a loop variable
+    # hides the helper from the template matcher
+    store.delete(k_open(n))
+    store.delete(k_closed(n))
+    store.delete(k_join_count(n))
+    store.delete(k_result(n))
+    store.delete(k_done(n))
+    store.delete(k_restart_req(n))
+    for raw in store.list_keys(f"rdzv/{n}/node/"):
+        store.delete(k_node(n, raw.decode().rsplit("/", 1)[-1]))
+    for raw in store.list_keys(f"rdzv/{n}/count/"):
+        tail = raw.decode().rsplit("/", 1)[-1]
+        if tail.isdigit():
+            store.delete(k_count(n, int(tail)))
 
 
 class NodeRole(str, enum.Enum):
@@ -172,6 +202,19 @@ class NodeDesc:
             slots=slots,
             slice_key=slice_key,
         )
+
+
+def _desc_json_with_arrival_slot(desc: NodeDesc) -> bytes:
+    """The node record JSON with the ``arrival`` field as the server-side
+    ADD_SET splice marker: the arrival number is the post-add join counter,
+    which only the server knows at send time.  ``json.dumps`` renders the
+    int field with default separators, so ``"arrival": 0`` appears exactly
+    once (a quote inside ``node_id`` JSON-escapes to ``\\"`` and cannot
+    forge the pattern)."""
+    base = dataclasses.replace(desc, arrival=0).to_json()
+    return base.replace(
+        '"arrival": 0', '"arrival": ' + ADD_SLOT.decode(), 1
+    ).encode()
 
 
 @dataclasses.dataclass
@@ -286,6 +329,28 @@ class RendezvousHost:
         # round -> monotonic-ns open stamp (for the round-duration metric)
         self._opened_ns: Dict[int, int] = {}
 
+    def _read_descs(self, keys) -> List[Optional[bytes]]:
+        """Node records for ``keys``, batched into one round trip when the
+        store supports ``multi_get`` (``None`` per vanished key)."""
+        if not keys:
+            return []
+        multi_get = getattr(self.store, "multi_get", None)
+        if multi_get is not None:
+            return multi_get(keys)
+        return [self.store.try_get(key) for key in keys]
+
+    def _wait_next_arrival(self, n: int, count: int, timeout: float) -> None:
+        """Block until joiner ``count + 1`` lands (raises StoreTimeout).
+
+        Fast path: WAIT_GE on the join counter itself — works with both
+        joiner generations, since legacy ADD and one-RTT ADD_SET both bump
+        it.  Legacy stores block on the exact-count marker key instead."""
+        wait_ge = getattr(self.store, "wait_ge", None)
+        if wait_ge is not None:
+            wait_ge(k_join_count(n), count + 1, timeout=timeout)
+        else:
+            self.store.wait([k_count(n, count + 1)], timeout=timeout)
+
     def bootstrap(self) -> None:
         """Initialize round/cycle counters if this is a fresh store."""
         self.store.compare_set(K_ACTIVE_ROUND, b"", b"0")
@@ -321,19 +386,21 @@ class RendezvousHost:
     def _gc_old_rounds(self, current: int, keep: int = 2) -> None:
         """Delete keys of rounds older than ``current - keep``: a job crash-
         looping for days must not grow the store unboundedly.  Stale writers
-        are already fenced by round-numbered keys; GC only reclaims memory."""
+        are already fenced by round-numbered keys; GC only reclaims memory.
+        The round-first layout makes discovery one prefix scan: any
+        ``rdzv/{digits}/...`` key names its round in the second segment."""
         cutoff = current - keep
         if cutoff < 0:
             return
-        prefixes = ("rdzv/open/", "rdzv/closed/", "rdzv/join_count/",
-                    "rdzv/count/", "rdzv/node/", "rdzv/result/",
-                    "rdzv/done/", "rdzv/restart_req/")
         try:
-            for prefix in prefixes:
-                for key in self.store.list_keys(prefix):
-                    tail = key.decode()[len(prefix):].split("/", 1)[0]
-                    if tail.isdigit() and int(tail) < cutoff:
-                        self.store.delete(key)
+            rounds = set()
+            for key in self.store.list_keys("rdzv/"):
+                parts = key.decode().split("/", 2)
+                if len(parts) >= 2 and parts[1].isdigit():
+                    rounds.add(int(parts[1]))
+            for r in sorted(rounds):
+                if r < cutoff:
+                    gc_round(self.store, r)
         except Exception:  # noqa: BLE001 - GC must never break a round open
             log.exception("round GC failed (continuing)")
 
@@ -354,9 +421,16 @@ class RendezvousHost:
         desc_cache: Dict[bytes, NodeDesc] = {}
         while True:
             count = int(self.store.try_get(k_join_count(n)) or b"0")
-            for key in self.store.list_keys(f"rdzv/node/{n}/"):
-                if key not in desc_cache:
-                    desc_cache[key] = NodeDesc.from_json(self.store.get(key))
+            missing = [
+                key for key in self.store.list_keys(f"rdzv/{n}/node/")
+                if key not in desc_cache
+            ]
+            if missing:
+                # batched: at 10k nodes, per-key GETs would cost O(N)
+                # sequential round trips per close-loop wake
+                for key, raw in zip(missing, self._read_descs(missing)):
+                    if raw is not None:
+                        desc_cache[key] = NodeDesc.from_json(raw)
             nodes_now = list(desc_cache.values())
             if len(nodes_now) < count:
                 # arrival counters lead their node records by a few writes;
@@ -396,9 +470,7 @@ class RendezvousHost:
                 if wait_s <= 0:
                     break
                 try:
-                    self.store.wait(
-                        [k_count(n, count + 1)], timeout=max(0.01, wait_s)
-                    )
+                    self._wait_next_arrival(n, count, max(0.01, wait_s))
                     continue  # someone arrived: re-evaluate health/max
                 except StoreTimeout:
                     break  # settle expired with nobody new
@@ -410,9 +482,8 @@ class RendezvousHost:
             # block until the next joiner arrives (bounded chunks so the
             # overall timeout is still honored)
             try:
-                self.store.wait(
-                    [k_count(n, count + 1)],
-                    timeout=max(0.01, min(remaining, 30.0)),
+                self._wait_next_arrival(
+                    n, count, max(0.01, min(remaining, 30.0))
                 )
             except StoreTimeout:
                 continue
@@ -421,9 +492,11 @@ class RendezvousHost:
         # small grace for in-flight joiners who passed the open-gate check
         time.sleep(self.close_poll_interval)
         count = int(self.store.try_get(k_join_count(n)) or b"0")
-        nodes = []
-        for key in self.store.list_keys(f"rdzv/node/{n}/"):
-            nodes.append(NodeDesc.from_json(self.store.get(key)))
+        nodes = [
+            NodeDesc.from_json(raw)
+            for raw in self._read_descs(self.store.list_keys(f"rdzv/{n}/node/"))
+            if raw is not None
+        ]
         assignment = assign_group_ranks(
             nodes, self.min_nodes, self.max_nodes,
             require_equal_slots=self.require_equal_slots,
@@ -437,7 +510,9 @@ class RendezvousHost:
             "assignment": assignment,
             "participants": participants,
             "slots": slots,
-            "cycle": int(self.store.get(K_CYCLE)) - 1,
+            "cycle": int(self.store.get(
+                K_CYCLE, timeout=max(0.01, deadline - time.monotonic()),
+            )) - 1,
         }
         self.store.set(k_result(n), json.dumps(result))
         self.store.set(k_done(n), b"1")
@@ -528,12 +603,26 @@ class RendezvousJoiner:
             n = self.wait_round_open(timeout=deadline - time.monotonic())
             if self.pre_join_health_check is not None:
                 self.pre_join_health_check()  # raises UnhealthyNodeError
-            arrival = self.store.add(k_join_count(n), 1)
-            desc = dataclasses.replace(self.desc, arrival=arrival)
-            self.store.set(k_node(n, desc.node_id), desc.to_json())
-            # exact-count marker AFTER the node record: when the host's wait
-            # on this key fires, the corresponding node info is readable
-            self.store.set(k_count(n, arrival), b"1")
+            add_set = getattr(self.store, "add_set", None)
+            if add_set is not None:
+                # One-RTT registration: counter bump + node record in one
+                # atomic op, the arrival number spliced server-side into the
+                # record.  No count marker — a WAIT_GE host blocks on the
+                # join counter, which this same op advances, and the record
+                # is readable the instant the counter moves (both mutate in
+                # one server step, where the legacy path's counter led its
+                # record by a round trip).
+                add_set(
+                    k_join_count(n), 1, k_node(n, self.desc.node_id),
+                    _desc_json_with_arrival_slot(self.desc),
+                )
+            else:
+                arrival = self.store.add(k_join_count(n), 1)
+                desc = dataclasses.replace(self.desc, arrival=arrival)
+                self.store.set(k_node(n, desc.node_id), desc.to_json())
+                # exact-count marker AFTER the node record: when the host's
+                # wait on this key fires, the node info is readable
+                self.store.set(k_count(n, arrival), b"1")
             try:
                 self.store.wait([k_done(n)], timeout=max(1.0, deadline - time.monotonic()))
             except Exception as exc:

@@ -23,10 +23,13 @@ from .client import (
 from .server import StoreServer, serve_forever
 from .barrier import barrier, reentrant_barrier, BarrierOverflow, BarrierTimeout
 from .sharding import (
+    AffinityGroup,
     ShardMap,
     ShardServerGroup,
     ShardedStoreClient,
     ShardedStoreFactory,
+    affinity_token,
+    promote_spare,
     publish_shard_map,
     spawn_shard_subprocess,
 )
@@ -45,10 +48,13 @@ __all__ = [
     "reentrant_barrier",
     "BarrierOverflow",
     "BarrierTimeout",
+    "AffinityGroup",
     "ShardMap",
     "ShardServerGroup",
     "ShardedStoreClient",
     "ShardedStoreFactory",
+    "affinity_token",
+    "promote_spare",
     "publish_shard_map",
     "spawn_shard_subprocess",
     "TreeTopology",

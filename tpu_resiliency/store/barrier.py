@@ -134,14 +134,32 @@ def reentrant_barrier(
     arrivals_key = f"barrier/{name}{gen}/arrivals"
     done_key = f"barrier/{name}{gen}/done"
 
-    new_len = store.append(arrivals_key, f"{rank},")
-    # completion is only possible once the log is at least as long as the
-    # participants' tokens laid end-to-end; below that, skip the read
-    min_len = sum(len(str(r)) + 1 for r in participants)
-    if new_len >= min_len:
-        arrived = _decode_arrivals(store.try_get(arrivals_key))
-        if participants <= arrived:
-            store.set(done_key, b"1")  # idempotent: any completer may set it
+    append_check = getattr(store, "append_check", None)
+    if append_check is not None:
+        # One-RTT arrival: the server appends AND sets `done` when the
+        # participant set is complete, atomically — no completion-check
+        # read, no crash window between a completer's append and its
+        # done-set.  Affinity routing co-locates both keys on one shard.
+        append_check(
+            arrivals_key, f"{rank},", done_key, b"1",
+            required=len(participants),
+            tokens=(
+                [str(r) for r in sorted(participants)]
+                if ranks is not None else ()
+            ),
+        )
+    else:
+        # Legacy arrival (mock/minimal stores): APPEND, then a conditional
+        # completion check + done-set — up to three round trips, and the
+        # wait loop below papers over the completer-crash window.
+        new_len = store.append(arrivals_key, f"{rank},")
+        # completion is only possible once the log is at least as long as
+        # the participants' tokens laid end-to-end; below that, skip the read
+        min_len = sum(len(str(r)) + 1 for r in participants)
+        if new_len >= min_len:
+            arrived = _decode_arrivals(store.try_get(arrivals_key))
+            if participants <= arrived:
+                store.set(done_key, b"1")  # idempotent: any completer may set
 
     deadline = time.monotonic() + timeout
     while True:

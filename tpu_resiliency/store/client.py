@@ -68,6 +68,10 @@ _IDEMPOTENT_OPS = frozenset(
         Op.MULTI_SET,
         Op.MULTI_GET,
         Op.MULTI_TRY_GET,
+        # WAIT_GE is a read fence (blocks until a counter reaches a
+        # threshold) — resending cannot change store state.  APPEND_CHECK
+        # and ADD_SET are NOT idempotent: both mutate on every application.
+        Op.WAIT_GE,
     }
 )
 
@@ -410,6 +414,75 @@ class StoreClient:
             for i in range(0, len(out), 2)
         ]
 
+    # -- one-RTT protocol ops ---------------------------------------------
+    # Both keys of each op must live on the same server; the sharded client
+    # asserts that via affinity groups before delegating here.
+
+    def append_check(
+        self, key, value, done_key, done_value,
+        required: int = 0, tokens: Sequence = (),
+    ) -> tuple[int, bool]:
+        """Append ``value`` to ``key`` AND set ``done_key`` server-side when
+        the arrival population is complete — one round trip, no crash window
+        between a completer's append and its done-set.  With ``tokens`` the
+        population is that exact set; otherwise ``required`` distinct
+        comma-separated tokens.  Returns ``(new_log_len, done)``."""
+        args = [
+            self._k(key), self._v(value), self._k(done_key),
+            self._v(done_value), itob(required),
+        ] + [self._v(t) for t in tokens]
+        status, out = self._roundtrip(Op.APPEND_CHECK, args, self.timeout)
+        if status != Status.OK:
+            raise StoreError(f"append_check({key}) -> {status.name}")
+        return int(out[0]), out[1] == b"1"
+
+    def add_set(self, add_key, amount: int, set_key, set_value) -> int:
+        """Atomic counter bump + record write in one round trip.  The first
+        :data:`~tpu_resiliency.store.protocol.ADD_SLOT` marker in
+        ``set_value`` is replaced server-side by the post-add counter (ASCII
+        decimal).  Returns the new counter value."""
+        status, out = self._roundtrip(
+            Op.ADD_SET,
+            [self._k(add_key), itob(amount), self._k(set_key),
+             self._v(set_value)],
+            self.timeout,
+        )
+        if status != Status.OK:
+            raise StoreError(f"add_set({add_key}) -> {status.name}")
+        return int(out[0])
+
+    def wait_ge(self, key, threshold: int,
+                timeout: Optional[float] = None) -> int:
+        """Block until ``key`` holds an integer >= ``threshold`` (missing key
+        counts as 0).  Sliced like :meth:`get` so liveness stamps keep
+        flowing.  Returns the value observed."""
+        t = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + t
+        wire = [self._k(key), itob(threshold)]
+        while True:
+            remaining = deadline - time.monotonic()
+            slice_t = min(max(remaining, 0.05), self.BLOCKING_SLICE_S)
+            try:
+                status, out = self._roundtrip(
+                    Op.WAIT_GE, wire + [itob(int(slice_t * 1000))],
+                    io_timeout=slice_t + 10.0,
+                )
+            except StoreTimeout:
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(
+                        f"wait_ge({key}, {threshold}) timed out after {t}s"
+                    )
+                continue
+            if status == Status.OK:
+                return int(out[0])
+            if status == Status.TIMEOUT:
+                if remaining <= self.BLOCKING_SLICE_S:
+                    raise StoreTimeout(
+                        f"wait_ge({key}, {threshold}) timed out after {t}s"
+                    )
+                continue
+            raise StoreError(f"wait_ge({key}) -> {status.name}")
+
 
 class PrefixStore:
     """Key-namespace wrapper (equivalent of torch's PrefixStore, used for the
@@ -486,6 +559,22 @@ class PrefixStore:
 
     def multi_get(self, keys: Sequence):
         return self._store.multi_get([self._p(k) for k in keys])
+
+    def append_check(self, key, value, done_key, done_value,
+                     required: int = 0, tokens: Sequence = ()):
+        return self._store.append_check(
+            self._p(key), value, self._p(done_key), done_value,
+            required, tokens,
+        )
+
+    def add_set(self, add_key, amount: int, set_key, set_value) -> int:
+        return self._store.add_set(
+            self._p(add_key), amount, self._p(set_key), set_value
+        )
+
+    def wait_ge(self, key, threshold: int,
+                timeout: Optional[float] = None) -> int:
+        return self._store.wait_ge(self._p(key), threshold, timeout)
 
 
 class FailoverStoreClient(StoreClient):

@@ -43,6 +43,34 @@ class Op(IntEnum):
     MULTI_TRY_GET = 15  # immediate; args: key... -> (b"1", value) per present
                         # key, (b"0", b"") per absent one — per-key misses
                         # instead of MULTI_GET's all-or-nothing KEY_MISS
+    # One-RTT protocol rounds: the ops below fold a whole arrival (append +
+    # completion check, or counter bump + record write) into one round trip,
+    # so a barrier/rendezvous round costs O(rounds) trips instead of
+    # O(ops x ranks).  All keys an op touches MUST live on one shard — the
+    # sharded client's affinity groups guarantee that.
+    APPEND_CHECK = 16   # args: key, value, done_key, done_value, required,
+                        # token... ; append value to key, then decode the log
+                        # as comma-separated tokens and set done_key when the
+                        # population is complete: tokens given -> all of them
+                        # present; none given -> >= `required` DISTINCT tokens
+                        # (duplicates from re-entry collapse).  ->
+                        # (new_len, b"1" if done was set by anyone else b"0")
+    ADD_SET = 17        # args: add_key, amount, set_key, set_value ; atomic
+                        # ADD then SET in one trip.  The first ADD_SLOT marker
+                        # in set_value is replaced by the post-add counter
+                        # (ASCII decimal) — protocols embed the arrival number
+                        # only the server knows.  -> new counter value
+    WAIT_GE = 18        # args: key, threshold, timeout_ms ; block until the
+                        # key holds an integer >= threshold (missing key
+                        # counts as 0).  The event-driven "wait for the next
+                        # arrival" primitive that replaces per-count marker
+                        # keys.  -> current value (or TIMEOUT status)
+
+
+# Spliced by the server into ADD_SET's set_value (first occurrence only):
+# the post-add counter as ASCII decimal.  Chosen to never collide with JSON
+# payloads the protocols store (no '%' keys in any record schema).
+ADD_SLOT = b"%TPURX_N%"
 
 
 class Status(IntEnum):
@@ -78,3 +106,48 @@ def itob(value: int) -> bytes:
 
 def btoi(value: bytes) -> int:
     return int(value.decode())
+
+
+# -- single-source op table ---------------------------------------------------
+# The native server's accepted-op range guard once rejected any op added only
+# on the Python side (silently: the C++ side dropped the connection).  The
+# C++ enum is now GENERATED from this module between the markers below, and a
+# parity test asserts the generated block appears verbatim in the source, so
+# the two servers cannot drift.
+
+CPP_OP_TABLE_BEGIN = "// BEGIN GENERATED OP TABLE"
+CPP_OP_TABLE_END = "// END GENERATED OP TABLE"
+
+
+def render_cpp_op_enum() -> str:
+    """The C++ ``enum Op`` block for ``native/store_server.cpp``.
+
+    ``OP__LAST`` is the range-guard sentinel: the frame parser accepts
+    ``OP_SET..OP__LAST``, so a new Python-side op is rejected by the native
+    server until this block is regenerated — which the parity test turns
+    into a loud failure instead of a silent connection drop.
+    """
+    lines = [
+        f"{CPP_OP_TABLE_BEGIN} "
+        "(source: tpu_resiliency/store/protocol.py;",
+        "// regenerate: python -m tpu_resiliency.store.protocol --cpp)",
+        "enum Op : uint8_t {",
+    ]
+    for op in Op:
+        lines.append(f"  OP_{op.name} = {int(op)},")
+    lines.append(f"  OP__LAST = {max(int(op) for op in Op)},")
+    lines.append("};")
+    lines.append(CPP_OP_TABLE_END)
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--cpp" in sys.argv:
+        # tpurx: disable=TPURX001 -- CLI entry point, stdout is the generated table
+        print(render_cpp_op_enum())
+    else:
+        for _op in Op:
+            # tpurx: disable=TPURX001 -- CLI entry point, stdout is the op listing
+            print(f"{int(_op):3d}  {_op.name}")

@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Set
 
 from ..utils import env
 from ..utils.logging import get_logger
-from .protocol import Op, Status, encode_response, itob
+from .protocol import ADD_SLOT, Op, Status, encode_response, itob
 
 log = get_logger("store.server")
 
@@ -434,6 +434,55 @@ class StoreServer:
                 else:
                     pairs += [b"1", v]
             return encode_response(Status.OK, *pairs)
+        if op == Op.APPEND_CHECK:
+            # one-RTT barrier arrival: append to the shared log AND set the
+            # done key when the participant population is complete — the
+            # append and the completion check are one atomic step, so the
+            # crash window between a completer's APPEND and its done-SET
+            # cannot exist
+            key, value, done_key, done_value = args[0], args[1], args[2], args[3]
+            required = int(args[4])
+            tokens = args[5:]
+            new = data.get(key, b"") + value
+            self._set(key, new)
+            seen = {tok for tok in new.split(b",") if tok}
+            if tokens:  # narrowed participant set: exact membership
+                done = all(t in seen for t in tokens)
+            else:  # full population: distinct-token count (dedup re-entries)
+                done = len(seen) >= required
+            if done:
+                self._set(done_key, done_value)
+            return encode_response(
+                Status.OK, itob(len(new)), b"1" if done else b"0"
+            )
+        if op == Op.ADD_SET:
+            # one-RTT rendezvous join: counter bump + record write in one
+            # trip, splicing the post-add value into the record (the arrival
+            # number only the server knows)
+            add_key, amount = args[0], int(args[1])
+            set_key, set_value = args[2], args[3]
+            new_count = int(data.get(add_key, b"0")) + amount
+            self._set(add_key, itob(new_count))
+            self._set(set_key, set_value.replace(ADD_SLOT, itob(new_count), 1))
+            return encode_response(Status.OK, itob(new_count))
+        if op == Op.WAIT_GE:
+            key, threshold, timeout_ms = args[0], int(args[1]), int(args[2])
+            deadline = time.monotonic() + timeout_ms / 1000.0
+            while True:
+                cur = int(data.get(key) or b"0")
+                if cur >= threshold:
+                    return encode_response(Status.OK, itob(cur))
+                ev = asyncio.Event()
+                self._waiters.setdefault(key, set()).add(ev)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._waiters.get(key, set()).discard(ev)
+                    return encode_response(Status.TIMEOUT)
+                try:
+                    await asyncio.wait_for(ev.wait(), timeout=remaining)
+                except asyncio.TimeoutError:
+                    self._waiters.get(key, set()).discard(ev)
+                    return encode_response(Status.TIMEOUT)
         return encode_response(Status.ERROR, b"unknown op")
 
     # -- connection handling ----------------------------------------------

@@ -42,11 +42,13 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 import zlib
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..telemetry import counter, gauge
+from ..utils import env
 from ..utils.logging import get_logger
 from ..utils.retry import Retrier, RetryExhausted, RetryPolicy
 
@@ -100,6 +102,30 @@ def _parse_endpoints(endpoints) -> List[Tuple[str, int]]:
     return out
 
 
+def affinity_token(key: bytes) -> Optional[bytes]:
+    """The affinity-group token for ``key``, or None for per-key routing.
+
+    Keys of one protocol round hash as a unit so a round's multi-key
+    one-RTT ops (APPEND_CHECK, ADD_SET) are guaranteed single-shard:
+
+    - ``rdzv/{n}/...`` (numeric round segment) -> ``rdzv/{n}``
+    - ``barrier/{name}/...`` -> ``barrier/{name}``
+
+    Fixed rendezvous pointers (``rdzv/active_round`` etc.) have a
+    non-numeric second segment and keep per-key routing, as does every
+    other keyspace — affinity narrows distribution only where a round's
+    keys must be co-located.
+    """
+    parts = key.split(b"/", 2)
+    if len(parts) < 3:
+        return None
+    if parts[0] == b"rdzv" and parts[1].isdigit():
+        return b"rdzv/" + parts[1]
+    if parts[0] == b"barrier":
+        return b"barrier/" + parts[1]
+    return None
+
+
 class ShardMap:
     """Consistent-hash ring over shard endpoints (crc32 space).
 
@@ -108,13 +134,22 @@ class ShardMap:
     Ring points are keyed by shard INDEX, not endpoint: a shard's identity
     is its position (which is also what names its journal, ``*.shard<i>``),
     so a replacement coming up on a different host:port — a restarted
-    control plane re-binding ephemeral ports — keeps the exact same
-    key→shard routing the journals were written under.
+    control plane re-binding ephemeral ports, or a spare promoted by
+    :func:`promote_spare` — keeps the exact same key→shard routing the
+    journals were written under.
+
+    ``epoch`` versions the index→endpoint assignment: every spare
+    promotion bumps it (under CAS on the published map), and clients
+    inside a failover episode adopt any same-size map with a greater
+    epoch.  ``spares`` lists endpoints a dead shard may be promoted onto.
     """
 
-    def __init__(self, endpoints, vnodes: int = 64):
+    def __init__(self, endpoints, vnodes: int = 64, epoch: int = 0,
+                 spares: Sequence = ()):
         self.endpoints = _parse_endpoints(endpoints)
         self.vnodes = vnodes
+        self.epoch = int(epoch)
+        self.spares = _parse_endpoints(spares) if spares else []
         points: List[Tuple[int, int]] = []
         for idx in range(len(self.endpoints)):
             for v in range(vnodes):
@@ -127,6 +162,17 @@ class ShardMap:
     def __len__(self) -> int:
         return len(self.endpoints)
 
+    def with_promoted(self, dead_idx: int, spare_endpoint) -> "ShardMap":
+        """A new map with ``spare_endpoint`` serving shard ``dead_idx`` and
+        the epoch bumped.  Key→index routing is untouched (the ring is keyed
+        by index); the spare is consumed from ``spares`` if listed there."""
+        (spare,) = _parse_endpoints([spare_endpoint])
+        endpoints = [f"{h}:{p}" for h, p in self.endpoints]
+        endpoints[dead_idx] = f"{spare[0]}:{spare[1]}"
+        spares = [f"{h}:{p}" for h, p in self.spares if (h, p) != spare]
+        return ShardMap(endpoints, vnodes=self.vnodes,
+                        epoch=self.epoch + 1, spares=spares)
+
     def shard_for(self, key: bytes) -> int:
         """Owning shard index for ``key`` (first ring point clockwise)."""
         if len(self.endpoints) == 1:
@@ -138,25 +184,76 @@ class ShardMap:
         return self._owners[i]
 
     def to_json(self) -> str:
-        return json.dumps(
-            {
-                "endpoints": [f"{h}:{p}" for h, p in self.endpoints],
-                "vnodes": self.vnodes,
-            }
-        )
+        out = {
+            "endpoints": [f"{h}:{p}" for h, p in self.endpoints],
+            "vnodes": self.vnodes,
+            "epoch": self.epoch,
+        }
+        if self.spares:
+            out["spares"] = [f"{h}:{p}" for h, p in self.spares]
+        return json.dumps(out)
 
     @classmethod
     def from_json(cls, raw) -> "ShardMap":
         if isinstance(raw, bytes):
             raw = raw.decode()
         d = json.loads(raw)
-        return cls(d["endpoints"], vnodes=int(d.get("vnodes", 64)))
+        return cls(
+            d["endpoints"],
+            vnodes=int(d.get("vnodes", 64)),
+            epoch=int(d.get("epoch", 0)),  # pre-epoch maps: epoch 0
+            spares=d.get("spares", ()),
+        )
 
 
 def publish_shard_map(seed_client, shard_map: ShardMap) -> None:
     """Publish the map on the seed shard so bootstrap-only clients (that
     know nothing but the rendezvous endpoint) can discover the fleet."""
     seed_client.set(SHARD_MAP_KEY, shard_map.to_json())
+
+
+def promote_spare(map_client, dead_idx: int, spare_endpoint=None,
+                  timeout: float = 30.0) -> ShardMap:
+    """Re-point shard ``dead_idx`` to a spare endpoint via a CAS'd epoch
+    bump on the published map (``map_client`` talks to whichever server
+    holds :data:`SHARD_MAP_KEY` — the seed, or seed's own journal-restored
+    replacement when the seed is the dead shard).
+
+    ``spare_endpoint`` defaults to the map's first listed spare.  Safe under
+    concurrent promoters: the CAS loser re-reads, and if the winner already
+    re-pointed the same shard, adopts the winner's map instead of promoting
+    twice.  Returns the map now in force.
+    """
+    deadline = time.monotonic() + timeout
+    while True:
+        raw = map_client.get(SHARD_MAP_KEY, timeout=timeout)
+        current = ShardMap.from_json(raw)
+        spare = spare_endpoint
+        if spare is None:
+            if not current.spares:
+                raise StoreError(
+                    f"promote shard {dead_idx}: no spare endpoints in map"
+                )
+            spare = current.spares[0]
+        promoted = current.with_promoted(dead_idx, spare)
+        applied, after = map_client.compare_set_ex(
+            SHARD_MAP_KEY, raw, promoted.to_json()
+        )
+        if applied:
+            log.warning(
+                "promoted spare %s to shard %d (map epoch %d)",
+                spare, dead_idx, promoted.epoch,
+            )
+            return promoted
+        winner = ShardMap.from_json(after)
+        if (winner.epoch > current.epoch
+                and winner.endpoints[dead_idx] != current.endpoints[dead_idx]):
+            return winner  # a concurrent promoter already replaced it
+        if time.monotonic() >= deadline:
+            raise StoreError(
+                f"promote shard {dead_idx}: lost the map CAS past deadline"
+            )
+        # unrelated concurrent map change: retry against the new state
 
 
 class ShardedStoreClient:
@@ -176,12 +273,19 @@ class ShardedStoreClient:
         connect_timeout: float = 60.0,
         vnodes: int = 64,
         failover_policy: RetryPolicy = FAILOVER_POLICY,
+        epoch: int = 0,
+        spares: Sequence = (),
+        affinity: Optional[bool] = None,
     ):
-        self.map = ShardMap(endpoints, vnodes=vnodes)
+        self.map = ShardMap(endpoints, vnodes=vnodes, epoch=epoch,
+                            spares=spares)
         self.endpoints = self.map.endpoints
         self.timeout = timeout
         self._connect_timeout = connect_timeout
         self._failover_policy = failover_policy
+        self._affinity = (
+            env.STORE_AFFINITY.get() if affinity is None else affinity
+        )
         self._clients: List[Optional[StoreClient]] = [
             StoreClient(h, p, timeout=timeout, connect_timeout=connect_timeout)
             for h, p in self.endpoints
@@ -204,12 +308,17 @@ class ShardedStoreClient:
         finally:
             seed.close()
         m = ShardMap.from_json(raw)
-        return cls(m.endpoints, timeout=timeout, vnodes=m.vnodes, **kwargs)
+        return cls(m.endpoints, timeout=timeout, vnodes=m.vnodes,
+                   epoch=m.epoch, spares=m.spares, **kwargs)
 
     # -- plumbing ----------------------------------------------------------
 
     def _shard_idx(self, key) -> int:
         k = key.encode() if isinstance(key, str) else bytes(key)
+        if self._affinity:
+            tok = affinity_token(k)
+            if tok is not None:
+                k = tok
         return self.map.shard_for(k)
 
     def _client(self, idx: int) -> StoreClient:
@@ -231,15 +340,78 @@ class ShardedStoreClient:
             except OSError:
                 pass
 
+    def _fetch_map_raw(self, exclude: int) -> Optional[bytes]:
+        """Best-effort read of the published shard map from any reachable
+        server: live endpoints first (seed ahead — it holds the map), then
+        the map's own spares, then ``TPURX_STORE_SPARES`` (covers the seed
+        itself dying: its journal-restored spare holds the map key)."""
+        candidates = [ep for i, ep in enumerate(self.endpoints)
+                      if i != exclude]
+        candidates += list(self.map.spares)
+        raw_spares = env.STORE_SPARES.get()
+        if raw_spares:
+            candidates += _parse_endpoints(
+                [e.strip() for e in raw_spares.split(",") if e.strip()]
+            )
+        seen = set()
+        for host, port in candidates:
+            if (host, port) in seen:
+                continue
+            seen.add((host, port))
+            try:
+                probe = StoreClient(host, port, timeout=5.0,
+                                    connect_timeout=2.0, retries=0)
+            except StoreError:
+                continue
+            try:
+                raw = probe.try_get(SHARD_MAP_KEY)
+            except (StoreError, StoreTimeout):
+                continue
+            finally:
+                probe.close()
+            if raw:
+                return raw
+        return None
+
+    def _adopt_map(self, m: ShardMap) -> None:
+        for i, (old, new) in enumerate(zip(self.endpoints, m.endpoints)):
+            if old != new:
+                log.warning(
+                    "shard %d re-pointed %s:%d -> %s:%d (map epoch %d)",
+                    i, old[0], old[1], new[0], new[1], m.epoch,
+                )
+                self._reconnect(i)
+        self.map = m
+        self.endpoints = m.endpoints
+
+    def _maybe_adopt_promoted(self, idx: int) -> bool:
+        """Inside shard ``idx``'s failover episode: look for an epoch-bumped
+        map (a spare was promoted) and re-point re-indexed endpoints.  The
+        ring is keyed by index, so adoption never moves keys — only where
+        index ``idx`` connects."""
+        raw = self._fetch_map_raw(exclude=idx)
+        if raw is None:
+            return False
+        try:
+            m = ShardMap.from_json(raw)
+        except (ValueError, KeyError):
+            return False
+        if m.epoch <= self.map.epoch or len(m) != len(self.map):
+            return False
+        self._adopt_map(m)
+        return True
+
     def _routed(self, idx: int, fn: Callable[[StoreClient], object]):
         """Run ``fn`` against shard ``idx``, riding out a shard death.
 
         The base client already retries transport-level failures of
         idempotent ops; what lands here as :class:`StoreError` is a shard
         that stayed dead past that budget.  The failover episode reconnects
-        and re-runs under ``store_shard_failover`` until the journal-replayed
-        replacement accepts, or the policy deadline expires.  ``fn`` must be
-        safe to re-run (idempotent op, or recovery logic like the CAS path).
+        and re-runs under ``store_shard_failover`` until a replacement
+        accepts — journal-replayed on the same endpoint, or an epoch-bumped
+        spare discovered via the published map — or the policy deadline
+        expires.  ``fn`` must be safe to re-run (idempotent op, or recovery
+        logic like the CAS path).
         """
         self._shard_ops[idx].inc()
         retrier: Optional[Retrier] = None
@@ -267,6 +439,7 @@ class ShardedStoreClient:
                         f"{give_up.last_exc}"
                     ) from give_up
                 self._reconnect(idx)
+                self._maybe_adopt_promoted(idx)
 
     def _by_shard(self, keys: Sequence) -> dict:
         """{shard_idx: [(position, key), ...]} preserving caller order."""
@@ -283,6 +456,9 @@ class ShardedStoreClient:
             timeout=self.timeout,
             vnodes=self.map.vnodes,
             failover_policy=self._failover_policy,
+            epoch=self.map.epoch,
+            spares=[f"{h}:{p}" for h, p in self.map.spares],
+            affinity=self._affinity,
         )
 
     def close(self) -> None:
@@ -367,6 +543,7 @@ class ShardedStoreClient:
                         f"back: {give_up.last_exc}"
                     ) from give_up
                 self._reconnect(idx)
+                self._maybe_adopt_promoted(idx)
                 try:
                     current = self._client(idx).try_get(key)
                 except (StoreError, StoreTimeout):
@@ -377,11 +554,16 @@ class ShardedStoreClient:
                 # not applied: loop re-issues the CAS against live state
 
     def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        """Block until every key exists.  Per-shard groups run CONCURRENTLY
+        (one thread per extra shard): the overall fence latency is the MAX
+        of the shard fences, where the historical sequential loop paid the
+        SUM — at K shards a near-deadline straggler on each made the fence
+        K times slower than the slowest shard."""
         t = self.timeout if timeout is None else timeout
         deadline = time.monotonic() + t
-        for idx, group in self._by_shard(keys).items():
-            group_keys = [k for _pos, k in group]
+        groups = list(self._by_shard(keys).items())
 
+        def wait_shard(idx: int, group_keys: List) -> None:
             def attempt(c: StoreClient, _keys=group_keys) -> None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -391,6 +573,48 @@ class ShardedStoreClient:
                 c.wait(_keys, timeout=remaining)
 
             self._routed(idx, attempt)
+
+        if len(groups) == 1:  # common case: no thread overhead
+            idx, group = groups[0]
+            return wait_shard(idx, [k for _pos, k in group])
+        errors: List[Optional[BaseException]] = [None] * len(groups)
+
+        def run(slot: int, idx: int, group_keys: List) -> None:
+            try:
+                wait_shard(idx, group_keys)
+            except BaseException as exc:  # re-raised on the caller thread
+                errors[slot] = exc
+
+        threads = [
+            threading.Thread(
+                target=run, args=(slot, idx, [k for _pos, k in group]),
+                name=f"shard-wait-{idx}", daemon=True,
+            )
+            for slot, (idx, group) in enumerate(groups)
+        ]
+        for th in threads:
+            th.start()
+        # bound each join past the wait deadline by the failover episode's
+        # own deadline: a shard mid-failover legitimately outlives the wait
+        # budget, but a thread alive past BOTH is wedged — raise rather
+        # than park forever
+        join_deadline = deadline + self._failover_policy.deadline + 5.0
+        for th in threads:
+            th.join(timeout=max(0.0, join_deadline - time.monotonic()))
+            if th.is_alive():
+                raise StoreTimeout(
+                    f"wait({list(keys)}): {th.name} still blocked "
+                    f"{self._failover_policy.deadline + 5.0:.0f}s past the "
+                    f"{t}s deadline"
+                )
+        # surface a hard shard error over a plain timeout: the timeout may
+        # BE the dead shard, and the error names it
+        for exc in errors:
+            if exc is not None and not isinstance(exc, StoreTimeout):
+                raise exc
+        for exc in errors:
+            if exc is not None:
+                raise exc
 
     def check(self, keys: Sequence) -> bool:
         return all(
@@ -427,6 +651,170 @@ class ShardedStoreClient:
             for (pos, _key), val in zip(group, vals):
                 out[pos] = val
         return out
+
+    # -- one-RTT protocol ops ---------------------------------------------
+    # Multi-key atomic ops execute on ONE single-threaded shard; the keys'
+    # co-location is ASSERTED here (affinity routing makes it hold — a
+    # violation means the caller's keys fall outside an affinity group).
+
+    def _colocated(self, op: str, key_a, key_b) -> int:
+        i, j = self._shard_idx(key_a), self._shard_idx(key_b)
+        if i != j:
+            raise StoreError(
+                f"{op}({key_a!r}, {key_b!r}): keys land on shards {i}/{j}; "
+                f"one-RTT ops need both on one shard — route the round's "
+                f"keys through an affinity group (affinity_token prefix)"
+            )
+        return i
+
+    def append_check(
+        self, key, value, done_key, done_value,
+        required: int = 0, tokens: Sequence = (),
+    ) -> Tuple[int, bool]:
+        idx = self._colocated("append_check", key, done_key)
+        # at-most-once like add/append: a resend would double-append
+        return self._shard_ops_inc_and_call(
+            idx,
+            lambda c: c.append_check(
+                key, value, done_key, done_value, required, tokens
+            ),
+        )
+
+    def add_set(self, add_key, amount: int, set_key, set_value) -> int:
+        idx = self._colocated("add_set", add_key, set_key)
+        return self._shard_ops_inc_and_call(
+            idx, lambda c: c.add_set(add_key, amount, set_key, set_value)
+        )
+
+    def wait_ge(self, key, threshold: int,
+                timeout: Optional[float] = None) -> int:
+        t = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + t
+        idx = self._shard_idx(key)
+
+        def attempt(c: StoreClient) -> int:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise StoreTimeout(
+                    f"wait_ge({key}, {threshold}) timed out after {t}s"
+                )
+            return c.wait_ge(key, threshold, timeout=remaining)
+
+        return self._routed(idx, attempt)
+
+    def affinity(self, prefix) -> "AffinityGroup":
+        """A handle whose ops are guaranteed single-shard for every key
+        under ``prefix`` (which should be an :func:`affinity_token` value,
+        e.g. ``rdzv/7`` or ``barrier/restart``)."""
+        return AffinityGroup(self, prefix)
+
+
+class AffinityGroup:
+    """Single-shard view over one protocol round's keys.
+
+    Every op verifies its keys (a) carry the group's prefix and (b) route
+    to the group's home shard — asserted per call, not assumed, so a
+    mis-grouped key (affinity disabled, or a key outside the round) fails
+    loudly instead of splitting a one-RTT op across shards.  Delegates to
+    the owning :class:`ShardedStoreClient`, so failover episodes and
+    epoch adoption apply unchanged.
+    """
+
+    def __init__(self, base: ShardedStoreClient, prefix):
+        self._base = base
+        self._prefix = (
+            prefix.decode() if isinstance(prefix, bytes) else str(prefix)
+        ).rstrip("/")
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def shard(self) -> int:
+        return self._base._shard_idx(self._prefix)
+
+    def _chk(self, *keys) -> None:
+        home = self._base._shard_idx(self._prefix)
+        for key in keys:
+            k = key.decode() if isinstance(key, bytes) else str(key)
+            if k != self._prefix and not k.startswith(self._prefix + "/"):
+                raise StoreError(
+                    f"key {k!r} is outside affinity group {self._prefix!r}"
+                )
+            idx = self._base._shard_idx(k)
+            if idx != home:
+                raise StoreError(
+                    f"affinity violated: key {k!r} routes to shard {idx}, "
+                    f"group {self._prefix!r} lives on shard {home} (is "
+                    f"TPURX_STORE_AFFINITY disabled?)"
+                )
+
+    def set(self, key, value) -> None:
+        self._chk(key)
+        return self._base.set(key, value)
+
+    def get(self, key, timeout: Optional[float] = None) -> bytes:
+        self._chk(key)
+        return self._base.get(key, timeout)
+
+    def try_get(self, key) -> Optional[bytes]:
+        self._chk(key)
+        return self._base.try_get(key)
+
+    def add(self, key, amount: int = 1) -> int:
+        self._chk(key)
+        return self._base.add(key, amount)
+
+    def append(self, key, value) -> int:
+        self._chk(key)
+        return self._base.append(key, value)
+
+    def compare_set(self, key, expected, desired) -> bytes:
+        self._chk(key)
+        return self._base.compare_set(key, expected, desired)
+
+    def compare_set_ex(self, key, expected, desired) -> Tuple[bool, bytes]:
+        self._chk(key)
+        return self._base.compare_set_ex(key, expected, desired)
+
+    def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        self._chk(*keys)
+        return self._base.wait(keys, timeout)
+
+    def check(self, keys: Sequence) -> bool:
+        self._chk(*keys)
+        return self._base.check(keys)
+
+    def delete(self, key) -> bool:
+        self._chk(key)
+        return self._base.delete(key)
+
+    def multi_set(self, items: dict) -> None:
+        self._chk(*items.keys())
+        return self._base.multi_set(items)
+
+    def multi_get(self, keys: Sequence) -> List[Optional[bytes]]:
+        self._chk(*keys)
+        return self._base.multi_get(keys)
+
+    def append_check(
+        self, key, value, done_key, done_value,
+        required: int = 0, tokens: Sequence = (),
+    ) -> Tuple[int, bool]:
+        self._chk(key, done_key)
+        return self._base.append_check(
+            key, value, done_key, done_value, required, tokens
+        )
+
+    def add_set(self, add_key, amount: int, set_key, set_value) -> int:
+        self._chk(add_key, set_key)
+        return self._base.add_set(add_key, amount, set_key, set_value)
+
+    def wait_ge(self, key, threshold: int,
+                timeout: Optional[float] = None) -> int:
+        self._chk(key)
+        return self._base.wait_ge(key, threshold, timeout)
 
 
 class ShardedStoreFactory:

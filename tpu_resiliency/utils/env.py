@@ -171,6 +171,18 @@ STORE_ENDPOINTS = Knob(
     "TPURX_STORE_ENDPOINTS", str, None,
     "Comma-separated host:port shard endpoints, overriding the "
     "shard-map bootstrap read.", group="store")
+STORE_AFFINITY = Knob(
+    "TPURX_STORE_AFFINITY", bool, True,
+    "Key-affinity routing in the sharded store client: keys of one "
+    "protocol round (barrier/{name}/*, rdzv/{n}/*) hash as a unit so "
+    "multi-key one-RTT ops stay single-shard.  Disable to fall back to "
+    "pure per-key routing.", group="store")
+STORE_SPARES = Knob(
+    "TPURX_STORE_SPARES", str, None,
+    "Comma-separated host:port spare store endpoints a dead shard can be "
+    "promoted onto (CAS'd epoch bump on the shard map); also consulted by "
+    "clients re-fetching the map when every mapped endpoint is down.",
+    group="store")
 NATIVE_STORE = Knob(
     "TPURX_NATIVE_STORE", bool, False,
     "Launcher hosts the native C++ store server instead of the asyncio "

@@ -1,8 +1,9 @@
 """TPURX013: store-key lifecycle — ephemeral keys must have a GC path.
 
 Protocol rounds write per-round/per-rank keys into the control-plane store
-(``set``/``append``/``add`` with interpolated round, cycle, iteration, or
-rank components).  A key written every round and deleted never is a leak
+(``set``/``append``/``add`` — and the fused one-RTT ``append_check`` /
+``add_set``, which each write a second key at ``args[2]`` — with
+interpolated round, cycle, iteration, or rank components).  A key written every round and deleted never is a leak
 that grows O(rounds x ranks) until a 10k-rank job OOMs the shard — the
 ``store/tree.py`` discipline (parents delete consumed child keys, the round
 fence doubles as the GC barrier) is the model.
@@ -26,7 +27,11 @@ import ast
 from ..astutil import attr_chain, call_name
 from ..registry import Rule, register
 
-_WRITE_OPS = {"set", "append", "add"}
+_WRITE_OPS = {"set", "append", "add", "append_check", "add_set"}
+# the one-RTT atomic ops write TWO keys: the log/counter at args[0] and the
+# done/marker key at args[2] — each is checked under its effective primitive
+# (append_check ~ append+set, add_set ~ add+set)
+_TWO_KEY_OPS = {"append_check": "append", "add_set": "add"}
 _DELETE_OPS = {"delete", "multi_delete", "delete_prefix"}
 
 # functions whose key argument is consumed by their own GC discipline
@@ -157,6 +162,7 @@ class StoreKeyLifecycleRule(Rule):
         "tpu_resiliency/store/",
         "tpu_resiliency/inprocess/",
         "tpu_resiliency/checkpointing/local/",
+        "tpu_resiliency/fault_tolerance/rendezvous.py",
     )
     # the store implementation itself (set/delete here are the ops, not
     # protocol-round usage); tree.py is the sanctioned GC discipline home
@@ -208,12 +214,19 @@ class StoreKeyLifecycleRule(Rule):
                         and self.applies_to(fi.pf.rel)):
                     if locals_ is None:
                         locals_ = _local_templates(fi, cg, consts)
-                    t = _template_of(node.args[0], cg, fi, locals_, consts)
-                    if t is None:
-                        continue
-                    if not t.ephemeral and func.attr in ("set", "add"):
-                        continue   # bounded singleton
-                    writes.append((t, fi.pf, node.lineno, func.attr))
+                    if func.attr in _TWO_KEY_OPS:
+                        key_ops = [(node.args[0], _TWO_KEY_OPS[func.attr])]
+                        if len(node.args) >= 3:
+                            key_ops.append((node.args[2], "set"))
+                    else:
+                        key_ops = [(node.args[0], func.attr)]
+                    for key_expr, eff_op in key_ops:
+                        t = _template_of(key_expr, cg, fi, locals_, consts)
+                        if t is None:
+                            continue
+                        if not t.ephemeral and eff_op in ("set", "add"):
+                            continue   # bounded singleton
+                        writes.append((t, fi.pf, node.lineno, func.attr))
 
         for t, pf, line, op in writes:
             if t.ident in deletes:
