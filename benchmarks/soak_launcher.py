@@ -50,6 +50,15 @@ disk bytes > 0, peer bytes == 0) with ``tpurx_ckpt_fallback_depth`` 0:
 a stalled peer degrades the restore to a colder source, never to an
 older iteration.
 
+With ``--link-degrade`` the soak runs the self-healing-collectives
+campaign: every rank loops a wrapped collective
+(``parallel.collectives.device_max_reduce``) while rank 0's PRIMARY lane
+is fault-armed to stall past its deadline (``TPURX_FAULT=coll_stall``).
+The gate asserts the wrapper handled the bad link entirely in process —
+deadline trip (``tpurx_collective_timeouts_total`` > 0), degrade ladder
+walked (``tpurx_collective_degrades_total`` > 0 on the armed rank only),
+every rank FINISHED, and the launcher ring recorded ZERO restart cycles.
+
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
 for both rings from those events and ASSERTS bounds, so a regression in
@@ -301,6 +310,59 @@ print(f"soaklc[{rank}] result=done", flush=True)
 """
 
 
+WORKLOAD_COLL = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
+from tpu_resiliency.parallel import device_max_reduce
+from tpu_resiliency.telemetry import get_registry
+
+rank = int(os.environ["TPURX_RANK"])
+world = int(os.environ["TPURX_WORLD_SIZE"])
+total = int(os.environ.get("SOAK_COLL_STEPS", "25"))
+ckpt = os.environ["SOAK_CKPT"]
+
+
+def metric_sum(name):
+    m = get_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(v.get("value", 0.0) for _l, v in m._sample_rows())
+
+
+client = RankMonitorClient(); client.init_workload_monitoring()
+from tpu_resiliency.store.client import store_from_env
+store = store_from_env(timeout=10.0)
+for step in range(total):
+    client.send_heartbeat()
+    # every step runs one wrapped collective; on the fault-armed rank
+    # (TPURX_FAULT=coll_stall) the primary lane stalls past its deadline
+    # and the wrapper must degrade (retry -> re-layout) IN PROCESS — the
+    # launcher ring must never see a restart
+    got = device_max_reduce([float(step)])
+    assert got and got[0] >= float(step), (got, step)
+    time.sleep(0.02)
+    if rank == 0:
+        write_progress_iteration(ckpt, step + 1)
+# gang-synchronized exit: a rank exiting while the degraded rank is still
+# grinding reads as a failure to the launcher ring, which would restart
+# the gang and mask the zero-restart assertion
+store.set(f"soakcoll/done/r{rank}", "1")
+t_barrier = time.monotonic()
+while time.monotonic() - t_barrier < 120.0:
+    client.send_heartbeat()
+    if all(store.try_get(f"soakcoll/done/r{r}") is not None
+           for r in range(world)):
+        break
+    time.sleep(0.2)
+print(f"soakcoll[{rank}] result=done "
+      f"degrades={int(metric_sum('tpurx_collective_degrades_total'))} "
+      f"timeouts={int(metric_sum('tpurx_collective_timeouts_total'))}",
+      flush=True)
+"""
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -430,6 +492,12 @@ def main() -> None:
                         "serving rank mid-restore drill; the other ranks' "
                         "ladders must fall through to their own disk with "
                         "fallback depth 0")
+    p.add_argument("--link-degrade", action="store_true",
+                   help="self-healing-collectives campaign: one rank's "
+                        "primary collective lane is fault-armed to stall "
+                        "past its deadline (TPURX_FAULT=coll_stall); the "
+                        "wrapper must degrade (retry -> re-layout) and the "
+                        "job must finish with ZERO launcher-ring restarts")
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
     p.add_argument("--chaos-store", action="store_true",
@@ -459,10 +527,12 @@ def main() -> None:
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
     with open(wl_path, "w") as f:
-        f.write(
-            WORKLOAD_LCKPT if (args.corrupt_blob or args.peer_mem_kill)
-            else WORKLOAD
-        )
+        if args.link_degrade:
+            f.write(WORKLOAD_COLL)
+        elif args.corrupt_blob or args.peer_mem_kill:
+            f.write(WORKLOAD_LCKPT)
+        else:
+            f.write(WORKLOAD)
     ckpt = os.path.join(workdir, "progress.txt")
     profile = os.path.join(workdir, "profile.jsonl")
     journal = os.path.join(workdir, "store.journal")
@@ -517,6 +587,19 @@ def main() -> None:
         })
         if not args.corrupt_blob:
             env["SOAK_CORRUPT_STEP"] = "-1"  # drill only, no corruption leg
+    if args.link_degrade:
+        env.update({
+            # stall rank 0's PRIMARY collective lane past its deadline;
+            # fallback lanes stay healthy so the degrade ladder can land
+            "TPURX_FAULT": "coll_stall",
+            "TPURX_FAULT_RANKS": "0",
+            "TPURX_COLL_DEADLINE_MS": "300",
+            "TPURX_COLL_RETRIES": "1",
+            "SOAK_COLL_STEPS": "25",
+            # the first degraded call eats ~2 deadlines + a re-layout;
+            # keep the heartbeat kill threshold well clear of that
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "10.0",
+        })
     if args.quorum:
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -710,6 +793,44 @@ def main() -> None:
             # lckpt workloads track progress through checkpoint iterations
             monotone = True
             final = max((d[1] for d in drills), default=0)
+    # self-healing-collectives campaign (--link-degrade): every rank must
+    # FINISH (no restart of any kind), the armed rank must have walked the
+    # degrade ladder (timeouts then degrades both nonzero), the healthy
+    # ranks must have degraded nothing, and the launcher ring must have
+    # recorded ZERO restart cycles — a single bad link costs one
+    # collective's deadline plus a local re-layout, not a pod-wide restart
+    coll_report: dict = {}
+    coll_ok = True
+    if args.link_degrade:
+        import re as re_mod
+
+        marks = [
+            tuple(int(x) for x in m)
+            for m in re_mod.findall(
+                r"soakcoll\[(\d+)\] result=done degrades=(\d+) "
+                r"timeouts=(\d+)", out)
+        ]
+        armed = [m for m in marks if m[0] == 0]
+        coll_ok = bool(
+            marks
+            and {m[0] for m in marks} == set(range(args.nproc))
+            and armed and armed[0][1] >= 1 and armed[0][2] >= 1
+            # healthy ranks may eat a first-call compile-latency timeout
+            # (retry rung absorbs it) but must never DEGRADE
+            and all(m[1] == 0 for m in marks if m[0] != 0)
+            and cycles == 0
+        )
+        coll_report = {
+            "link_degrade": True,
+            "coll_marks": marks,
+            "coll_degrades": armed[0][1] if armed else 0,
+            "coll_timeouts": armed[0][2] if armed else 0,
+            "coll_ok": coll_ok,
+        }
+        monotone = all(
+            b >= a for a, b in zip(progress_samples, progress_samples[1:])
+        )
+        final = len(marks)
     ckpt_report: dict = {}
     ckpt_ok = True
     if args.corrupt_blob:
@@ -754,6 +875,8 @@ def main() -> None:
         final = max((r[1] for r in restores), default=0)
     if args.corrupt_blob:
         ok = bool(ckpt_ok and peer_ok and cycles >= 1)
+    elif args.link_degrade:
+        ok = bool(coll_ok and monotone)
     elif args.peer_mem_kill:
         ok = bool(peer_ok and final > 0)
     else:
@@ -782,6 +905,7 @@ def main() -> None:
                 "bounds_ok": bounds_ok,
                 "ladder_ok": ladder_ok,
                 "saves_ok": saves_ok,
+                **coll_report,
                 **peer_report,
                 **ckpt_report,
                 "ok": ok,

@@ -1,0 +1,327 @@
+"""Unit surface of the self-healing collective layer (docs/collectives.md).
+
+Pure-Python lanes (no jax, no mesh): the wrapper's contract — deadline
+trips typed with op+axis, the retry → relayout → shrink ladder, route
+health bias, at-abort DegradeVerdict consumption — is all host-side
+machinery exercised here with plain callables.  The end-to-end drives
+live in test_soak_smoke.py (--link-degrade campaign) and
+test_layered_restart.py ("degrade" scenario).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.attribution.base import AttributionResult
+from tpu_resiliency.attribution.trace_analyzer import (
+    DegradeVerdict,
+    analyze_fingerprints,
+    degrade_verdict,
+)
+from tpu_resiliency.inprocess.abort import (
+    AbortLadder,
+    DegradeToShrink,
+    ShrinkMeshStage,
+    get_degrade_hook,
+    install_degrade_hook,
+)
+from tpu_resiliency.parallel import collectives as coll_mod
+from tpu_resiliency.parallel.collectives import (
+    ResilientCollective,
+    wrap_collective,
+)
+from tpu_resiliency.parallel.deadline import CollectiveTimeout, DeadlineLane
+from tpu_resiliency.parallel.degrade import DegradePolicy, trip_shrink
+from tpu_resiliency.parallel.health import SUSPECT_AFTER, health
+
+
+@pytest.fixture(autouse=True)
+def fresh_collective_plane():
+    """Each test gets its own shared lane + route-health registry (the
+    singletons are process-global; a tripped route from one test must not
+    bias the next)."""
+    coll_mod._reset_for_tests()
+    install_degrade_hook(None)
+    yield
+    coll_mod._reset_for_tests()
+    install_degrade_hook(None)
+
+
+def sleeper(seconds, value):
+    def fn(*args, **kwargs):
+        time.sleep(seconds)
+        return value
+
+    return fn
+
+
+# -- wrapper basics ----------------------------------------------------------
+
+
+def test_wrapped_op_returns_primary_result_and_args_pass_through():
+    calls = []
+
+    def op(a, b, *, k=0):
+        calls.append((a, b, k))
+        return a + b + k
+
+    c = wrap_collective(op, "add_op", axis="data", deadline_ms=5000.0)
+    assert c(1, 2, k=3) == 6
+    assert calls == [(1, 2, 3)]
+    st = health().route("add_op", "data")
+    assert st.ok_count == 1 and st.timeout_count == 0
+    assert st.ewma_latency_ns > 0
+
+
+def test_zero_budget_runs_inline_on_caller_thread():
+    seen = {}
+
+    def op():
+        seen["thread"] = threading.current_thread()
+        return 42
+
+    c = ResilientCollective("inline_op", op, deadline_ms=0.0)
+    assert c() == 42
+    # the opt-out: no worker handoff at all
+    assert seen["thread"] is threading.current_thread()
+
+
+def test_op_exception_propagates_untouched():
+    def op():
+        raise ValueError("not a hang")
+
+    c = ResilientCollective(
+        "raiser", op, deadline_ms=5000.0,
+        policy=DegradePolicy(rungs=(), retries=0),
+    )
+    with pytest.raises(ValueError, match="not a hang"):
+        c()
+    # an op *failure* is not a deadline trip
+    assert health().route("raiser", "").timeout_count == 0
+
+
+def test_env_knobs_read_at_call_time(monkeypatch):
+    c = ResilientCollective("knobbed", lambda: 1)
+    monkeypatch.setenv("TPURX_COLL_DEADLINE_MS", "123.5")
+    assert c.budget_ms() == 123.5
+    monkeypatch.setenv("TPURX_COLL_RETRIES", "7")
+    monkeypatch.setenv("TPURX_COLL_DEGRADE", "retry,shrink,bogus")
+    pol = c.policy()
+    assert pol.retries == 7
+    assert pol.rungs == ("retry", "shrink")  # unknown rung dropped
+
+
+# -- deadline trips ----------------------------------------------------------
+
+
+def test_deadline_trip_raises_typed_timeout_naming_op_and_axis():
+    c = ResilientCollective(
+        "slow_gather", sleeper(0.6, "late"), axis="model",
+        deadline_ms=100.0, policy=DegradePolicy(rungs=(), retries=0),
+    )
+    with pytest.raises(CollectiveTimeout) as ei:
+        c()
+    exc = ei.value
+    assert exc.op == "slow_gather"
+    assert exc.axis == "model"
+    assert exc.budget_ms == 100.0
+    assert "collective 'slow_gather' exceeded its 100ms deadline" in str(exc)
+    assert "mesh axis 'model'" in str(exc)
+    st = health().route("slow_gather", "model")
+    assert st.timeout_count == 1 and st.consecutive_timeouts == 1
+
+
+def test_lane_abandons_worker_and_serves_next_op():
+    lane = DeadlineLane("t-abandon")
+    try:
+        with pytest.raises(CollectiveTimeout):
+            lane.run(sleeper(0.6, None), op="wedged", budget_ms=80.0)
+        assert lane.abandoned == 1
+        # a fresh worker serves the next submission immediately — the lane
+        # is not poisoned by the still-sleeping abandoned thread
+        assert lane.run(lambda: "ok", op="next", budget_ms=2000.0) == "ok"
+    finally:
+        lane.stop()
+
+
+def test_retry_rung_absorbs_transient_stall():
+    attempts = []
+
+    def flaky():
+        attempts.append(time.monotonic())
+        if len(attempts) == 1:
+            time.sleep(0.5)  # first call blows the budget (transient)
+        return "recovered"
+
+    c = ResilientCollective(
+        "flaky_op", flaky, deadline_ms=120.0,
+        policy=DegradePolicy(rungs=("retry",), retries=2),
+    )
+    assert c() == "recovered"
+    assert len(attempts) == 2
+    st = health().route("flaky_op", "")
+    # recovered via retry: no lasting route bias
+    assert st.consecutive_timeouts == 0
+    assert health().start_rung("flaky_op", "") == ""
+
+
+# -- degrade ladder ----------------------------------------------------------
+
+
+def test_relayout_rung_lands_on_fallback_and_biases_route():
+    primary_calls, relayouts = [], []
+
+    def primary():
+        primary_calls.append(1)
+        time.sleep(0.5)  # a dead link: every primary attempt blows budget
+        return "primary"
+
+    c = ResilientCollective(
+        "dead_link", primary, axis="data", fallback=lambda: "via_fallback",
+        deadline_ms=100.0,
+        policy=DegradePolicy(rungs=("retry", "relayout"), retries=0),
+        relayout=lambda: relayouts.append(1) or "noop",
+    )
+    assert c() == "via_fallback"
+    assert relayouts == [1]
+    assert len(primary_calls) == 1  # retries=0: one burned deadline only
+    # recovery via relayout biases the route: the next call must NOT burn
+    # another deadline re-proving the primary
+    assert health().start_rung("dead_link", "data") == "relayout"
+    assert c() == "via_fallback"
+    assert len(primary_calls) == 1  # primary never re-attempted
+
+
+def test_consecutive_timeouts_arm_relayout_bias():
+    c = ResilientCollective(
+        "suspect_link", sleeper(0.4, None), deadline_ms=80.0,
+        policy=DegradePolicy(rungs=(), retries=0),
+    )
+    for _ in range(SUSPECT_AFTER):
+        with pytest.raises(CollectiveTimeout):
+            c()
+    assert health().start_rung("suspect_link", "") == "relayout"
+    health().clear_route("suspect_link", "")
+    assert health().start_rung("suspect_link", "") == ""
+
+
+def test_exhausted_ladder_reraises_last_timeout():
+    c = ResilientCollective(
+        "hopeless", sleeper(0.4, None), axis="x", deadline_ms=80.0,
+        fallback=sleeper(0.6, None),  # the fallback lane is dead too
+        policy=DegradePolicy(rungs=("retry", "relayout"), retries=0),
+        relayout=lambda: "noop",
+    )
+    with pytest.raises(CollectiveTimeout) as ei:
+        c()
+    assert ei.value.op == "hopeless"
+
+
+class RecordingHook:
+    """Stand-in degrade hook (the real DegradeToShrink tears down jax
+    backends — not for a unit lane)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, op="", axis="", culprits=()):
+        self.calls.append((op, axis, tuple(culprits)))
+        return "recorded"
+
+
+def test_shrink_rung_fires_installed_degrade_hook():
+    hook = RecordingHook()
+    install_degrade_hook(hook)
+    assert get_degrade_hook() is hook
+    c = ResilientCollective(
+        "shrink_me", sleeper(0.5, None), axis="ici",
+        fallback=lambda: "post_shrink", deadline_ms=90.0,
+        policy=DegradePolicy(rungs=("shrink",), retries=0),
+    )
+    assert c() == "post_shrink"
+    assert hook.calls == [("shrink_me", "ici", ())]
+
+
+def test_trip_shrink_without_hook_runs_bare_ladder_gated_off():
+    # standalone process (no wrapper installed a hook): trip_shrink builds
+    # a one-rung ladder around ShrinkMeshStage, which is opt-in and —
+    # TPURX_SHRINK_MESH unset here — gates itself off (outcome recorded,
+    # no backend teardown)
+    detail = trip_shrink("lone_op", "axis0")
+    assert "shrink_mesh=skipped" in detail
+
+
+def test_degrade_to_shrink_runs_shrink_stage_through_ladder_accounting():
+    ladder = AbortLadder(ShrinkMeshStage(enabled=False), name="degrade")
+    hook = DegradeToShrink(ladder)
+    out = hook(op="opx", axis="ax", culprits=(3,))
+    assert hook.trips == 1
+    assert "shrink_mesh=skipped" in out  # gated stage: outcome still recorded
+
+
+# -- at-abort verdict consumption --------------------------------------------
+
+
+def _laggard_tails():
+    """Synthetic at-abort fingerprints: ranks 0/2 parked fresh inside
+    'unified_allreduce', rank 1 stopped dispatching long before them."""
+    return {
+        0: [{"op": "unified_allreduce", "age_ms": 50.0, "seq": 10}],
+        1: [{"op": "unified_allreduce", "age_ms": 5000.0, "seq": 10}],
+        2: [{"op": "unified_allreduce", "age_ms": 60.0, "seq": 10}],
+    }
+
+
+def test_degrade_verdict_maps_wedged_collective_to_shrink():
+    result = analyze_fingerprints(_laggard_tails())
+    assert result.category == "wedged_collective"
+    dv = degrade_verdict(result)
+    assert dv.action == "shrink"
+    assert dv.op == "unified_allreduce"
+    assert dv.culprit_ranks == [1]
+    # machine-readable: survives the store round-trip
+    assert DegradeVerdict.from_json(dv.to_json()) == dv
+
+
+def test_degrade_verdict_maps_pod_wide_stall_to_relayout():
+    result = AttributionResult(
+        category="collective_stall", confidence=0.5,
+        summary="pod-wide", extra={"op": "ring_permute"},
+    )
+    dv = degrade_verdict(result)
+    assert dv.action == "relayout" and dv.op == "ring_permute"
+
+
+def test_degrade_verdict_none_for_non_collective_categories():
+    dv = degrade_verdict(
+        AttributionResult(category="no_data", confidence=0.0, summary="")
+    )
+    assert dv.action == "none"
+    health().apply_verdict(dv)  # a none-verdict must not arm anything
+    assert health().start_rung("", "") == ""
+
+
+def test_applied_verdict_pre_arms_route_and_first_call_starts_at_rung():
+    dv = degrade_verdict(analyze_fingerprints(_laggard_tails()))
+    health().apply_verdict(dv)
+    assert health().start_rung("unified_allreduce", "") == "shrink"
+
+    hook = RecordingHook()
+    install_degrade_hook(hook)
+    primary_calls = []
+
+    def primary():
+        primary_calls.append(1)
+        return "healthy"
+
+    c = ResilientCollective(
+        "unified_allreduce", primary, fallback=lambda: "degraded",
+        deadline_ms=5000.0,
+        policy=DegradePolicy(rungs=("retry", "relayout", "shrink"), retries=2),
+    )
+    # the pre-armed route starts the ladder AT the shrink rung: the primary
+    # attempt (known-doomed per the verdict) is never burned
+    assert c() == "degraded"
+    assert primary_calls == []
+    assert hook.calls and hook.calls[0][0] == "unified_allreduce"

@@ -118,6 +118,56 @@ def test_stalled_collective_recovered_through_ladder_with_verdict(tmp_path):
     assert any("culprits=[1]" in l for l in verdict_lines), verdict_lines[:5]
 
 
+def test_link_degrade_absorbed_below_both_rings(tmp_path):
+    """The self-healing collective layer UNDER the layered stack
+    (docs/collectives.md): rank 1's primary collective lane is armed to
+    stall past its deadline every call (``TPURX_FAULT=coll_stall``), the
+    wrapped ``device_max_reduce`` walks retry → re-layout in process, and
+    a shrink-only probe trips the Wrapper-installed DegradeToShrink hook
+    running the real opt-in ShrinkMeshStage as a TARGETED rung.  Neither
+    restart ring fires: both ranks finish at wrapper-iteration 0 and the
+    launcher records zero cycles."""
+    proc = run_layered(
+        tmp_path, "degrade", timeout=240,
+        extra_env={
+            "LAYERED_STEPS": "8",
+            "TPURX_FAULT": "coll_stall",
+            "TPURX_FAULT_RANKS": "1",
+            "TPURX_COLL_DEADLINE_MS": "500",
+            "TPURX_COLL_RETRIES": "1",
+            "TPURX_SHRINK_MESH": "1",
+        },
+    )
+    assert proc.returncode == 0
+    blob = proc.stdout + proc.stderr
+    # absorbed BELOW both rings: no wrapper restart (iteration stays 0),
+    # no launcher cycle
+    assert proc.stdout.count("ret=done@0") == 2
+    assert "worker failure detected" not in proc.stderr
+    assert "cycle=1" not in proc.stdout
+    # the armed rank walked the ladder: deadline trips and degrades; the
+    # healthy rank never degraded
+    marks = {}
+    for line in proc.stdout.splitlines():
+        # worker stdout arrives through the log funnel with an [rN] prefix
+        if "colldeg[" in line:
+            mark = line[line.index("colldeg["):]
+            rank = int(mark.split("[")[1].split("]")[0])
+            kv = dict(p.split("=") for p in mark.split()[1:])
+            marks[rank] = kv
+    assert set(marks) == {0, 1}, blob[-3000:]
+    assert int(marks[1]["degrades"]) >= 1, marks
+    assert int(marks[1]["timeouts"]) >= 1, marks
+    assert int(marks[0]["degrades"]) == 0, marks
+    # the re-layout rung engaged on the armed rank's step collective...
+    assert "collective degrade: op=device_max_reduce" in blob
+    # ...and the shrink probe reached the targeted ShrinkMeshStage through
+    # the degrade hook, completing on the fallback lane
+    assert "degrade-to-shrink: op=shrink_probe" in blob
+    assert "shrink_mesh=released" in blob
+    assert "shrink probe -> shrunk" in proc.stdout
+
+
 def test_outer_fault_escalates_to_launcher(tmp_path):
     proc = run_layered(tmp_path, "outer")
     assert proc.returncode == 0

@@ -106,6 +106,35 @@ def test_soak_smoke_peer_mem_kill_falls_to_disk():
             assert disk_b > 0 and peer_b == 0 and depth == 0, report
 
 
+def test_soak_smoke_link_degrade_no_restart():
+    """The link_degrade fault class: rank 0's primary collective lane is
+    armed to stall past its deadline every call; the resilient wrapper
+    must absorb the bad link IN PROCESS (deadline trip -> retry ->
+    re-layout), every rank must finish, and the launcher ring must record
+    ZERO restart cycles."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "110", "--link-degrade",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["coll_ok"], report
+    # zero pod-wide restarts: the whole point of the degrade ladder
+    assert report["cycles"] == 0, report
+    # the armed rank walked the ladder: deadline trips AND degrades
+    assert report["coll_degrades"] >= 1, report
+    assert report["coll_timeouts"] >= 1, report
+    # the healthy rank never degraded
+    marks = {m[0]: m for m in report["coll_marks"]}
+    assert marks[1][1] == 0, report
+
+
 def test_soak_smoke_store_outage_mid_save():
     """The store-outage-mid-save fault class: targeted store kills inside
     rank 0's store-backed save windows; the unified retry policy must ride

@@ -43,9 +43,9 @@ def rules_of(findings):
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_thirteen_rules_with_stable_ids(self):
+    def test_fourteen_rules_with_stable_ids(self):
         ids = [r.rule_id for r in all_rules()]
-        assert ids == [f"TPURX{n:03d}" for n in range(1, 14)]
+        assert ids == [f"TPURX{n:03d}" for n in range(1, 15)]
 
     def test_every_rule_documents_itself(self):
         for r in all_rules():
@@ -501,6 +501,59 @@ class TestStoreKeyLifecycle:
                 store.append("audit_log", f"{rank},")
         """, rule="TPURX013")
         assert rules_of(fs) == {"TPURX013"}
+
+
+class TestRawCollective:
+    def test_fires_on_allgather_and_lax(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import jax
+            from jax import lax
+            from jax.experimental import multihost_utils
+
+            def f(x, axis):
+                vals = multihost_utils.process_allgather(x)
+                a = lax.pmax(x, axis)
+                b = jax.lax.ppermute(x, axis, perm=[(0, 1)])
+                return vals, a, b
+        """, rule="TPURX014")
+        assert rules_of(fs) == {"TPURX014"}
+        assert len(fs) == 3
+        msgs = " ".join(f.message for f in fs)
+        assert "ResilientCollective" in msgs
+
+    def test_passes_in_wrapper_home_and_quorum_lane(self, tmp_path):
+        # parallel/collectives.py is the sanctioned home for raw collectives
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/parallel/collectives.py", """
+                from jax import lax
+                from jax.experimental import multihost_utils
+
+                def f(x, axis):
+                    return multihost_utils.process_allgather(x), lax.pmax(x, axis)
+            """, rule="TPURX014")
+        # ops/quorum.py's jitted detection lane is allowlisted
+        assert not lint_snippet(tmp_path, "tpu_resiliency/ops/quorum.py", """
+            import jax
+
+            def f(x, axis):
+                return jax.lax.pmax(x, axis)
+        """, rule="TPURX014")
+
+    def test_passes_non_collective_lax_and_out_of_scope(self, tmp_path):
+        # lax math primitives are not collectives
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            from jax import lax
+
+            def f(x):
+                return lax.cumsum(x, axis=0)
+        """, rule="TPURX014")
+        # scripts outside the library may call raw collectives
+        assert not lint_snippet(tmp_path, "benchmarks/x.py", """
+            from jax.experimental import multihost_utils
+
+            def f(x):
+                return multihost_utils.process_allgather(x)
+        """, rule="TPURX014")
 
 
 # ---------------------------------------------------------------------------

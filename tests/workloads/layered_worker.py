@@ -22,6 +22,17 @@ Scenario (env LAYERED_SCENARIO):
            dispatch tail, the trace-analyzer verdict names the in-flight op
            and the lagging rank, and the ring restarts in-process — the
            launcher never sees a failure.
+  degrade — the link fault the SELF-HEALING COLLECTIVE layer absorbs below
+           both restart rings (docs/collectives.md): every step runs a
+           wrapped collective (``device_max_reduce``); the armed rank
+           (``TPURX_FAULT=coll_stall``) has its primary lane stall past the
+           deadline every call, so the wrapper walks retry → re-layout in
+           process and the route-health bias keeps later calls off the dead
+           primary.  Mid-run the armed rank also trips a shrink-only probe
+           through the Wrapper-installed DegradeToShrink hook, running the
+           real (opt-in) ShrinkMeshStage as a TARGETED rung.  Neither the
+           in-process ring nor the launcher ever sees a fault: zero wrapper
+           restarts, zero launcher cycles.
   wedged — rank 1 blocks forever inside a DEVICE program (a jit'd infinite
            while_loop: stuck in PJRT C++ with the GIL released — how a
            collective with a missing participant presents to Python).  The
@@ -110,6 +121,27 @@ def train(call_wrapper=None):
         # at-abort fingerprint feed: the step's collective, at dispatch
         record_dispatch("unified_allreduce")
         time.sleep(0.05)
+        if SCENARIO == "degrade":
+            from tpu_resiliency.parallel import device_max_reduce
+
+            # the step collective, wrapped: the armed rank's primary lane
+            # stalls past deadline and the ladder absorbs it IN PROCESS
+            got = device_max_reduce([float(step)])
+            assert got and got[0] >= float(step), got
+            if RANK == 1 and step == 3:
+                # targeted-shrink probe: a shrink-only ladder walks the
+                # Wrapper-installed DegradeToShrink hook — the real
+                # ShrinkMeshStage (TPURX_SHRINK_MESH=1) as ONE rung, not a
+                # restart; the healthy fallback lane completes the op
+                from tpu_resiliency.parallel import ResilientCollective
+                from tpu_resiliency.parallel.degrade import DegradePolicy
+
+                probe = ResilientCollective(
+                    "shrink_probe", lambda: "primary", axis="ici",
+                    fallback=lambda: "shrunk", deadline_ms=250.0,
+                    policy=DegradePolicy(rungs=("shrink",), retries=0),
+                )
+                print(f"shrink probe -> {probe()}", flush=True)
         if CYCLE == 0 and it == 0 and RANK == 1 and step == 5:
             if SCENARIO == "inner":
                 raise RuntimeError("inner fault: recover in-process")
@@ -146,6 +178,21 @@ def train(call_wrapper=None):
                 spin(jnp.int32(0)).block_until_ready()
         if state.active_rank == 0:
             write_progress_iteration(os.environ["TOY_CKPT"], step)
+    if SCENARIO == "degrade":
+        from tpu_resiliency.telemetry import get_registry
+
+        def metric_sum(name):
+            m = get_registry().get(name)
+            if m is None:
+                return 0.0
+            return sum(v.get("value", 0.0) for _l, v in m._sample_rows())
+
+        print(
+            f"colldeg[{RANK}] "
+            f"degrades={int(metric_sum('tpurx_collective_degrades_total'))} "
+            f"timeouts={int(metric_sum('tpurx_collective_timeouts_total'))}",
+            flush=True,
+        )
     return f"done@{it}"
 
 

@@ -196,7 +196,7 @@ def analyze_fingerprints(
         return AttributionResult(
             category="no_data", confidence=0.2, culprit_ranks=missing,
             summary="no rank published an at-abort fingerprint",
-            should_resume=True,
+            should_resume=True, extra={"op": ""},
         )
     newest = {r: max(t, key=lambda e: e.get("seq", 0)) for r, t in present.items()}
     ops = Counter(e.get("op", "?") for e in newest.values())
@@ -227,6 +227,7 @@ def analyze_fingerprints(
                 f"{sorted(in_op)} are parked in it"
             ),
             evidence=evidence, should_resume=True,
+            extra={"op": wedged_op, "variant": "missing"},
         )
     if divergent:
         return AttributionResult(
@@ -239,6 +240,7 @@ def analyze_fingerprints(
                 "blocked waiting for them"
             ),
             evidence=evidence, should_resume=True,
+            extra={"op": wedged_op, "variant": "divergent"},
         )
     if laggards and len(in_op) > len(laggards):
         return AttributionResult(
@@ -251,6 +253,7 @@ def analyze_fingerprints(
                 "stuck on"
             ),
             evidence=evidence, should_resume=True,
+            extra={"op": wedged_op, "variant": "laggards"},
         )
     return AttributionResult(
         category="collective_stall", confidence=0.5,
@@ -260,4 +263,59 @@ def analyze_fingerprints(
             "— pod-wide stall, no single laggard distinguishable"
         ),
         evidence=evidence, should_resume=True,
+        extra={"op": wedged_op, "variant": "pod_wide"},
     )
+
+
+# -- machine-readable degrade verdict ---------------------------------------
+
+
+@dataclasses.dataclass
+class DegradeVerdict:
+    """The *acting* half of the at-abort verdict: which degrade-ladder rung
+    the self-healing collective layer (``parallel/degrade.py``) should start
+    at for the implicated op.  Consumed on the restart path by
+    ``parallel.health.RouteHealth.apply_verdict`` — the first post-restart
+    call of the named op starts at ``action`` instead of re-proving the
+    dead rungs above it."""
+
+    action: str                 # "retry" | "relayout" | "shrink" | "none"
+    op: str = ""                # DispatchTail op identity
+    axis: str = ""              # implicated mesh axis when known
+    culprit_ranks: list = dataclasses.field(default_factory=list)
+    reason: str = ""
+    confidence: float = 0.0
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_json(cls, raw) -> "DegradeVerdict":
+        return cls(**json.loads(raw if isinstance(raw, str) else raw.decode()))
+
+
+def degrade_verdict(result: AttributionResult) -> DegradeVerdict:
+    """Map an :func:`analyze_fingerprints` result onto a degrade action.
+
+    - ``wedged_collective`` with named culprits → **shrink**: a specific
+      rank/link is implicated; the route needs the targeted teardown, not
+      more deadline burns re-proving it;
+    - ``collective_stall`` (pod-wide, no laggard distinguishable) →
+      **relayout**: nothing to shrink around — re-trace/re-lane and go;
+    - anything else (``no_data``, marker categories, healthy) → **none**.
+    """
+    op = str(result.extra.get("op", "") or "")
+    if result.category == "wedged_collective" and op:
+        return DegradeVerdict(
+            action="shrink", op=op,
+            culprit_ranks=list(result.culprit_ranks),
+            reason=result.summary, confidence=result.confidence,
+        )
+    if result.category == "collective_stall" and op:
+        return DegradeVerdict(
+            action="relayout", op=op,
+            culprit_ranks=list(result.culprit_ranks),
+            reason=result.summary, confidence=result.confidence,
+        )
+    return DegradeVerdict(action="none", op=op, reason=result.summary,
+                          confidence=result.confidence)

@@ -62,6 +62,7 @@ class IciReplication:
         self.axis = axis_name or mesh.axis_names[0]
         self._sync_gen = 0
         self._fns: Dict[int, object] = {}
+        self._coll = None  # lazy ResilientCollective for the shift dispatch
         self._tcp = None  # lazy recovery-path CliqueReplication (DCN TCP)
 
     # -- helpers -----------------------------------------------------------
@@ -93,35 +94,29 @@ class IciReplication:
         return int(agreed)
 
     def _shift_fn(self, shift: int):
-        """Jitted ppermute by `shift` along the process axis (cached)."""
+        """Jitted ppermute by `shift` along the process axis (cached) — the
+        raw ``lax.ppermute`` lives in the sanctioned builder
+        (``parallel.collectives.build_shift_permute``, lint TPURX014)."""
         fn = self._fns.get(shift)
         if fn is not None:
             return fn
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ...parallel.collectives import build_shift_permute
 
-        axis = self.axis
-        n = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
-        axis_size = self.mesh.shape[axis]
-        perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
-
-        def body(x):
-            import jax as _jax
-
-            return _jax.lax.ppermute(x, axis, perm)
-
-        from ...utils.jax_compat import shard_map as shard_map_compat
-
-        smapped = shard_map_compat(
-            body,
-            mesh=self.mesh,
-            in_specs=P(self.axis),
-            out_specs=P(self.axis),
-            check=False,
-        )
-        jitted = jax.jit(smapped)
-        self._fns[shift] = (jitted, NamedSharding(self.mesh, P(self.axis)))
+        self._fns[shift] = build_shift_permute(self.mesh, self.axis, shift)
         return self._fns[shift]
+
+    def _run_shift(self, jitted, arr):
+        """Dispatch one shift through the resilient wrapper: deadlined,
+        telemetered (op ``ici_ppermute``), degradable — a wedged mesh
+        raises ``CollectiveTimeout`` / walks the degrade ladder instead of
+        parking the save thread forever."""
+        if self._coll is None:
+            from ...parallel.collectives import ResilientCollective
+
+            self._coll = ResilientCollective(
+                "ici_ppermute", lambda j, a: j(a), axis=self.axis
+            )
+        return self._coll(jitted, arr)
 
     # -- CliqueReplication-compatible surface ------------------------------
 
@@ -161,7 +156,7 @@ class IciReplication:
                 # assemble the global array from the store, then run the same
                 # collective so the device path is exercised
                 arr = self._assemble_single_process(buf, padded_len, sharding)
-            shifted = jitted(arr)
+            shifted = self._run_shift(jitted, arr)
             mine = self._extract_my_shard(shifted)
             (true_len,) = np.frombuffer(mine[:8].tobytes(), dtype=np.uint64)
             src_rank = (self.rank - shift) % self.world_size

@@ -9,8 +9,12 @@ The cross-rank MAX reduction is the TPU twist: the reference all-reduces a
 tensor over NCCL/Gloo (``timeouts_calc.py:74-91``).  Here the default path is
 a KV-store gather-max over DCN (control plane — always available, even when
 ranks hold no devices), and callers inside a live JAX mesh can pass
-``reduce_fn`` to use an on-device ``pmax`` instead (see
-``tpu_resiliency.parallel.collectives.host_max``).
+``reduce_fn`` from ``tpu_resiliency.parallel.collectives.make_timeouts_reduce_fn``
+for the device lane — a wrapped (deadlined, telemetered, degradable)
+all-gather-max through the self-healing collective layer
+(``docs/collectives.md``); a wedged mesh raises ``CollectiveTimeout``
+instead of hanging the sync, and the store path remains the mesh-free
+fallback.
 """
 
 from __future__ import annotations
@@ -129,9 +133,12 @@ class TimeoutsCalc:
     ) -> None:
         """Key-wise MAX of observed stats across ranks.
 
-        Either pass ``reduce_fn`` (e.g. an on-device pmax wrapper taking and
-        returning the ``{stat_key: value}`` dict) or a store + rank +
-        world_size for the DCN gather-max path.
+        Either pass ``reduce_fn`` (the device lane:
+        ``parallel.collectives.make_timeouts_reduce_fn()`` — a wrapped
+        all-gather-max taking and returning the ``{stat_key: value}``
+        dict, deadlined and degradable like every resiliency-layer
+        collective) or a store + rank + world_size for the DCN
+        gather-max path.
 
         ``namespace`` must be shared by all ranks of one incarnation but
         unique across restarts (e.g. the restart cycle number) — the store

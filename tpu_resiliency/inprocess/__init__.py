@@ -24,11 +24,14 @@ from .abort import (
     AbortQuorumMonitor,
     AbortStage,
     ClearJaxCaches,
+    DegradeToShrink,
     EscalateAbort,
     FingerprintStage,
     ShrinkMeshStage,
     StageResult,
     default_ladder,
+    get_degrade_hook,
+    install_degrade_hook,
 )
 from .attribution import Interruption, InterruptionRecord
 from .fingerprint import DispatchTail, record_dispatch, snapshot_tail
@@ -78,6 +81,9 @@ __all__ = [
     "AbortPeerExchange",
     "AbortQuorumMonitor",
     "ClearJaxCaches",
+    "DegradeToShrink",
+    "install_degrade_hook",
+    "get_degrade_hook",
     "default_ladder",
     "DispatchTail",
     "record_dispatch",

@@ -410,6 +410,56 @@ class ClearJaxCaches(AbortStage):
         return None
 
 
+class DegradeToShrink:
+    """Targeted mesh-shrink entry point for the collective degrade ladder.
+
+    The self-healing collective layer (``parallel/degrade.py``) reaches its
+    bottom rung when retry and re-layout both failed: the implicated link
+    needs the real teardown — distributed client + backends — that
+    :class:`ShrinkMeshStage` owns.  This hook runs *only* the shrink rung
+    (plus any stages the caller composed into ``ladder``), through the
+    ladder machinery so the stage deadline / abandoned-worker / outcome
+    accounting applies — a single collective's route is rebuilt without
+    tripping the full restart ladder or the pod.
+
+    The in-process :class:`~tpu_resiliency.inprocess.wrap.Wrapper` installs
+    one bound to a dedicated shrink-only ladder at build time
+    (:func:`install_degrade_hook`); standalone processes get a bare
+    fallback from ``parallel/degrade.py``.
+    """
+
+    def __init__(self, ladder: AbortLadder):
+        self.ladder = ladder
+        self.trips = 0
+
+    def __call__(self, op: str = "", axis: str = "",
+                 culprits: tuple = ()) -> str:
+        self.trips += 1
+        log.warning(
+            "degrade-to-shrink: op=%s axis=%s culprits=%s — running "
+            "targeted shrink rung", op or "?", axis or "?", list(culprits),
+        )
+        self.ladder(None)
+        return self.ladder.summary()
+
+
+_degrade_hook: Optional[DegradeToShrink] = None
+_degrade_hook_lock = threading.Lock()
+
+
+def install_degrade_hook(hook: Optional[DegradeToShrink]) -> None:
+    """Publish the process's targeted-shrink hook (``None`` uninstalls).
+    Latest install wins: the hook belongs to the live wrapper."""
+    global _degrade_hook
+    with _degrade_hook_lock:
+        _degrade_hook = hook
+
+
+def get_degrade_hook() -> Optional[DegradeToShrink]:
+    with _degrade_hook_lock:
+        return _degrade_hook
+
+
 def default_ladder(ops=None, rank: Optional[int] = None,
                    iteration_fn: Optional[Callable[[], int]] = None,
                    *extra_stages) -> AbortLadder:

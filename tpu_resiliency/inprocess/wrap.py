@@ -28,7 +28,14 @@ from ..store.client import StoreClient, StoreError, store_from_env
 from ..telemetry import counter, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
-from .abort import AbortLadder, FingerprintStage, ShrinkMeshStage, as_stage
+from .abort import (
+    AbortLadder,
+    DegradeToShrink,
+    FingerprintStage,
+    ShrinkMeshStage,
+    as_stage,
+    install_degrade_hook,
+)
 from .attribution import Interruption, InterruptionRecord
 from .fingerprint import DispatchTail, install_tail, snapshot_tail
 from .exceptions import HealthCheckError, RankShouldRestart, RestartAbort
@@ -572,6 +579,13 @@ class CallWrapper:
         fp = FingerprintStage(
             self.ops, self.state.initial_rank, lambda: self.state.iteration
         )
+        # targeted-shrink entry for the collective degrade ladder: a wrapped
+        # collective that exhausted retry+relayout trips ONLY the shrink
+        # rung (per-stage deadline and outcome accounting intact), not the
+        # full restart ladder — parallel/degrade.py fetches this hook
+        install_degrade_hook(
+            DegradeToShrink(AbortLadder(ShrinkMeshStage(), name="degrade"))
+        )
         user = self.w.abort
         if isinstance(user, AbortLadder):
             bound = False
@@ -613,13 +627,28 @@ class CallWrapper:
                 tails.setdefault(r, [])
             if not any(tails.values()):
                 return
-            from ..attribution.trace_analyzer import analyze_fingerprints
+            from ..attribution.trace_analyzer import (
+                analyze_fingerprints,
+                degrade_verdict,
+            )
 
             verdict = analyze_fingerprints(tails)
             log.warning(
                 "abort fingerprint verdict: category=%s culprits=%s — %s",
                 verdict.category, verdict.culprit_ranks, verdict.summary,
             )
+            # machine-readable half: pre-arm the implicated collective's
+            # route so the first post-restart call starts at the verdict's
+            # degrade rung instead of re-burning its deadline
+            dv = degrade_verdict(verdict)
+            if dv.action != "none":
+                log.warning(
+                    "abort degrade verdict: action=%s op=%s axis=%s — %s",
+                    dv.action, dv.op, dv.axis or "-", dv.reason,
+                )
+                from ..parallel.health import health
+
+                health().apply_verdict(dv)
         except Exception:  # noqa: BLE001 - attribution never blocks recovery
             log.exception("fingerprint verdict failed")
 

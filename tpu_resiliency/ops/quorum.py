@@ -730,6 +730,7 @@ class FusedStepQuorum:
         self._jax = jax
         self._last_beat_ns = now_stamp_ns()
         self._pending = None
+        self._readback = None  # lazy ResilientCollective (parallel layer)
         self.last_max_age_ms: Optional[float] = None
         self.last_stale_device: Optional[int] = None
         self.trip_count = 0
@@ -782,13 +783,63 @@ class FusedStepQuorum:
             previous, self._pending = self._pending, packed
             if previous is not None:
                 # materialize LAST step's already-dispatched reduce (async
-                # dispatch means this is usually a completed value)
-                self._check(int(previous))
+                # dispatch means this is usually a completed value) — the
+                # host readback is THE blockable point of the fused lane,
+                # so it rides the resilient-collective deadline lane: a
+                # wedged fabric trips CollectiveTimeout (folded into the
+                # staleness path below) instead of wedging the step thread
+                self._materialize_check(previous)
             return out
 
         run.check_now = self.check_now
         run.quorum = self
         return run
+
+    def _materialize_check(self, packed_arr) -> float:
+        rc = self._readback
+        if rc is None:
+            # lazy: parallel.collectives imports this module (stamp/tripwire
+            # machinery), so the wrapper must be built at call time
+            from ..parallel.collectives import ResilientCollective
+            from ..parallel.degrade import DegradePolicy
+
+            budget = (
+                max(self.budget_ms * 4.0, 50.0)
+                if math.isfinite(self.budget_ms) else 0.0
+            )
+            rc = self._readback = ResilientCollective(
+                "fused_quorum_readback",
+                lambda p: int(p),
+                axis=self.axis,
+                deadline_ms=budget,  # 0 (budget inf) = inline fast path
+                # retry/relayout cannot help a readback: the value either
+                # materializes or the fabric is wedged — fail fast into the
+                # staleness trip below
+                policy=DegradePolicy(rungs=(), retries=0),
+            )
+        from ..parallel.deadline import CollectiveTimeout
+
+        try:
+            value = rc(packed_arr)
+        except CollectiveTimeout:
+            # the readback itself wedged: that IS the staleness signal —
+            # report the saturated age (magnitude lost, ordering correct)
+            self.trip_count += 1
+            self.last_max_age_ms = AGE_CAP_MS
+            self.last_stale_device = None
+            _DETECT_NS.labels("fused").observe(int(_AGE_CAP_NS))
+            if self.on_stale is not None:
+                try:
+                    self.on_stale(AGE_CAP_MS, None)
+                except Exception:  # noqa: BLE001
+                    log.exception("fused-quorum on_stale failed")
+            else:
+                log.error(
+                    "fused quorum: readback wedged past %.0fms deadline "
+                    "(axis %s)", rc.budget_ms(), self.axis,
+                )
+            return AGE_CAP_MS
+        return self._check(value)
 
     def check_now(self) -> Optional[float]:
         """Materialize and check the in-flight packed result (end-of-loop
@@ -796,7 +847,7 @@ class FusedStepQuorum:
         if self._pending is None:
             return None
         pending, self._pending = self._pending, None
-        return self._check(int(pending))
+        return self._materialize_check(pending)
 
     def _check(self, packed: int) -> float:
         if self.identify:

@@ -1,13 +1,42 @@
-"""Mesh and collective helpers used by the resiliency layer and workloads."""
+"""Mesh and collective helpers used by the resiliency layer and workloads.
+
+The collective surface is the self-healing wrapper layer
+(``docs/collectives.md``): :class:`ResilientCollective` deadlines,
+telemeters, and degrades every resiliency-layer collective; raw
+``multihost_utils``/``lax.p*`` calls outside this package are banned by
+lint rule TPURX014.
+"""
 
 from .mesh import make_mesh, mesh_axis_sizes
-from .collectives import device_max_reduce, make_timeouts_reduce_fn
+from .collectives import (
+    ResilientCollective,
+    build_shift_permute,
+    device_max_reduce,
+    instrument_dispatch,
+    make_timeouts_reduce_fn,
+    observe_latency_ns,
+    wrap_collective,
+)
+from .deadline import CollectiveTimeout, DeadlineLane, shared_lane
+from .degrade import DegradePolicy
+from .health import RouteHealth, health
 from .distributed import init_distributed
 
 __all__ = [
     "make_mesh",
     "mesh_axis_sizes",
+    "ResilientCollective",
+    "CollectiveTimeout",
+    "DeadlineLane",
+    "DegradePolicy",
+    "RouteHealth",
+    "build_shift_permute",
     "device_max_reduce",
+    "health",
+    "instrument_dispatch",
     "make_timeouts_reduce_fn",
+    "observe_latency_ns",
+    "shared_lane",
+    "wrap_collective",
     "init_distributed",
 ]

@@ -353,11 +353,11 @@ class CompletionWatcher:
             self._thread.start()
         return self
 
-    def submit(self, op_idx: int, t0: float, leaf) -> None:
+    def submit(self, op_idx: int, t0: float, leaf, label: str = "") -> None:
         with self._inflight_lock:
             self._inflight += 1
         try:
-            self._q.put_nowait((op_idx, t0, leaf))
+            self._q.put_nowait((op_idx, t0, leaf, label))
         except queue.Full:
             with self._inflight_lock:
                 self._inflight -= 1
@@ -370,14 +370,22 @@ class CompletionWatcher:
     def _loop(self) -> None:
         import jax
 
+        from ..parallel.collectives import observe_latency_ns
+
         while not self._stop.is_set():
             try:
-                op_idx, t0, leaf = self._q.get(timeout=0.2)
+                op_idx, t0, leaf, label = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
             try:
                 jax.block_until_ready(leaf)
-                self.arena.push(op_idx, time.perf_counter() - t0)
+                elapsed = time.perf_counter() - t0
+                self.arena.push(op_idx, elapsed)
+                if label:
+                    # completion half of the collective-plane choke point:
+                    # the same latency family wrapped collectives feed, op
+                    # names shared with the dispatch-tail vocabulary
+                    observe_latency_ns(label, int(elapsed * 1e9))
             except Exception:  # noqa: BLE001 — a failed fetch ends the step, not us
                 self.arena.add_drop(op_idx)
             finally:
@@ -431,12 +439,14 @@ class OpCollector:
         label = name or getattr(fn, "__name__", repr(fn))
         op_idx = self.arena.intern(label)
 
-        from ..inprocess.fingerprint import record_dispatch
+        from ..parallel.collectives import instrument_dispatch
 
         def collected(*args, **kwargs):
-            # at-abort fingerprint feed: name + dispatch stamp into the
-            # rank's dispatch tail (µs; read post-mortem when wedged)
-            record_dispatch(label)
+            # the collective-plane instrumentation choke point: name +
+            # dispatch stamp into the rank's dispatch tail (µs; read
+            # post-mortem when wedged) — one vocabulary for the at-abort
+            # fingerprint AND the live latency histograms
+            instrument_dispatch(label)
             profiling = self._profile_due()
             if profiling:
                 return self._profiled_call(fn, label, args, kwargs)
@@ -444,7 +454,7 @@ class OpCollector:
             out = fn(*args, **kwargs)
             leaf = _first_array_leaf(out)
             if leaf is not None:
-                self.watcher.submit(op_idx, t0, leaf)
+                self.watcher.submit(op_idx, t0, leaf, label=label)
             return out
 
         collected.__name__ = f"op_collected[{label}]"

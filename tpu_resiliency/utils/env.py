@@ -374,6 +374,23 @@ SANITIZE_WITNESS_PATH = Knob(
     "%p = pid); feed it back with 'tpurx-lint --witness <file>' to "
     "confirm or prune static TPURX011 cycles.", group="health")
 
+# -- collectives ------------------------------------------------------------
+COLL_DEADLINE_MS = Knob(
+    "TPURX_COLL_DEADLINE_MS", float, 30000.0,
+    "Default per-op deadline for wrapped resiliency-layer collectives "
+    "(ResilientCollective); <=0 disables deadlining (inline fast path).",
+    group="collectives")
+COLL_RETRIES = Knob(
+    "TPURX_COLL_RETRIES", int, 2,
+    "Bounded retry budget of the collective degrade ladder's first rung "
+    "(re-attempts of the primary lane after a CollectiveTimeout).",
+    group="collectives")
+COLL_DEGRADE = Knob(
+    "TPURX_COLL_DEGRADE", str, "retry,relayout,shrink",
+    "Ordered degrade-ladder composition for wrapped collectives: "
+    "comma-separated rungs from {retry, relayout, shrink} (empty string "
+    "= fail fast on the first CollectiveTimeout).", group="collectives")
+
 # -- attribution / LLM ------------------------------------------------------
 LLM_BASE_URL = Knob(
     "TPURX_LLM_BASE_URL", str, "",
@@ -414,6 +431,7 @@ _GROUP_TITLES = {
     "checkpoint": "Checkpointing",
     "telemetry": "Telemetry & logging",
     "health": "Health & fault injection",
+    "collectives": "Collectives",
     "attribution": "Attribution / LLM",
     "bench": "Bench & harness",
     "general": "General",

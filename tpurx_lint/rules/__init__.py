@@ -14,4 +14,5 @@ from . import (  # noqa: F401
     lock_order,
     deadline_prop,
     store_keys,
+    collectives,
 )

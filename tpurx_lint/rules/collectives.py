@@ -1,0 +1,77 @@
+"""TPURX014: resiliency-layer collectives route through the wrapper."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+# jax.lax cross-device collective primitives (the p*/all_* family)
+_COLLECTIVE_LAX = {
+    "psum",
+    "pmax",
+    "pmin",
+    "pmean",
+    "ppermute",
+    "pshuffle",
+    "psum_scatter",
+    "pbroadcast",
+    "all_gather",
+    "all_to_all",
+}
+
+
+def _is_lax_base(base: ast.expr) -> bool:
+    if isinstance(base, ast.Name):
+        return base.id in ("lax", "_lax")
+    if isinstance(base, ast.Attribute):
+        return base.attr == "lax"
+    return False
+
+
+@register
+class RawCollectiveRule(Rule):
+    rule_id = "TPURX014"
+    name = "raw-collective"
+    rationale = (
+        "A raw multihost_utils.process_allgather / lax.p* collective has no "
+        "deadline, no per-op telemetry, and no degrade path — a wedged link "
+        "parks the host thread until the pod-wide restart ladder fires.  "
+        "Resiliency-layer collectives go through "
+        "parallel.collectives.ResilientCollective (or the sanctioned "
+        "builders in that module), which deadlines the op, records "
+        "tpurx_collective_* telemetry under the DispatchTail op "
+        "vocabulary, and degrades retry -> re-layout -> targeted shrink "
+        "instead of wedging."
+    )
+    scope = ("tpu_resiliency/",)
+    exclude = (
+        # the sanctioned home: the wrapper API + raw-collective builders
+        "tpu_resiliency/parallel/collectives.py",
+        # the jitted detection lane: the fused quorum reduce is ITSELF the
+        # deadline mechanism (a stale pmax IS the signal), and its host
+        # readback already rides the wrapper (FusedStepQuorum)
+        "tpu_resiliency/ops/quorum.py",
+    )
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            if attr == "process_allgather":
+                yield pf.finding(
+                    self.rule_id, node,
+                    "raw multihost_utils.process_allgather — route the "
+                    "collective through parallel.collectives "
+                    "(ResilientCollective)",
+                )
+            elif attr in _COLLECTIVE_LAX and _is_lax_base(node.func.value):
+                yield pf.finding(
+                    self.rule_id, node,
+                    f"raw lax.{attr} collective outside parallel/ — route "
+                    "it through parallel.collectives (ResilientCollective "
+                    "or a sanctioned builder)",
+                )
