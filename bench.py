@@ -375,6 +375,9 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "tm_store_shard_ops", "tm_store_shard_failovers", "tm_tree_rounds",
         "tm_ckpt_saves", "tm_ckpt_stage_mb", "tm_restarts",
         "tm_restart_p50_ms", "tm_monitor_trips", "tm_metric_inc_ns",
+        "policy_goodput_gain", "policy_adaptive_goodput",
+        "policy_best_fixed_goodput", "policy_trial_gains",
+        "policy_retunes", "policy_hang_start_rung", "policy_ok",
     ):
         if key in partial:
             line[key] = partial[key]
@@ -1432,6 +1435,30 @@ def bench_rendezvous_10k(time_left_fn) -> dict:
         return rendezvous_10k_sweep(shards=4, ranks=ranks, native=False)
 
 
+def bench_policy_goodput() -> dict:
+    """Adaptive-vs-best-fixed goodput gate: a deterministic seeded fault
+    schedule with a regime step drives the REAL policy components (the
+    GoodputEstimator's windowed MTBF, the Actuator's clamp + hysteresis +
+    knob override, the RungLedger's start-rung pick) against a swept grid
+    of fixed cadences.  Single-source: the sim lives in
+    benchmarks/bench_policy.py (standalone: ``python
+    benchmarks/bench_policy.py --seed N``).  Gate: mean gain >= 1.1x over
+    the best fixed knob; fully deterministic, so no 1-core waiver needed."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.bench_policy import run as policy_run
+
+    report = policy_run(seed=0xA11CE, trials=3)
+    return {
+        "policy_goodput_gain": report["policy_goodput_gain"],
+        "policy_adaptive_goodput": report["policy_adaptive_goodput"],
+        "policy_best_fixed_goodput": report["policy_best_fixed_goodput"],
+        "policy_trial_gains": report["policy_trial_gains"],
+        "policy_retunes": report["policy_retunes"],
+        "policy_hang_start_rung": report["policy_hang_start_rung"],
+        "policy_ok": report["policy_ok"],
+    }
+
+
 def _telemetry_keys() -> dict:
     """Derive bench keys from the in-process telemetry registry — the same
     series production scrapes from the per-rank exporter, so bench numbers
@@ -1712,6 +1739,14 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional lane, never fatal
                 print(f"bench: rdzv 10k arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 10:
+            try:
+                _PARTIAL.update(bench_policy_goodput())
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: policy goodput arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
     except _ChildDeadline:
         print("bench: child hit its internal deadline — finalizing from "

@@ -106,6 +106,29 @@ total = int(os.environ.get("SOAK_STEPS", "100000"))
 ckpt = os.environ["SOAK_CKPT"]
 rng = random.Random(f"{cycle}:{rank}:{os.getpid()}")
 
+# seeded replay: a pre-drawn per-(rank, step) schedule replaces the RNG
+# draws so two runs (e.g. the adaptive-vs-fixed A/B arms) see the EXACT
+# same injection timeline
+sched_path = os.environ.get("SOAK_FAULT_SCHEDULE", "")
+fired_dir = os.environ.get("SOAK_FAULT_FIRED_DIR", "")
+fault_sched = {}
+if sched_path:
+    import json as json_mod
+    with open(sched_path) as f:
+        fault_sched = json_mod.load(f)["faults"].get(str(rank), {})
+
+
+def claim_fault(step):
+    '''One-shot gate: restarts rewind the loop over already-run steps, so
+    each scheduled injection fires exactly once via an O_EXCL marker.'''
+    try:
+        fd = os.open(os.path.join(fired_dir, f"r{rank}_s{step}"),
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+        return True
+    except OSError:
+        return False
+
 save_store = None
 if save_every and rank == 0:
     from tpu_resiliency.store.client import store_from_env
@@ -173,6 +196,21 @@ def run(call_wrapper=None):
         time.sleep(0.03)
         if save_every and save_store is not None and step and step % save_every == 0:
             store_save(step)
+        if sched_path:
+            kind = fault_sched.get(str(step))
+            if kind and claim_fault(step):
+                print(f"soak[{rank}] {kind} at step {step}", flush=True)
+                if kind == "crash":
+                    os._exit(41)
+                if kind == "hang":
+                    time.sleep(3600)
+                if kind in ("quorum stall", "collective wedge"):
+                    while True:
+                        time.sleep(0.02)
+                raise RuntimeError(f"scheduled exception step {step}")
+            if call_wrapper.state.active_rank == 0:
+                write_progress_iteration(ckpt, step + 1)
+            continue
         r = rng.random()
         if r < p_crash:
             print(f"soak[{rank}] crash at step {step}", flush=True); os._exit(41)
@@ -363,6 +401,196 @@ print(f"soakcoll[{rank}] result=done "
 """
 
 
+WORKLOAD_GOODPUT = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.fault_tolerance.progress_tracker import write_progress_iteration
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper, record_dispatch
+from tpu_resiliency.checkpointing.async_ckpt.checkpointer import SaveScheduler
+from tpu_resiliency.telemetry import get_registry
+
+rank = int(os.environ["TPURX_RANK"])
+cycle = int(os.environ["TPURX_CYCLE"])
+ckpt = os.environ["SOAK_CKPT"]
+step_s = float(os.environ.get("SOAK_STEP_S", "0.02"))
+save_cost_s = float(os.environ.get("SOAK_SAVE_COST_S", "0.4"))
+fixed_interval_s = float(os.environ.get("SOAK_SAVE_INTERVAL_S", "4.0"))
+total = int(os.environ.get("SOAK_STEPS", "100000"))
+with open(os.environ["SOAK_FAULT_SCHEDULE"]) as f:
+    faults = json.load(f)["faults"].get(str(rank), {})
+fired_dir = os.environ["SOAK_FAULT_FIRED_DIR"]
+
+
+def claim_fault(step):
+    try:
+        fd = os.open(os.path.join(fired_dir, f"r{rank}_s{step}"),
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.close(fd)
+        return True
+    except OSError:
+        return False
+
+
+client = RankMonitorClient(); client.init_workload_monitoring()
+
+# the adaptive arm: a per-rank closed loop over this rank's own telemetry
+# — the estimator measures MTBF / C / R from the SAME counters the real
+# stack records (interruptions, save-call latency, restart latency) and
+# retunes TPURX_CKPT_INTERVAL_S through the actuator; the fixed arm runs
+# the identical workload with the policy off
+policy_ctl = None
+if os.environ.get("TPURX_POLICY", "0") == "1":
+    from tpu_resiliency.policy import PolicyController
+    policy_ctl = PolicyController()
+    policy_ctl.start(
+        interval_s=float(os.environ.get("TPURX_POLICY_INTERVAL_S", "2.0")))
+
+scheduler = SaveScheduler(default_interval_s=fixed_interval_s)
+SAVE_NS = get_registry().get("tpurx_ckpt_save_call_ns")
+
+
+@Wrapper(
+    group=f"goodput-c{cycle}",
+    rank_assignment=ShiftRanks(),
+    soft_timeout=3600.0, hard_timeout=7200.0,
+    monitor_thread_interval=0.1,
+    heartbeat_interval=0.2, sibling_timeout=8.0,
+    last_call_wait=0.1,
+    enable_monitor_process=False,
+)
+def run(call_wrapper=None):
+    start = 0
+    if os.path.exists(ckpt):
+        try:
+            start = int(open(ckpt).read().strip() or 0)
+        except ValueError:
+            start = 0
+    for step in range(start, total):
+        call_wrapper.ping()
+        client.send_heartbeat()
+        record_dispatch("goodput_allreduce")
+        time.sleep(step_s)           # the useful work
+        if scheduler.due():          # re-reads TPURX_CKPT_INTERVAL_S
+            t0 = time.monotonic_ns()
+            time.sleep(save_cost_s)  # the checkpoint cost C
+            scheduler.note_saved()
+            if SAVE_NS is not None:
+                SAVE_NS.observe(time.monotonic_ns() - t0)
+            if call_wrapper.state.active_rank == 0:
+                # durable progress == last save: a fault rewinds to here
+                write_progress_iteration(ckpt, step + 1)
+        kind = faults.get(str(step))
+        if kind and claim_fault(step):
+            print(f"soak[{rank}] {kind} at step {step}", flush=True)
+            if kind == "crash":
+                os._exit(41)
+            if kind == "hang":
+                time.sleep(3600)
+            raise RuntimeError(f"scheduled exception step {step}")
+    return "done"
+
+print(f"soak[{rank}] result={run()}", flush=True)
+"""
+
+
+def _gen_fault_schedule(seed, nproc, horizon, probs, shift_at=None,
+                        shift_mult=1.0):
+    """Pre-draw the whole injection timeline: ``probs`` maps fault kind ->
+    per-step probability; from ``shift_at`` on, every probability is
+    multiplied by ``shift_mult`` (the fault-regime step the adaptive
+    policy must chase).  Same seed -> byte-identical schedule."""
+    rng = random.Random(seed)
+    faults: dict = {str(r): {} for r in range(nproc)}
+    for r in range(nproc):
+        for step in range(1, horizon):
+            mult = (
+                shift_mult
+                if shift_at is not None and step >= shift_at
+                else 1.0
+            )
+            draw = rng.random()
+            for kind, p_kind in probs.items():
+                if draw < p_kind * mult:
+                    faults[str(r)][str(step)] = kind
+                    break
+                draw -= p_kind * mult
+    return {
+        "seed": seed,
+        "nproc": nproc,
+        "horizon": horizon,
+        "shift_at": shift_at,
+        "shift_mult": shift_mult,
+        "faults": faults,
+    }
+
+
+def _run_fault_shift_ab(args) -> None:
+    """Adaptive-vs-fixed goodput A/B: both arms replay ONE seeded fault
+    schedule (same injection timeline) for the same wall time; goodput is
+    durably-saved progress.  Reports ``policy_goodput_gain`` =
+    adaptive / fixed, gated at 1.1x (waived on 1-core hosts, where two
+    gangs + monitors thrash a single CPU)."""
+    workdir = tempfile.mkdtemp(prefix="tpurx-soak-ab-")
+    sched_path = args.fault_schedule
+    if sched_path is None:
+        seed = args.fault_seed if args.fault_seed is not None else 0x600D
+        step_s = 0.02
+        horizon = max(400, int(args.seconds / step_s) * 2)
+        sched = _gen_fault_schedule(
+            seed, args.nproc, horizon, {"exception": 0.004},
+            shift_at=horizon // 4, shift_mult=6.0,
+        )
+        sched_path = os.path.join(workdir, "fault_schedule.json")
+        with open(sched_path, "w") as f:
+            json.dump(sched, f)
+    arms: dict = {}
+    for arm in ("fixed", "adaptive"):
+        cmd = [
+            sys.executable, os.path.abspath(__file__),
+            "--seconds", str(args.seconds),
+            "--nproc", str(args.nproc),
+            "--fault-schedule", os.path.abspath(sched_path),
+            "--goodput-arm", arm,
+        ]
+        env = dict(os.environ)
+        env.update({
+            "TPURX_POLICY": "1" if arm == "adaptive" else "0",
+            "TPURX_POLICY_INTERVAL_S": "2.0",
+            # the Young/Daly optimum here lives in single-digit seconds;
+            # production clamp floors would pin the controller
+            "TPURX_POLICY_CADENCE_MIN_S": "0.5",
+            "TPURX_POLICY_CADENCE_MAX_S": "60.0",
+        })
+        proc = subprocess.run(cmd, cwd=REPO, env=env,
+                              capture_output=True, text=True)
+        last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+        arms[arm] = (
+            json.loads(last[-1]) if last
+            else {"ok": False, "final_progress": 0}
+        )
+        print(f"soak-ab[{arm}]: final={arms[arm].get('final_progress')} "
+              f"ok={arms[arm].get('ok')}", flush=True)
+    fixed_g = max(1, int(arms["fixed"].get("final_progress") or 0))
+    adaptive_g = int(arms["adaptive"].get("final_progress") or 0)
+    gain = adaptive_g / fixed_g
+    waived = (os.cpu_count() or 1) <= 1
+    arms_ok = bool(arms["fixed"].get("ok") and arms["adaptive"].get("ok"))
+    ok = arms_ok and (waived or gain >= 1.1)
+    print(json.dumps({
+        "metric": "soak_fault_shift",
+        "seconds_per_arm": args.seconds,
+        "fault_schedule": os.path.abspath(sched_path),
+        "adaptive_progress": adaptive_g,
+        "fixed_progress": fixed_g,
+        "policy_goodput_gain": round(gain, 3),
+        "policy_gate_waived": waived,
+        "arms_ok": arms_ok,
+        "ok": ok,
+    }))
+    sys.exit(0 if ok else 1)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -498,6 +726,20 @@ def main() -> None:
                         "past its deadline (TPURX_FAULT=coll_stall); the "
                         "wrapper must degrade (retry -> re-layout) and the "
                         "job must finish with ZERO launcher-ring restarts")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="derive a deterministic per-(rank,step) fault "
+                        "schedule from this seed and replay it (each "
+                        "scheduled injection fires exactly once) instead "
+                        "of per-step RNG draws")
+    p.add_argument("--fault-schedule", default=None,
+                   help="replay an exact recorded schedule file "
+                        "(overrides --fault-seed generation)")
+    p.add_argument("--fault-shift", action="store_true",
+                   help="adaptive-vs-fixed goodput A/B under ONE seeded "
+                        "fault schedule whose fault rate steps up "
+                        "mid-run; reports policy_goodput_gain")
+    p.add_argument("--goodput-arm", choices=("adaptive", "fixed"),
+                   default=None, help=argparse.SUPPRESS)  # one A/B arm
     p.add_argument("--nproc", type=int, default=2)
     p.add_argument("--native-store", action="store_true")
     p.add_argument("--chaos-store", action="store_true",
@@ -523,11 +765,16 @@ def main() -> None:
         args.chaos_store = True
         if not args.save_every:
             args.save_every = 40
+    if args.fault_shift:
+        _run_fault_shift_ab(args)
+        return
 
     workdir = tempfile.mkdtemp(prefix="tpurx-soak-")
     wl_path = os.path.join(workdir, "workload.py")
     with open(wl_path, "w") as f:
-        if args.link_degrade:
+        if args.goodput_arm:
+            f.write(WORKLOAD_GOODPUT)
+        elif args.link_degrade:
             f.write(WORKLOAD_COLL)
         elif args.corrupt_blob or args.peer_mem_kill:
             f.write(WORKLOAD_LCKPT)
@@ -562,6 +809,26 @@ def main() -> None:
             "JAX_PLATFORMS": "cpu",
         }
     )
+    sched_path = args.fault_schedule
+    if sched_path is None and (args.fault_seed is not None or args.goodput_arm):
+        sched = _gen_fault_schedule(
+            args.fault_seed if args.fault_seed is not None else 0x600D,
+            args.nproc, 20000,
+            {"exception": args.exc_p, "crash": args.crash_p,
+             "hang": args.hang_p},
+        )
+        sched_path = os.path.join(workdir, "fault_schedule.json")
+        with open(sched_path, "w") as f:
+            json.dump(sched, f)
+    if sched_path is not None:
+        fired = os.path.join(workdir, "fault_fired")
+        os.makedirs(fired, exist_ok=True)
+        env["SOAK_FAULT_SCHEDULE"] = os.path.abspath(sched_path)
+        env["SOAK_FAULT_FIRED_DIR"] = fired
+        with open(sched_path) as f:
+            n_sched = sum(len(v) for v in json.load(f)["faults"].values())
+        print(f"soak: replaying fault schedule {sched_path} "
+              f"({n_sched} scheduled injections)", flush=True)
     if args.corrupt_blob or args.peer_mem_kill:
         env.update({
             "SOAK_CKPT_ROOT": os.path.join(workdir, "lckpt"),

@@ -359,6 +359,56 @@ class TestEnvRegistry:
             home = os.environ.get("HOME")
         """, rule="TPURX010", extra_files=_ENV_FIXTURE)
 
+    def test_fires_on_direct_write(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import os
+            os.environ["TPURX_FOO"] = "1"
+            os.environ.setdefault("TPURX_BAR", "1")
+            os.environ.pop("TPURX_BAZ", None)
+            os.putenv("TPURX_QUX", "1")
+            os.environ.update({"TPURX_QUUX": "1"})
+        """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+        assert len([f for f in fs if "direct os.environ write" in f.message]) \
+            == 5
+
+    def test_policy_package_is_sanctioned_writer(self, tmp_path):
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/policy/actuator.py", """
+                import os
+                os.environ["TPURX_FOO"] = "1"
+            """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+
+    def test_identity_republication_is_exempt(self, tmp_path):
+        # the launcher restamps rank identity after a mesh shrink; children
+        # inherit it through the real environment, so the write is legal
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/inprocess/state.py", """
+                import os
+                os.environ["TPURX_RANK"] = "0"
+                os.environ["TPURX_WORLD_SIZE"] = "4"
+            """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+
+    def test_write_through_constant_idiom_fires(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import os
+            ENV_FOO = "TPURX_FOO"
+            os.environ[ENV_FOO] = "1"
+        """, rule="TPURX010", extra_files=_ENV_FIXTURE)
+        assert len(fs) == 1 and "direct os.environ write" in fs[0].message
+
+    def test_repurposed_exempt_key_loses_waiver(self, tmp_path):
+        # TPURX_RANK declared as a plain tuning knob (not identity-group,
+        # no publisher doc) -> the WRITE_EXEMPT entry no longer qualifies
+        fs = lint_snippet(
+            tmp_path, "tpu_resiliency/utils/env.py", """
+                class Knob:
+                    def __init__(self, name, type, default, doc, group="g"):
+                        self.name = name
+                RANK = Knob("TPURX_RANK", int, 0, "doc", group="tuning")
+            """, rule="TPURX010",
+            extra_files=[("docs/configuration.md", "`TPURX_RANK`\n")])
+        assert any("no longer qualifies" in f.message for f in fs)
+
     def test_undocumented_knob_fails(self, tmp_path):
         fs = lint_snippet(
             tmp_path, "tpu_resiliency/utils/env.py", """

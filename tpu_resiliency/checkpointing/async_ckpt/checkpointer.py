@@ -139,6 +139,43 @@ class _StagingJob:
     cleaned: bool = False
 
 
+class SaveScheduler:
+    """Interval-based save gate that re-reads ``TPURX_CKPT_INTERVAL_S``
+    per step, so a runtime override (the policy controller retuning
+    cadence toward the Young/Daly optimum) takes effect mid-run without
+    restarting the trainer.  ``default_interval_s`` is the cadence when
+    the knob is unset; ``<= 0`` disables time-gating (every ``due()``
+    call answers True)."""
+
+    def __init__(
+        self,
+        default_interval_s: float = 300.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.default_interval_s = float(default_interval_s)
+        self._clock = clock
+        self._last_save_t: Optional[float] = None
+
+    def interval_s(self) -> float:
+        knob = env.CKPT_INTERVAL_S.get()
+        return self.default_interval_s if knob is None else float(knob)
+
+    def due(self, now: Optional[float] = None) -> bool:
+        """True when a save should be issued this step.  Does NOT mark —
+        call :meth:`note_saved` after ``async_save`` actually ran, so a
+        skipped/failed save retries next step."""
+        interval = self.interval_s()
+        if interval <= 0:
+            return True
+        t = self._clock() if now is None else float(now)
+        if self._last_save_t is None:
+            return True
+        return (t - self._last_save_t) >= interval
+
+    def note_saved(self, now: Optional[float] = None) -> None:
+        self._last_save_t = self._clock() if now is None else float(now)
+
+
 class AsyncCheckpointer:
     def __init__(
         self,

@@ -248,8 +248,18 @@ class CliqueReplication:
     ):
         self.exchange = exchange
         self.world_size = world_size
-        self.factor = replication_factor
+        self._floor_factor = replication_factor
         self.jump = replication_jump
+
+    @property
+    def factor(self) -> int:
+        """Effective replication factor, consulted per save: the ctor
+        value is the floor; ``TPURX_LCKPT_REPLICATION`` (normally set by
+        the policy controller ahead of a predicted node failure) can only
+        raise it, clamped to the world size."""
+        knob = env.LCKPT_REPLICATION.get()
+        f = self._floor_factor if knob is None else max(self._floor_factor, int(knob))
+        return min(f, self.world_size)
 
     def members(self) -> List[int]:
         return clique_members(

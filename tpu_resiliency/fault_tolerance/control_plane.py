@@ -29,6 +29,86 @@ from .rendezvous import K_SHUTDOWN, RendezvousHost
 log = get_logger("control_plane")
 
 
+class PolicyClient:
+    """Per-rank side of the adaptive policy loop.
+
+    The job-level controller (hosted by smonsvc, or rank 0) publishes each
+    decision batch under ``policy/decision/latest``; every rank polls that
+    one key and re-applies the published actions locally through the
+    actuator — knob reads on this rank then see the controller's values
+    via the ``utils/env`` runtime-override layer, with no env mutation
+    and no per-rank re-deciding.
+    """
+
+    def __init__(self, store, actuator=None, poll_interval_s: float | None = None):
+        from ..policy import Actuator
+        from ..utils import env
+
+        self.store = store
+        self.actuator = actuator or Actuator()
+        self.poll_interval_s = (
+            env.POLICY_INTERVAL_S.get()
+            if poll_interval_s is None
+            else float(poll_interval_s)
+        )
+        self.applied_seq = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def poll_once(self) -> int:
+        """Apply any decision batch newer than the last applied one;
+        returns the number of actions applied."""
+        from ..policy import K_DECISION_LATEST, decisions_from_json
+
+        raw = self.store.try_get(K_DECISION_LATEST)
+        if raw is None:
+            return 0
+        try:
+            seq, actions = decisions_from_json(raw)
+        except (ValueError, KeyError) as e:
+            log.warning("undecodable policy decision payload: %s", e)
+            return 0
+        if seq <= self.applied_seq:
+            return 0
+        for action in actions:
+            try:
+                self.actuator.apply(action)
+            except (KeyError, ValueError) as e:
+                # a newer controller may publish knobs this rank's build
+                # does not declare — skip them, apply the rest
+                log.warning("skipping unappliable policy action %s: %s",
+                            action, e)
+        self.applied_seq = seq
+        log.info(
+            "applied policy decision batch seq=%d (%d action(s))",
+            seq, len(actions),
+        )
+        return len(actions)
+
+    def start(self) -> "PolicyClient":
+        if self._thread is not None:
+            return self
+
+        def _loop():
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self.poll_once()
+                except StoreError:
+                    pass  # store outage: the next poll retries
+
+        self._thread = threading.Thread(
+            target=_loop, name="tpurx-policy-client", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
 def run(
     host: str,
     port: int,

@@ -25,8 +25,16 @@ import re
 import subprocess
 from typing import List, Optional, Pattern, Sequence
 
+from ..telemetry.registry import counter
 from .base import HealthCheck, HealthCheckResult
-from .window import WindowedErrorCounter
+from .window import HEALTH_SCORE, WindowedErrorCounter
+
+KMSG_FAULTS = counter(
+    "tpurx_kmsg_faults_total",
+    "Kernel log lines matching a fault signature, by class "
+    "(hard = broken hardware, transient = must repeat to exclude).",
+    labels=("class",),
+)
 
 # Hard faults: a single occurrence indicates broken hardware on THIS node —
 # accelerator resets, machine checks, uncorrectable memory errors.  One event
@@ -235,10 +243,20 @@ class KernelLogHealthCheck(HealthCheck):
         self.last_matches = hard_matches + soft_matches
         if hard_matches:
             self._window.record(len(hard_matches))
+            KMSG_FAULTS.labels("hard").inc(len(hard_matches))
         if soft_matches:
             self._soft_window.record(len(soft_matches))
+            KMSG_FAULTS.labels("transient").inc(len(soft_matches))
         hard_total = self._window.count()
         soft_total = self._soft_window.count()
+        HEALTH_SCORE.labels(check=self.name).set(
+            max(
+                self._window.score(self.threshold),
+                self._soft_window.score(self.soft_threshold)
+                if self.soft_patterns
+                else 0.0,
+            )
+        )
         if hard_total >= self.threshold:
             sample = "; ".join(m[:160] for m in hard_matches[:3])
             return HealthCheckResult(

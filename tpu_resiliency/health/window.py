@@ -21,7 +21,19 @@ import time
 from collections import deque
 from typing import Deque, Dict, Optional, Sequence, Tuple
 
+from ..telemetry.registry import gauge
 from .base import HealthCheck, HealthCheckResult
+
+# Windowed fault pressure per check, 0 (quiet) to 1 (at the exclusion
+# threshold) — the per-node failure-risk input of the policy estimator
+# (Guard-style predictive replication), and the first gauge an operator
+# should graph per node.
+HEALTH_SCORE = gauge(
+    "tpurx_health_score",
+    "Windowed fault score per health check: windowed event count over "
+    "the check's exclusion threshold, clamped to 0-1.",
+    labels=("check",),
+)
 
 # carrier_changes is deliberately NOT here: it increments on link-up as well
 # as link-down, so a single planned bounce would double-count; operators who
@@ -48,6 +60,12 @@ class WindowedErrorCounter:
         while self._events and now - self._events[0][0] > self.window_s:
             self._events.popleft()
         return sum(n for _, n in self._events)
+
+    def score(self, threshold: int, now: Optional[float] = None) -> float:
+        """Windowed fault pressure: count over threshold, clamped 0-1."""
+        if threshold <= 0:
+            return 0.0
+        return min(1.0, self.count(now=now) / threshold)
 
 
 class CounterDeltaWindowCheck(HealthCheck):
@@ -102,6 +120,9 @@ class CounterDeltaWindowCheck(HealthCheck):
                     self._window.record(delta, now=now)
                     self._last_deltas[path] = delta
         total = self._window.count(now=now)
+        HEALTH_SCORE.labels(check=self.name).set(
+            self._window.score(self.threshold, now=now)
+        )
         if total >= self.threshold:
             worst = sorted(
                 self._last_deltas.items(), key=lambda kv: -kv[1]

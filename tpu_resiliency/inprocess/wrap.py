@@ -25,7 +25,9 @@ from typing import Any, Callable, Optional
 
 from ..store.barrier import BarrierTimeout
 from ..store.client import StoreClient, StoreError, store_from_env
+from ..policy.ledger import ledger
 from ..telemetry import counter, histogram
+from ..utils import env
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 from .abort import (
@@ -190,6 +192,9 @@ class CallWrapper:
         self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
         # stamp of the last fault, cleared when the restarted fn re-enters
         self._restart_started_ns: Optional[int] = None
+        # (fault_class, rung) of the restart episode in flight; closed into
+        # the policy rung ledger when the restarted fn re-enters
+        self._episode: Optional[tuple] = None
 
     # -- public API for the wrapped fn ------------------------------------
 
@@ -386,10 +391,20 @@ class CallWrapper:
                     state.set_distributed_vars()
                     self.watchdog.ping()
                     if self._restart_started_ns is not None:
-                        _RESTART_NS.observe(
+                        recovery_ns = (
                             time.monotonic_ns() - self._restart_started_ns
                         )
+                        _RESTART_NS.observe(recovery_ns)
                         self._restart_started_ns = None
+                        if self._episode is not None:
+                            # re-entering fn closes the episode: the rung
+                            # that ran recovered this fault class, at this
+                            # measured cost — the policy ledger's input
+                            cls, rung = self._episode
+                            self._episode = None
+                            ledger().record(
+                                cls, rung, True, recovery_ns / 1e9
+                            )
                     record_event(
                         ProfilingEvent.INPROCESS_RESTART_COMPLETED
                         if iteration
@@ -496,14 +511,44 @@ class CallWrapper:
             # the ladder already counted stage outcomes in telemetry; emit
             # them into the profiling stream too so cross-process gates
             # (chaos soak) can assert rung behavior from the JSONL
-            for res in self.ladder.take_results():
+            ladder_results = self.ladder.take_results()
+            for res in ladder_results:
                 record_event(
                     ProfilingEvent.ABORT_STAGE,
                     iteration=iteration, rank=state.initial_rank,
                     stage=res.stage, outcome=res.outcome,
                     duration_ms=round(res.duration_ms, 3),
                 )
+            fault_class = (
+                "exception" if fault_exc is not None else "peer_signal"
+            )
+            # which restart rung this episode is riding: in_process unless
+            # the ladder's shrink rung actually ran
+            rung = (
+                "mesh_shrink"
+                if any(
+                    r.stage == "shrink_mesh" and r.outcome == "released"
+                    for r in ladder_results
+                )
+                else "in_process"
+            )
+            self._episode = (fault_class, rung)
             self._fingerprint_verdict(iteration, survivors)
+            if (
+                env.POLICY.get()
+                and ledger().start_rung(fault_class) == "in_job"
+            ):
+                # the ledger says this fault class historically escalates
+                # anyway: skip the in-process rungs and hand the episode to
+                # the launcher ring (in-job restart) immediately
+                ledger().record(
+                    fault_class, "in_process", False,
+                    (time.monotonic_ns() - self._restart_started_ns) / 1e9,
+                )
+                self._episode = None
+                raise RestartAbort(
+                    f"policy: start rung for {fault_class} is in_job"
+                )
             monitor.stop()
             if sibling:
                 sibling.stop()
@@ -526,6 +571,16 @@ class CallWrapper:
                     w.health_check(state.freeze())
                 phase_t0 = _observe_phase("health_check", phase_t0)
             except HealthCheckError as exc:
+                if self._episode is not None:
+                    # episode escalates out of the process: the in-process
+                    # rung failed for this fault class
+                    cls, rung = self._episode
+                    self._episode = None
+                    ledger().record(
+                        cls, rung, False,
+                        (time.monotonic_ns() - self._restart_started_ns)
+                        / 1e9,
+                    )
                 log.error("rank %s failed restart health check: %s", state.initial_rank, exc)
                 self.ops.mark_terminated(state.initial_rank)
                 self.ops.record_interruption(

@@ -1,0 +1,47 @@
+"""Adaptive resiliency policy engine (ROADMAP item 4).
+
+Closes the loop from the telemetry plane back onto the resiliency knobs:
+the **estimator** turns windowed counter rates into measured MTBF per
+fault class plus checkpoint/recovery costs, the **actuator** applies
+typed, bounded knob changes through the runtime-override layer of
+``utils/env.py`` (never ``os.environ`` — lint rule TPURX010 bans that),
+the **ledger** scores restart/degrade rungs per fault class, and the
+**controller** ticks the loop, journals every decision to the store, and
+exports ``tpurx_policy_*`` metrics.
+
+Job-level hosting lives in ``services/smonsvc.py`` (tree-gathered
+snapshots → decisions published to the store); the per-rank client in
+``fault_tolerance/control_plane.py`` applies published decisions locally.
+"""
+
+from .actuator import Action, Actuator, RUNGS
+from .estimator import (
+    EstimatorInputs,
+    GoodputEstimator,
+    SnapshotFeed,
+    TelemetryFeed,
+    young_daly_interval,
+)
+from .ledger import RungLedger, RungStats, ledger, _reset_ledger_for_tests
+from .controller import (
+    K_DECISION_LATEST,
+    PolicyController,
+    decisions_from_json,
+)
+
+__all__ = [
+    "Action",
+    "Actuator",
+    "RUNGS",
+    "EstimatorInputs",
+    "GoodputEstimator",
+    "SnapshotFeed",
+    "TelemetryFeed",
+    "young_daly_interval",
+    "RungLedger",
+    "RungStats",
+    "ledger",
+    "PolicyController",
+    "K_DECISION_LATEST",
+    "decisions_from_json",
+]

@@ -1,0 +1,178 @@
+"""Typed, bounded policy actions applied through the knob override layer.
+
+Every change the controller can make is an :class:`Action` — a typed
+record of what was changed, to what, and why — applied exclusively via
+``env.set_runtime_override`` (knob reads see controller values without
+env mutation; direct ``os.environ`` writes of ``TPURX_*`` keys outside
+this package are a TPURX010 lint finding).  All actuators are bounded:
+cadence is clamped to ``[TPURX_POLICY_CADENCE_MIN_S,
+TPURX_POLICY_CADENCE_MAX_S]`` and hysteresis-damped
+(``TPURX_POLICY_HYSTERESIS_PCT``), replication to ``[1, max_replication]``,
+rung arms to the known ladder.  An actuator method returns the applied
+:class:`Action`, or ``None`` when damping/no-op suppressed it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+from ..utils import env
+from ..utils.logging import get_logger
+from .ledger import RUNGS, ledger
+
+log = get_logger("policy.actuator")
+
+# collective degrade-ladder compositions the controller may pick between
+DEGRADE_LADDERS = {
+    "full": "retry,relayout,shrink",
+    "skip_retry": "relayout,shrink",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Action:
+    """One applied decision.  ``target`` is a knob name, or
+    ``ledger:<fault_class>`` for rung arms; ``value == ""`` means the
+    override was cleared (revert to the env/declared default)."""
+
+    kind: str
+    target: str
+    value: str
+    reason: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Action":
+        return cls(
+            kind=d.get("kind", ""),
+            target=d.get("target", ""),
+            value=d.get("value", ""),
+            reason=d.get("reason", ""),
+        )
+
+
+class Actuator:
+    """The only sanctioned writer of runtime knob overrides."""
+
+    def __init__(self, max_replication: int = 4):
+        self.max_replication = int(max_replication)
+        self._armed: Dict[str, str] = {}  # fault_class -> rung (no-op filter)
+
+    # -- save cadence ------------------------------------------------------
+
+    @staticmethod
+    def current_cadence_s() -> Optional[float]:
+        return env.CKPT_INTERVAL_S.get()
+
+    def set_cadence(self, interval_s: float, reason: str) -> Optional[Action]:
+        """Retune the save interval toward ``interval_s`` (normally the
+        Young/Daly optimum), clamped and hysteresis-damped."""
+        lo = env.POLICY_CADENCE_MIN_S.get()
+        hi = env.POLICY_CADENCE_MAX_S.get()
+        if math.isinf(interval_s):
+            target = hi
+        else:
+            target = min(hi, max(lo, float(interval_s)))
+        current = self.current_cadence_s()
+        if current is not None and current > 0:
+            rel_change = abs(target - current) / current
+            if rel_change * 100.0 < env.POLICY_HYSTERESIS_PCT.get():
+                return None
+        value = f"{target:.3f}"
+        env.set_runtime_override(env.CKPT_INTERVAL_S.name, value)
+        action = Action("set_cadence", env.CKPT_INTERVAL_S.name, value, reason)
+        log.info("cadence -> %ss (%s)", value, reason)
+        return action
+
+    # -- replication / delta saves ----------------------------------------
+
+    def set_replication(
+        self, factor: Optional[int], reason: str
+    ) -> Optional[Action]:
+        """Raise/lower the local-checkpoint replication factor; ``None``
+        clears the override (back to the manager's configured value)."""
+        current = env.LCKPT_REPLICATION.get()
+        if factor is None:
+            if current is None:
+                return None
+            env.clear_runtime_override(env.LCKPT_REPLICATION.name)
+            return Action(
+                "set_replication", env.LCKPT_REPLICATION.name, "", reason
+            )
+        factor = min(self.max_replication, max(1, int(factor)))
+        if current == factor:
+            return None
+        env.set_runtime_override(env.LCKPT_REPLICATION.name, str(factor))
+        log.info("replication -> %d (%s)", factor, reason)
+        return Action(
+            "set_replication", env.LCKPT_REPLICATION.name, str(factor), reason
+        )
+
+    def set_delta(self, on: Optional[bool], reason: str) -> Optional[Action]:
+        """Flip delta saves; ``None`` clears the override."""
+        if on is None:
+            if env.runtime_overrides().get(env.CKPT_DELTA.name) is None:
+                return None
+            env.clear_runtime_override(env.CKPT_DELTA.name)
+            return Action("set_delta", env.CKPT_DELTA.name, "", reason)
+        if env.CKPT_DELTA.get() == bool(on):
+            return None
+        value = "1" if on else "0"
+        env.set_runtime_override(env.CKPT_DELTA.name, value)
+        log.info("delta saves -> %s (%s)", value, reason)
+        return Action("set_delta", env.CKPT_DELTA.name, value, reason)
+
+    # -- restart / degrade rungs ------------------------------------------
+
+    def set_start_rung(
+        self, fault_class: str, rung: str, reason: str
+    ) -> Optional[Action]:
+        """Arm the restart ladder's starting rung for one fault class;
+        arming ``mesh_shrink`` also enables the opt-in ShrinkMeshStage."""
+        if rung not in RUNGS:
+            raise ValueError(f"unknown restart rung {rung!r} (know {RUNGS})")
+        if self._armed.get(fault_class) == rung:
+            return None
+        ledger().arm(fault_class, rung, reason)
+        self._armed[fault_class] = rung
+        if rung == "mesh_shrink" and not env.SHRINK_MESH.get():
+            env.set_runtime_override(env.SHRINK_MESH.name, "1")
+        return Action("set_start_rung", f"ledger:{fault_class}", rung, reason)
+
+    def set_degrade_ladder(self, name: str, reason: str) -> Optional[Action]:
+        """Pick the wrapped-collective degrade composition (e.g. skip the
+        retry rung when timeouts historically escalate anyway)."""
+        composition = DEGRADE_LADDERS.get(name)
+        if composition is None:
+            raise ValueError(
+                f"unknown degrade ladder {name!r} (know {sorted(DEGRADE_LADDERS)})"
+            )
+        if env.COLL_DEGRADE.get() == composition:
+            return None
+        env.set_runtime_override(env.COLL_DEGRADE.name, composition)
+        log.info("collective degrade ladder -> %s (%s)", composition, reason)
+        return Action(
+            "set_degrade_ladder", env.COLL_DEGRADE.name, composition, reason
+        )
+
+    # -- remote application ------------------------------------------------
+
+    def apply(self, action: Action) -> None:
+        """Re-apply a journaled/published action locally (per-rank client
+        path) — no re-deciding, no damping; the deciding controller
+        already bounded the value."""
+        if action.target.startswith("ledger:"):
+            fault_class = action.target.split(":", 1)[1]
+            ledger().arm(fault_class, action.value, action.reason)
+            self._armed[fault_class] = action.value
+            if action.value == "mesh_shrink":
+                env.set_runtime_override(env.SHRINK_MESH.name, "1")
+            return
+        if action.value == "":
+            env.clear_runtime_override(action.target)
+        else:
+            env.set_runtime_override(action.target, action.value)

@@ -1,0 +1,266 @@
+"""The policy control loop: estimate → decide → actuate → journal.
+
+One :meth:`PolicyController.tick` refreshes the estimator from its feed,
+derives bounded actions (cadence toward Young/Daly, risk-driven
+replication/delta, per-fault-class rung arms), applies them through the
+actuator, and journals every applied action to the store:
+
+- ``policy/journal/<seq>`` — one JSON record per decision (bounded: the
+  controller deletes entries ``journal_keep`` behind the head, the same
+  consumed-key discipline as ``store/tree.py``);
+- ``policy/decision/latest`` — the full latest decision batch +
+  estimator snapshot, the single key per-rank clients poll.
+
+Deployment shapes: **job-level** — smonsvc hosts a controller over a
+``SnapshotFeed`` of tree-gathered rank snapshots and publishes decisions
+to the store; **per-rank** — ``fault_tolerance.control_plane.PolicyClient``
+polls ``policy/decision/latest`` and re-applies the published actions
+locally through the same actuator.  A rank can also run a standalone
+controller over its own ``TelemetryFeed`` (single-process jobs, tests).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..telemetry.registry import counter, gauge
+from ..utils import env
+from ..utils.logging import get_logger
+from .actuator import Action, Actuator
+from .estimator import GoodputEstimator, TelemetryFeed
+from .ledger import ledger
+
+log = get_logger("policy.controller")
+
+K_JOURNAL_PREFIX = "policy/journal"
+K_DECISION_LATEST = "policy/decision/latest"
+
+_TICKS = counter(
+    "tpurx_policy_ticks_total", "Policy control-loop ticks executed.")
+_DECISIONS = counter(
+    "tpurx_policy_decisions_total",
+    "Applied policy decisions by action kind.", labels=("action",))
+_TAU_OPT = gauge(
+    "tpurx_policy_tau_opt_s",
+    "Young/Daly optimal save interval for the measured regime (0 until "
+    "a fault rate is observed).")
+_CADENCE = gauge(
+    "tpurx_policy_cadence_s", "Save interval currently set by the policy.")
+_MTBF = gauge(
+    "tpurx_policy_mtbf_s",
+    "Measured MTBF per fault class (0 = no faults observed).",
+    labels=("fault_class",))
+_NODE_RISK = gauge(
+    "tpurx_policy_node_risk", "Worst per-node failure risk score (0-1).")
+_GOODPUT_EST = gauge(
+    "tpurx_policy_goodput_est",
+    "Modeled goodput fraction at the currently-set cadence.")
+
+# hysteresis band: risk actions arm at the threshold, relax at half of it
+_RISK_RELAX_FRACTION = 0.5
+
+# collective timeout rate (events/s normalized by the window) above which
+# the degrade ladder skips the retry rung
+_COLL_SKIP_RETRY_EVENTS_PER_WINDOW = 2.0
+
+
+class PolicyController:
+    def __init__(
+        self,
+        feed=None,
+        estimator: Optional[GoodputEstimator] = None,
+        actuator: Optional[Actuator] = None,
+        store=None,
+        journal_keep: int = 256,
+    ):
+        self.feed = feed or TelemetryFeed()
+        self.estimator = estimator or GoodputEstimator()
+        self.actuator = actuator or Actuator()
+        self.store = store
+        self.journal_keep = int(journal_keep)
+        self.seq = 0
+        self.journal: List[dict] = []  # in-memory tail (tests, /status)
+        self._risk_armed = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one tick ----------------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> List[Action]:
+        t = time.monotonic() if now is None else float(now)
+        self.estimator.update(self.feed.collect(), now=t)
+        _TICKS.inc()
+        actions: List[Action] = []
+        actions += self._decide_cadence()
+        actions += self._decide_risk()
+        actions += self._decide_rungs()
+        self._export_gauges()
+        if actions:
+            self._journal(actions)
+        return actions
+
+    def _decide_cadence(self) -> List[Action]:
+        est = self.estimator
+        tau = est.tau_opt()
+        if math.isinf(tau):
+            # no measured faults: leave the configured cadence alone
+            return []
+        mtbf = est.mtbf_s()
+        c, _ = est.costs()
+        action = self.actuator.set_cadence(
+            tau,
+            f"young-daly: mtbf={mtbf:.1f}s ckpt_cost={c:.2f}s "
+            f"dominant={est.dominant_class()}",
+        )
+        return [action] if action else []
+
+    def _decide_risk(self) -> List[Action]:
+        est = self.estimator
+        threshold = env.POLICY_RISK_THRESHOLD.get()
+        actions: List[Action] = []
+        at_risk = est.node_risk >= threshold or est.kmsg_hard_rate > 0
+        if at_risk:
+            reason = (
+                f"node risk {est.node_risk:.2f} >= {threshold:.2f}"
+                if est.node_risk >= threshold
+                else f"kmsg hard fault rate {est.kmsg_hard_rate:.4f}/s"
+            )
+            base = env.LCKPT_REPLICATION.get() or 2
+            for act in (
+                self.actuator.set_replication(max(base, 3), reason),
+                self.actuator.set_delta(True, reason),
+            ):
+                if act:
+                    actions.append(act)
+            self._risk_armed = True
+        elif (
+            self._risk_armed
+            and est.node_risk < threshold * _RISK_RELAX_FRACTION
+            and est.kmsg_hard_rate == 0
+        ):
+            reason = f"node risk cleared ({est.node_risk:.2f})"
+            for act in (
+                self.actuator.set_replication(None, reason),
+                self.actuator.set_delta(None, reason),
+            ):
+                if act:
+                    actions.append(act)
+            self._risk_armed = False
+        return actions
+
+    def _decide_rungs(self) -> List[Action]:
+        est = self.estimator
+        led = ledger()
+        actions: List[Action] = []
+        for cls, rate in est.rate_per_class.items():
+            if rate <= 0 or cls == "collective":
+                continue
+            rung = led.pick_start_rung(cls)
+            act = self.actuator.set_start_rung(
+                cls, rung,
+                f"ledger expected-cost pick over {led.episodes(cls)} episodes",
+            )
+            if act:
+                actions.append(act)
+        coll_per_window = (
+            est.rate_per_class.get("collective", 0.0) * est.window_s
+        )
+        name = (
+            "skip_retry"
+            if coll_per_window >= _COLL_SKIP_RETRY_EVENTS_PER_WINDOW
+            else "full"
+        )
+        act = self.actuator.set_degrade_ladder(
+            name, f"collective timeouts {coll_per_window:.1f}/window"
+        )
+        if act:
+            actions.append(act)
+        return actions
+
+    def _export_gauges(self) -> None:
+        est = self.estimator
+        tau = est.tau_opt()
+        _TAU_OPT.set(0.0 if math.isinf(tau) else tau)
+        cadence = self.actuator.current_cadence_s()
+        if cadence:
+            _CADENCE.set(cadence)
+            _GOODPUT_EST.set(est.expected_goodput(cadence))
+        for cls, _rate in est.rate_per_class.items():
+            mtbf = est.mtbf_s(cls)
+            _MTBF.labels(fault_class=cls).set(
+                0.0 if math.isinf(mtbf) else mtbf
+            )
+        _NODE_RISK.set(est.node_risk)
+
+    # -- journal -----------------------------------------------------------
+
+    def _journal(self, actions: List[Action]) -> None:
+        batch = []
+        for action in actions:
+            self.seq += 1
+            record = {"seq": self.seq, "t": time.time(), **action.to_dict()}
+            batch.append(record)
+            self.journal.append(record)
+            _DECISIONS.labels(action=action.kind).inc()
+        del self.journal[: -self.journal_keep]
+        if self.store is None:
+            return
+        try:
+            for record in batch:
+                self.store.set(
+                    f"{K_JOURNAL_PREFIX}/{record['seq']}",
+                    json.dumps(record).encode(),
+                )
+                stale = record["seq"] - self.journal_keep
+                if stale > 0:
+                    self.store.delete(f"{K_JOURNAL_PREFIX}/{stale}")
+            self.store.set(
+                K_DECISION_LATEST,
+                json.dumps(
+                    {
+                        "seq": self.seq,
+                        "actions": [r for r in batch],
+                        "estimator": self.estimator.snapshot(),
+                    }
+                ).encode(),
+            )
+        except Exception as e:  # journal is best-effort: never fail the loop
+            log.warning("policy journal write failed: %s", e)
+
+    # -- hosted loop -------------------------------------------------------
+
+    def start(self, interval_s: Optional[float] = None) -> None:
+        if self._thread is not None:
+            return
+        period = (
+            env.POLICY_INTERVAL_S.get() if interval_s is None else interval_s
+        )
+
+        def _loop():
+            while not self._stop.wait(period):
+                try:
+                    self.tick()
+                except Exception as e:
+                    log.warning("policy tick failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=_loop, name="tpurx-policy", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def decisions_from_json(raw: bytes) -> Tuple[int, List[Action]]:
+    """Parse a ``policy/decision/latest`` payload into (seq, actions)."""
+    payload = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+    actions = [Action.from_dict(d) for d in payload.get("actions", [])]
+    return int(payload.get("seq", 0)), actions

@@ -1,0 +1,339 @@
+"""Goodput estimator: telemetry rates → fault regime → optimal cadence.
+
+The estimator consumes one :class:`EstimatorInputs` observation per
+control-loop tick and maintains:
+
+- **MTBF per fault class** from windowed rates of the restart/interruption
+  counters (``RateWindow`` handles cross-restart counter resets);
+- **checkpoint cost C** (trainer-visible save stall) and **recovery cost
+  R** (fault observed → fn re-entered), EWMA-smoothed;
+- **per-node failure risk** from the health window score and kmsg hard
+  fault rate (Guard-style predictive signal);
+- the **Young/Daly optimum** ``tau_opt = sqrt(2·C·MTBF)`` and a
+  first-order goodput model used to compare candidate cadences:
+
+  ``goodput(tau) ≈ (1 - C/tau) · (1 - (R + tau/2) / MTBF)``
+
+  — the first factor is checkpoint overhead, the second the expected
+  rework + recovery fraction (each failure loses R plus half an interval
+  on average).
+
+Feeds adapt the two deployment shapes: :class:`TelemetryFeed` reads this
+process's registry (per-rank client, unit tests); :class:`SnapshotFeed`
+reduces tree-gathered cross-rank snapshots (job-level loop in smonsvc).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, Mapping, Optional
+
+from ..telemetry.registry import RateWindow, Registry, get_registry
+from ..utils import env
+from ..utils.logging import get_logger
+
+log = get_logger("policy.estimator")
+
+# fault classes the estimator tracks, and the counters that feed them
+FAULT_CLASSES = ("exception", "peer_signal", "hang", "collective")
+
+_EWMA_ALPHA = 0.3
+
+# floors/defaults keeping the model sane before data arrives
+_MIN_MTBF_S = 1.0
+_DEFAULT_CKPT_COST_S = 5.0
+_DEFAULT_RECOVERY_COST_S = 30.0
+
+
+def young_daly_interval(ckpt_cost_s: float, mtbf_s: float) -> float:
+    """The Young/Daly checkpoint-interval optimum ``sqrt(2·C·MTBF)``."""
+    return math.sqrt(2.0 * max(ckpt_cost_s, 0.0) * max(mtbf_s, 0.0))
+
+
+@dataclasses.dataclass
+class EstimatorInputs:
+    """One tick's raw observations (cumulative counts, not rates)."""
+
+    # cumulative interruption/fault counts per class
+    fault_counts: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # trainer-visible checkpoint save cost (s); None = no new data
+    ckpt_cost_s: Optional[float] = None
+    # mean restart recovery latency (s); None = no new data
+    recovery_cost_s: Optional[float] = None
+    # worst per-node failure risk 0-1 (health window + kmsg)
+    node_risk: float = 0.0
+    # cumulative kmsg hard faults (node-death leading indicator)
+    kmsg_hard_total: float = 0.0
+
+
+def _family_sum(
+    reg: Registry, name: str, label_filter: Optional[Mapping[str, str]] = None
+) -> float:
+    """Sum of a counter/gauge family's samples, optionally filtered on a
+    label subset (``value_of`` matches exact label dicts only)."""
+    metric = reg.get(name)
+    if metric is None:
+        return 0.0
+    total = 0.0
+    for labels, value in metric._sample_rows():
+        if label_filter and any(labels.get(k) != v for k, v in label_filter.items()):
+            continue
+        total += value.get("value", 0.0)
+    return total
+
+
+def _family_max(reg: Registry, name: str) -> float:
+    """Max across a gauge family's samples (risk is per-check/per-node:
+    act on the worst)."""
+    metric = reg.get(name)
+    if metric is None:
+        return 0.0
+    worst = 0.0
+    for _labels, value in metric._sample_rows():
+        worst = max(worst, value.get("value", 0.0))
+    return worst
+
+
+def _hist_mean_s(reg: Registry, name: str) -> Optional[float]:
+    """Mean of an ns-valued histogram family, in seconds; None when empty."""
+    metric = reg.get(name)
+    if metric is None:
+        return None
+    total = 0.0
+    count = 0
+    for _labels, value in metric._sample_rows():
+        total += value.get("sum", 0.0)
+        count += value.get("count", 0)
+    if count == 0:
+        return None
+    return total / count / 1e9
+
+
+class TelemetryFeed:
+    """Inputs from this process's metric registry (per-rank shape)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self._reg = registry
+
+    def collect(self) -> EstimatorInputs:
+        reg = self._reg or get_registry()
+        counts = {
+            "exception": _family_sum(
+                reg, "tpurx_inprocess_interruptions_total", {"kind": "exception"}
+            ),
+            "peer_signal": _family_sum(
+                reg, "tpurx_inprocess_interruptions_total", {"kind": "peer_signal"}
+            ),
+            "hang": _family_sum(reg, "tpurx_monitor_trips_total"),
+            "collective": _family_sum(reg, "tpurx_collective_timeouts_total"),
+        }
+        return EstimatorInputs(
+            fault_counts=counts,
+            ckpt_cost_s=_hist_mean_s(reg, "tpurx_ckpt_save_call_ns"),
+            recovery_cost_s=_hist_mean_s(reg, "tpurx_restart_total_latency_ns"),
+            node_risk=_family_max(reg, "tpurx_health_score"),
+            kmsg_hard_total=_family_sum(
+                reg, "tpurx_kmsg_faults_total", {"class": "hard"}
+            ),
+        )
+
+
+class SnapshotFeed:
+    """Inputs reduced from ``{rank: registry_snapshot}`` maps (the
+    ``aggregate.read_latest_snapshots`` feed smonsvc already polls)."""
+
+    def __init__(self, snapshots_fn: Callable[[], Dict[int, dict]]):
+        self._snapshots_fn = snapshots_fn
+
+    @staticmethod
+    def _sum(snapshots: Dict[int, dict], name: str,
+             label_filter: Optional[Mapping[str, str]] = None) -> float:
+        total = 0.0
+        for snap in snapshots.values():
+            fam = snap.get(name)
+            if not fam:
+                continue
+            for sample in fam.get("samples", ()):
+                labels = sample.get("labels", {})
+                if label_filter and any(
+                    labels.get(k) != v for k, v in label_filter.items()
+                ):
+                    continue
+                total += float(sample.get("value", 0.0))
+        return total
+
+    @staticmethod
+    def _hist_mean_s(snapshots: Dict[int, dict], name: str) -> Optional[float]:
+        total, count = 0.0, 0
+        for snap in snapshots.values():
+            fam = snap.get(name)
+            if not fam:
+                continue
+            for sample in fam.get("samples", ()):
+                total += float(sample.get("sum", 0.0))
+                count += int(sample.get("count", 0))
+        if count == 0:
+            return None
+        return total / count / 1e9
+
+    @staticmethod
+    def _max(snapshots: Dict[int, dict], name: str) -> float:
+        worst = 0.0
+        for snap in snapshots.values():
+            fam = snap.get(name)
+            if not fam:
+                continue
+            for sample in fam.get("samples", ()):
+                worst = max(worst, float(sample.get("value", 0.0)))
+        return worst
+
+    def collect(self) -> EstimatorInputs:
+        snaps = self._snapshots_fn() or {}
+        counts = {
+            "exception": self._sum(
+                snaps, "tpurx_inprocess_interruptions_total", {"kind": "exception"}
+            ),
+            "peer_signal": self._sum(
+                snaps, "tpurx_inprocess_interruptions_total", {"kind": "peer_signal"}
+            ),
+            "hang": self._sum(snaps, "tpurx_monitor_trips_total"),
+            "collective": self._sum(snaps, "tpurx_collective_timeouts_total"),
+        }
+        return EstimatorInputs(
+            fault_counts=counts,
+            ckpt_cost_s=self._hist_mean_s(snaps, "tpurx_ckpt_save_call_ns"),
+            recovery_cost_s=self._hist_mean_s(
+                snaps, "tpurx_restart_total_latency_ns"
+            ),
+            # risk is a per-node signal: the job acts on the WORST node
+            node_risk=self._max(snaps, "tpurx_health_score"),
+            kmsg_hard_total=self._sum(
+                snaps, "tpurx_kmsg_faults_total", {"class": "hard"}
+            ),
+        )
+
+
+class GoodputEstimator:
+    """Windowed fault-regime model; one :meth:`update` per control tick."""
+
+    def __init__(self, window_s: Optional[float] = None):
+        self.window_s = (
+            env.POLICY_WINDOW_S.get() if window_s is None else float(window_s)
+        )
+        self._rates: Dict[str, RateWindow] = {
+            cls: RateWindow() for cls in FAULT_CLASSES
+        }
+        self._kmsg_rate = RateWindow()
+        self.rate_per_class: Dict[str, float] = {cls: 0.0 for cls in FAULT_CLASSES}
+        self._seen: Dict[str, bool] = {cls: False for cls in FAULT_CLASSES}
+        self.ckpt_cost_s: Optional[float] = None
+        self.recovery_cost_s: Optional[float] = None
+        self.node_risk = 0.0
+        self.kmsg_hard_rate = 0.0
+        self.updates = 0
+
+    # -- observation -------------------------------------------------------
+
+    def update(self, inputs: EstimatorInputs, now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else float(now)
+        for cls in FAULT_CLASSES:
+            count = float(inputs.fault_counts.get(cls, 0.0))
+            self.rate_per_class[cls] = self._rates[cls].rate(
+                self.window_s, count, now=t
+            )
+            if count > 0:
+                self._seen[cls] = True
+        self.kmsg_hard_rate = self._kmsg_rate.rate(
+            self.window_s, float(inputs.kmsg_hard_total), now=t
+        )
+        if inputs.ckpt_cost_s is not None and inputs.ckpt_cost_s > 0:
+            if self.ckpt_cost_s is None:
+                self.ckpt_cost_s = inputs.ckpt_cost_s
+            else:
+                self.ckpt_cost_s += _EWMA_ALPHA * (
+                    inputs.ckpt_cost_s - self.ckpt_cost_s
+                )
+        if inputs.recovery_cost_s is not None and inputs.recovery_cost_s > 0:
+            if self.recovery_cost_s is None:
+                self.recovery_cost_s = inputs.recovery_cost_s
+            else:
+                self.recovery_cost_s += _EWMA_ALPHA * (
+                    inputs.recovery_cost_s - self.recovery_cost_s
+                )
+        self.node_risk = max(0.0, min(1.0, float(inputs.node_risk)))
+        self.updates += 1
+
+    # -- model -------------------------------------------------------------
+
+    def fault_rate(self) -> float:
+        """Combined fault rate across every class (events/s)."""
+        return sum(self.rate_per_class.values())
+
+    def mtbf_s(self, fault_class: Optional[str] = None) -> float:
+        """Measured MTBF (s).  +inf until a fault has EVER been observed;
+        after that, a quiet window reads as ``MTBF >= window_s`` (a lower
+        bound) so cadence relaxes when the regime calms instead of
+        staying pinned at the last noisy measurement."""
+        if fault_class is not None:
+            rate = self.rate_per_class.get(fault_class, 0.0)
+            seen = self._seen.get(fault_class, False)
+        else:
+            rate = self.fault_rate()
+            seen = any(self._seen.values())
+        if rate <= 0.0:
+            if not seen:
+                return math.inf
+            return max(_MIN_MTBF_S, self.window_s)
+        return max(_MIN_MTBF_S, 1.0 / rate)
+
+    def costs(self) -> tuple:
+        """(C, R) with defaults holding until measurements arrive."""
+        c = self.ckpt_cost_s if self.ckpt_cost_s else _DEFAULT_CKPT_COST_S
+        r = (
+            self.recovery_cost_s
+            if self.recovery_cost_s
+            else _DEFAULT_RECOVERY_COST_S
+        )
+        return c, r
+
+    def tau_opt(self) -> float:
+        """Young/Daly optimal save interval for the measured regime; +inf
+        when no faults have been observed (the clamp bounds it)."""
+        mtbf = self.mtbf_s()
+        if math.isinf(mtbf):
+            return math.inf
+        c, _ = self.costs()
+        return young_daly_interval(c, mtbf)
+
+    def expected_goodput(self, tau_s: float) -> float:
+        """First-order goodput fraction at save interval ``tau_s``."""
+        if tau_s <= 0:
+            return 0.0
+        c, r = self.costs()
+        mtbf = self.mtbf_s()
+        overhead = max(0.0, 1.0 - c / max(tau_s, c))
+        if math.isinf(mtbf):
+            return overhead
+        waste = (r + tau_s / 2.0) / mtbf
+        return max(0.0, overhead * (1.0 - min(1.0, waste)))
+
+    def dominant_class(self) -> Optional[str]:
+        """Fault class with the highest measured rate (None when quiet)."""
+        cls = max(self.rate_per_class, key=lambda c: self.rate_per_class[c])
+        return cls if self.rate_per_class[cls] > 0 else None
+
+    def snapshot(self) -> dict:
+        c, r = self.costs()
+        return {
+            "window_s": self.window_s,
+            "rate_per_class": dict(self.rate_per_class),
+            "mtbf_s": None if math.isinf(self.mtbf_s()) else self.mtbf_s(),
+            "ckpt_cost_s": c,
+            "recovery_cost_s": r,
+            "node_risk": self.node_risk,
+            "kmsg_hard_rate": self.kmsg_hard_rate,
+            "tau_opt_s": None if math.isinf(self.tau_opt()) else self.tau_opt(),
+            "updates": self.updates,
+        }

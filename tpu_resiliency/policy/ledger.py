@@ -1,0 +1,175 @@
+"""Per-fault-class restart-rung success/cost ledger.
+
+Extends the collectives ``RouteHealth`` idea (PR 14) from per-(op, axis)
+link state to the whole restart ladder: every layered-restart episode
+records which rung ultimately recovered the job (``in_process`` —
+abort ladder released and the wrapper re-entered the train fn;
+``mesh_shrink`` — recovery required the shrink rung; ``in_job`` — the
+episode escalated out to a launcher ring restart) plus what it cost in
+wall seconds.  ``pick_start_rung`` then answers "given THIS fault class,
+which rung should the next episode start at" by minimizing expected cost:
+starting low is cheap when it works, but a class that historically
+escalates anyway should skip straight to the rung that actually
+recovers it instead of re-proving the dead rungs above.
+
+State is process-local and advisory, like ``RouteHealth``: it biases the
+starting rung; it never removes escalation paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..utils.logging import get_logger
+
+log = get_logger("policy.ledger")
+
+# the restart ladder, cheapest rung first
+RUNGS = ("in_process", "mesh_shrink", "in_job")
+
+# Laplace prior keeps one lucky/unlucky sample from pinning a rung
+_PRIOR_SUCCESS = 1
+_PRIOR_ATTEMPTS = 2
+
+# assumed cost of a rung with no samples yet (s), per rung — reflects the
+# ladder's cost ordering so an empty ledger picks the top
+_DEFAULT_COST_S = {"in_process": 10.0, "mesh_shrink": 30.0, "in_job": 120.0}
+
+# a class needs this many recorded episodes before its bias leaves the top
+_MIN_EPISODES = 3
+
+
+@dataclasses.dataclass
+class RungStats:
+    attempts: int = 0
+    successes: int = 0
+    total_cost_s: float = 0.0
+
+    @property
+    def success_rate(self) -> float:
+        return (self.successes + _PRIOR_SUCCESS) / (
+            self.attempts + _PRIOR_ATTEMPTS
+        )
+
+    @property
+    def mean_cost_s(self) -> Optional[float]:
+        if self.attempts == 0:
+            return None
+        return self.total_cost_s / self.attempts
+
+
+class RungLedger:
+    """Registry of per-(fault_class, rung) outcome stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: Dict[Tuple[str, str], RungStats] = {}
+        self._armed: Dict[str, Tuple[str, str]] = {}  # class -> (rung, reason)
+
+    def record(
+        self, fault_class: str, rung: str, success: bool, cost_s: float
+    ) -> None:
+        """One restart episode's outcome at ``rung`` for ``fault_class``."""
+        if rung not in RUNGS:
+            raise ValueError(f"unknown restart rung {rung!r} (know {RUNGS})")
+        with self._lock:
+            st = self._stats.setdefault((fault_class, rung), RungStats())
+            st.attempts += 1
+            if success:
+                st.successes += 1
+            st.total_cost_s += max(0.0, float(cost_s))
+
+    def stats(self, fault_class: str, rung: str) -> RungStats:
+        with self._lock:
+            return self._stats.get((fault_class, rung), RungStats())
+
+    def episodes(self, fault_class: str) -> int:
+        with self._lock:
+            return sum(
+                st.attempts
+                for (cls, _), st in self._stats.items()
+                if cls == fault_class
+            )
+
+    # -- rung selection ----------------------------------------------------
+
+    def expected_cost(self, fault_class: str, start_rung: str) -> float:
+        """Expected recovery cost when the ladder starts at ``start_rung``:
+        each rung pays its mean cost, then escalates with probability
+        ``1 - success_rate``; a failure past the last rung pays the last
+        rung's cost again (ring-restart loop)."""
+        idx = RUNGS.index(start_rung)
+        expected = 0.0
+        carry = 1.0  # probability of reaching the current rung
+        for rung in RUNGS[idx:]:
+            st = self.stats(fault_class, rung)
+            cost = st.mean_cost_s
+            if cost is None:
+                cost = _DEFAULT_COST_S[rung]
+            expected += carry * cost
+            carry *= 1.0 - st.success_rate
+        # residual failure mass re-pays the terminal rung
+        expected += carry * _DEFAULT_COST_S[RUNGS[-1]]
+        return expected
+
+    def pick_start_rung(self, fault_class: str) -> str:
+        """Cheapest-expected-cost starting rung for ``fault_class``; the
+        ladder top until enough episodes are recorded."""
+        if self.episodes(fault_class) < _MIN_EPISODES:
+            return RUNGS[0]
+        best = min(
+            RUNGS, key=lambda rung: self.expected_cost(fault_class, rung)
+        )
+        return best
+
+    def arm(self, fault_class: str, rung: str, reason: str = "") -> None:
+        """Explicitly pin the starting rung (controller decision)."""
+        if rung not in RUNGS:
+            raise ValueError(f"unknown restart rung {rung!r} (know {RUNGS})")
+        with self._lock:
+            self._armed[fault_class] = (rung, reason)
+        log.info(
+            "start rung armed: class=%s rung=%s (%s)", fault_class, rung, reason
+        )
+
+    def disarm(self, fault_class: str) -> None:
+        with self._lock:
+            self._armed.pop(fault_class, None)
+
+    def start_rung(self, fault_class: str) -> str:
+        """Rung the next episode of ``fault_class`` should start at —
+        an explicit arm wins, otherwise the expected-cost pick."""
+        with self._lock:
+            armed = self._armed.get(fault_class)
+        if armed is not None:
+            return armed[0]
+        return self.pick_start_rung(fault_class)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            stats = {
+                f"{cls}@{rung}": dataclasses.asdict(st)
+                for (cls, rung), st in self._stats.items()
+            }
+            armed = {cls: rung for cls, (rung, _) in self._armed.items()}
+        return {"stats": stats, "armed": armed}
+
+
+_ledger: Optional[RungLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def ledger() -> RungLedger:
+    global _ledger
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = RungLedger()
+        return _ledger
+
+
+def _reset_ledger_for_tests() -> None:
+    global _ledger
+    with _ledger_lock:
+        _ledger = None

@@ -664,6 +664,25 @@ class JobMonitor:
             self._thread.join(timeout=5)
 
 
+def host_policy_controller(store, interval_s: Optional[float] = None):
+    """Job-level adaptive policy loop: estimator feed = the tree-gathered
+    per-rank snapshots rank 0 republishes (``telemetry/latest``, the same
+    single-key feed the aggregated /metrics splice polls); decisions are
+    journaled to the store and published under ``policy/decision/latest``
+    for every rank's :class:`~tpu_resiliency.fault_tolerance.control_plane.
+    PolicyClient` to apply.  Returns the started controller."""
+    from ..policy import PolicyController, SnapshotFeed
+    from ..telemetry.aggregate import read_latest_snapshots
+
+    controller = PolicyController(
+        feed=SnapshotFeed(lambda: read_latest_snapshots(store)),
+        store=store,
+    )
+    controller.start(interval_s)
+    log.info("adaptive policy controller hosted (job-level decisions)")
+    return controller
+
+
 def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -713,6 +732,17 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
                     200 if ok else 503,
                     {"status": "ok" if ok else "stalled"},
                 )
+            if self.path == "/policy":
+                controller = getattr(monitor, "policy_controller", None)
+                if controller is None:
+                    return self._send(
+                        200, {"enabled": False, "journal": []})
+                return self._send(200, {
+                    "enabled": True,
+                    "seq": controller.seq,
+                    "estimator": controller.estimator.snapshot(),
+                    "journal": controller.journal[-50:],
+                })
             self.send_response(404)
             self.end_headers()
 
@@ -752,6 +782,11 @@ def main(argv=None) -> None:
     p.add_argument("--poll-interval", type=float, default=5.0)
     p.add_argument("--crash-loop-threshold", type=int, default=5,
                    help="restarts in 15 min that flag crash_looping")
+    p.add_argument("--policy-store", default=None, metavar="HOST:PORT",
+                   help="host the adaptive policy controller over this "
+                        "control-plane store: job-level decisions from "
+                        "tree-gathered rank snapshots, published for "
+                        "per-rank PolicyClients")
     args = p.parse_args(argv)
     if args.slurm:
         scheduler = SlurmScheduler(args.slurm_user, args.slurm_partition)
@@ -783,10 +818,31 @@ def main(argv=None) -> None:
         scheduler, args.attrsvc, args.poll_interval,
         crash_loop_threshold_15m=args.crash_loop_threshold,
     ).start()
+    controller = policy_store = None
+    if args.policy_store:
+        from ..store import StoreClient
+        from ..telemetry.aggregate import read_latest_snapshots
+        from ..telemetry.aggregate import (
+            aggregate_snapshots, render_job_metrics,
+        )
+
+        shost, _, sport = args.policy_store.rpartition(":")
+        policy_store = StoreClient(shost or "127.0.0.1", int(sport))
+        controller = host_policy_controller(policy_store)
+        monitor.policy_controller = controller
+        # the same snapshot feed powers the /metrics job-level splice
+        monitor.aggregated_text_fn = lambda: render_job_metrics(
+            aggregate_snapshots(read_latest_snapshots(policy_store)),
+            prefix="job:",
+        )
     server = make_status_server(monitor, args.host, args.port)
     try:
         server.serve_forever()
     finally:
+        if controller is not None:
+            controller.stop()
+        if policy_store is not None:
+            policy_store.close()
         monitor.stop()
 
 

@@ -24,6 +24,7 @@ histograms can pass their own bucket table.
 from __future__ import annotations
 
 import bisect
+import collections
 import re
 import threading
 import time
@@ -91,8 +92,59 @@ class _NoopMetric:
     def time_ns(self):
         return _NOOP_TIMER
 
+    def rate(self, window_s: float, now: Optional[float] = None) -> float:
+        return 0.0
+
 
 NOOP = _NoopMetric()
+
+
+class RateWindow:
+    """Sliding-window rate over a cumulative series.
+
+    Each :meth:`observe` records ``(now, value)`` into a bounded ring;
+    :meth:`rate` divides the delta against the oldest still-in-window
+    sample by the elapsed time.  A value *decrease* means the underlying
+    counter reset (process restart, scrape of a re-created registry): the
+    history is re-baselined from the new value rather than reporting a
+    negative rate.  ``Counter.rate`` wraps one of these; the job-level
+    estimator feeds standalone instances from cross-rank snapshot sums,
+    which reset whenever ranks restart.
+    """
+
+    __slots__ = ("_samples", "_lock")
+
+    def __init__(self, maxlen: int = 256):
+        self._samples: collections.deque = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, now: Optional[float] = None) -> None:
+        t = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if self._samples and value < self._samples[-1][1]:
+                # counter reset: older samples describe a dead series
+                self._samples.clear()
+            self._samples.append((t, float(value)))
+
+    def rate(
+        self, window_s: float, value: float, now: Optional[float] = None
+    ) -> float:
+        """Record ``(now, value)`` and return events/s over ``window_s``.
+
+        Returns 0.0 until two in-window samples exist (no baseline yet).
+        """
+        t = time.monotonic() if now is None else float(now)
+        self.observe(value, now=t)
+        horizon = t - float(window_s)
+        with self._lock:
+            base = None
+            for st, sv in self._samples:
+                if st >= horizon:
+                    base = (st, sv)
+                    break
+            if base is None or base[0] >= t:
+                return 0.0
+            return max(0.0, (float(value) - base[1]) / (t - base[0]))
 
 
 class _TimerCtx:
@@ -168,6 +220,7 @@ class Counter(_Metric):
     def __init__(self, name: str, help: str = "", label_names: Sequence[str] = ()):
         super().__init__(name, help, label_names)
         self._value = 0.0
+        self._rate_window: Optional[RateWindow] = None
 
     def _make_child(self) -> "Counter":
         return Counter(self.name, self.help)
@@ -182,6 +235,19 @@ class Counter(_Metric):
     def value(self) -> float:
         with self._lock:
             return self._value
+
+    def rate(self, window_s: float, now: Optional[float] = None) -> float:
+        """Windowed rate view (events/s over the trailing ``window_s``).
+
+        Sampling happens at call time — the caller's poll cadence builds the
+        history, the hot ``inc`` path stays a lock + float add.  Returns 0.0
+        until a second in-window call establishes a baseline.
+        """
+        if self._rate_window is None:
+            with self._lock:
+                if self._rate_window is None:
+                    self._rate_window = RateWindow()
+        return self._rate_window.rate(window_s, self.value, now=now)
 
     def _value_dict(self) -> dict:
         with self._lock:

@@ -25,11 +25,54 @@ This module must import nothing from the package (everything imports it).
 from __future__ import annotations
 
 import os
+import threading
 
 _UNSET = object()
 _BOOL_FALSE = frozenset({"0", "false", "no", "off"})
 
 _REGISTRY: dict = {}
+
+# Runtime-override layer: the adaptive policy engine retunes knobs mid-run
+# (save cadence, replication factor, rung selection) WITHOUT mutating
+# os.environ — env mutation leaks into child processes, races exec'd
+# monitors, and is banned by lint rule TPURX010.  Overrides sit in front of
+# the environment for Knob.raw(); the only sanctioned writer is the policy
+# actuator layer (tpu_resiliency/policy/actuator.py).
+_OVERRIDES: dict = {}
+_OVERRIDES_LOCK = threading.Lock()
+
+
+def set_runtime_override(name: str, value) -> None:
+    """Install a runtime value for a declared knob (string-formatted, parsed
+    by the knob's declared type on read).  ``None`` clears the override.
+    Raises KeyError for undeclared names — a typo'd override must fail
+    loudly, exactly like a typo'd knob read."""
+    if name not in _REGISTRY and not any(
+        isinstance(k, KnobFamily) and name.startswith(k.prefix)
+        for k in _REGISTRY.values()
+    ):
+        raise KeyError(f"cannot override undeclared knob {name!r}")
+    with _OVERRIDES_LOCK:
+        if value is None:
+            _OVERRIDES.pop(name, None)
+        else:
+            _OVERRIDES[name] = str(value)
+
+
+def clear_runtime_override(name: str) -> None:
+    set_runtime_override(name, None)
+
+
+def clear_runtime_overrides() -> None:
+    """Drop every runtime override (tests / controller shutdown)."""
+    with _OVERRIDES_LOCK:
+        _OVERRIDES.clear()
+
+
+def runtime_overrides() -> dict:
+    """Snapshot of the active overrides ({name: raw_string})."""
+    with _OVERRIDES_LOCK:
+        return dict(_OVERRIDES)
 
 
 class Knob:
@@ -50,9 +93,12 @@ class Knob:
         _REGISTRY[name] = self
 
     def raw(self) -> str | None:
-        """The raw string value, honoring the fallback var; None when unset
-        (empty string counts as unset)."""
-        val = os.environ.get(self.name)
+        """The raw string value — runtime override first, then the env,
+        then the fallback var; None when unset (empty string counts as
+        unset)."""
+        val = _OVERRIDES.get(self.name)
+        if val is None or val == "":
+            val = os.environ.get(self.name)
         if (val is None or val == "") and self.fallback:
             val = os.environ.get(self.fallback)
         if val == "":
@@ -109,7 +155,9 @@ class KnobFamily:
 
     def raw(self, field: str) -> str | None:
         """Raw value of ``<prefix><FIELD>`` (field upper-cased), None if unset."""
-        return os.environ.get(self.prefix + field.upper())
+        name = self.prefix + field.upper()
+        val = _OVERRIDES.get(name)
+        return os.environ.get(name) if val is None else val
 
 
 def all_knobs():
@@ -391,6 +439,50 @@ COLL_DEGRADE = Knob(
     "comma-separated rungs from {retry, relayout, shrink} (empty string "
     "= fail fast on the first CollectiveTimeout).", group="collectives")
 
+# -- adaptive policy --------------------------------------------------------
+POLICY = Knob(
+    "TPURX_POLICY", bool, False,
+    "Enable the adaptive resiliency policy engine: a closed-loop "
+    "controller that retunes save cadence (Young/Daly), replication, "
+    "delta saves, and restart/degrade rungs from measured fault rates.",
+    group="policy")
+POLICY_INTERVAL_S = Knob(
+    "TPURX_POLICY_INTERVAL_S", float, 30.0,
+    "Tick period of the policy control loop (estimator refresh + "
+    "actuation).", group="policy")
+POLICY_WINDOW_S = Knob(
+    "TPURX_POLICY_WINDOW_S", float, 300.0,
+    "Sliding window the estimator reads fault/interruption rates over.",
+    group="policy")
+POLICY_CADENCE_MIN_S = Knob(
+    "TPURX_POLICY_CADENCE_MIN_S", float, 10.0,
+    "Lower clamp of the policy-set checkpoint save interval.",
+    group="policy")
+POLICY_CADENCE_MAX_S = Knob(
+    "TPURX_POLICY_CADENCE_MAX_S", float, 3600.0,
+    "Upper clamp of the policy-set checkpoint save interval.",
+    group="policy")
+POLICY_HYSTERESIS_PCT = Knob(
+    "TPURX_POLICY_HYSTERESIS_PCT", float, 20.0,
+    "Minimum relative change (percent) between the current and proposed "
+    "cadence before the actuator applies it — damping against estimator "
+    "noise flapping the knob every tick.", group="policy")
+POLICY_RISK_THRESHOLD = Knob(
+    "TPURX_POLICY_RISK_THRESHOLD", float, 0.5,
+    "Node failure-risk score (0-1) above which the controller raises "
+    "replication and flips delta saves on ahead of the predicted "
+    "failure.", group="policy")
+CKPT_INTERVAL_S = Knob(
+    "TPURX_CKPT_INTERVAL_S", float, None,
+    "Target seconds between async checkpoint saves; SaveScheduler reads "
+    "it per step, so policy runtime overrides retune cadence mid-run.",
+    group="checkpoint")
+LCKPT_REPLICATION = Knob(
+    "TPURX_LCKPT_REPLICATION", int, None,
+    "Override of the local-checkpoint replication factor, consulted per "
+    "save (the CliqueReplication ctor value is the floor default).",
+    group="checkpoint")
+
 # -- attribution / LLM ------------------------------------------------------
 LLM_BASE_URL = Knob(
     "TPURX_LLM_BASE_URL", str, "",
@@ -432,6 +524,7 @@ _GROUP_TITLES = {
     "telemetry": "Telemetry & logging",
     "health": "Health & fault injection",
     "collectives": "Collectives",
+    "policy": "Adaptive policy",
     "attribution": "Attribution / LLM",
     "bench": "Bench & harness",
     "general": "General",
