@@ -355,6 +355,10 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "ckpt1g_restore_warm_ok", "ckpt1g_restore_warm_gate_waived",
         "ckpt1g_delta_bytes_pct", "ckpt1g_delta_skipped_mb",
         "ckpt1g_delta_ok", "ckpt1g_delta_gate_waived",
+        "ckpt1g_delta_d2h_skipped_pct", "ckpt1g_delta_d2h_ok",
+        "ckpt1g_delta_d2h_gate_waived", "ckpt1g_device_digest_ns",
+        "ckpt1g_step_overhead_pct", "ckpt1g_step_overhead_ok",
+        "ckpt1g_step_overhead_gate_waived",
         "ckpt1g_restore_peer_s", "ckpt1g_restore_peer_mbps",
         "ckpt1g_restore_peer_state_mb", "ckpt1g_restore_peer_error",
         "straggler_collector_overhead_pct",
@@ -1225,30 +1229,71 @@ def bench_ckpt_large(target_mb: int, time_left_fn, light: bool):
         # A state too small for 10 leaves cannot BE 90% frozen at chunk
         # granularity, so the gate is waived (scaled-down convention).
         if time_left_fn() > 15.0 and n_leaves >= 2:
-            ckpt.async_save(state, os.path.join(tmp, "delta_base"),
-                            extra_metadata={"iteration": 1}, delta=False)
-            ckpt.finalize_all()
-            full_bytes = int(ckpt.last_drain_stats.get("bytes_written", 0))
-            for i in range(max(1, n_leaves // 10)):
-                state[f"w{i}"] = bump(state[f"w{i}"])
-            jax.block_until_ready(state)
-            ckpt.async_save(state, os.path.join(tmp, "delta_inc"),
-                            extra_metadata={"iteration": 2}, delta=True)
-            ckpt.finalize_all()
+            # device digest rides this lane (A/B vs the crc path above):
+            # the baseline save records on-device fingerprints, the delta
+            # save then skips the D2H itself for every frozen shard
+            os.environ["TPURX_CKPT_DEVICE_DIGEST"] = "1"
+            try:
+                ckpt.async_save(state, os.path.join(tmp, "delta_base"),
+                                extra_metadata={"iteration": 1}, delta=False)
+                ckpt.finalize_all()
+                full_bytes = int(ckpt.last_drain_stats.get("bytes_written", 0))
+                for i in range(max(1, n_leaves // 10)):
+                    state[f"w{i}"] = bump(state[f"w{i}"])
+                jax.block_until_ready(state)
+                # step-overhead probe: same call+stall accounting as the big
+                # save, but with delta + device digest on — the zero-stall
+                # path's trainer-visible cost at the fitted cadence
+                t0 = time.perf_counter()
+                ckpt.async_save(state, os.path.join(tmp, "delta_inc"),
+                                extra_metadata={"iteration": 2}, delta=True)
+                dd_call_s = time.perf_counter() - t0
+                dd_quanta = []
+                t_dd0 = time.perf_counter()
+                dd_cap = time_left_fn() - 8.0
+                while True:
+                    if time.perf_counter() - t_dd0 >= dd_cap:
+                        break
+                    dd_quanta.append(work_quantum())
+                    ckpt.maybe_finalize()
+                    if ckpt.num_pending_saves == 0:
+                        break
+                ckpt.finalize_all()
+            finally:
+                os.environ.pop("TPURX_CKPT_DEVICE_DIGEST", None)
             dstats = ckpt.last_drain_stats
+            sstats = ckpt.last_stage_stats
             delta_pct = 100.0 * int(dstats.get("bytes_written", 0)) / max(
                 1, full_bytes
             )
+            dd_stall_s = sum(max(0.0, q - base_s) for q in dd_quanta)
+            step_pct = 100.0 * (dd_call_s + dd_stall_s) / fit_interval_s
+            d2h_skip_pct = 100.0 * int(
+                sstats.get("d2h_skipped_bytes", 0)
+            ) / max(1, state_bytes)
             out.update({
                 "ckpt1g_delta_bytes_pct": round(delta_pct, 1),
                 "ckpt1g_delta_skipped_mb": round(
                     int(dstats.get("bytes_skipped", 0)) / 1e6, 1
                 ),
+                "ckpt1g_delta_d2h_skipped_pct": round(d2h_skip_pct, 1),
+                "ckpt1g_device_digest_ns": int(
+                    float(sstats.get("device_digest_s", 0.0)) * 1e9
+                ),
+                "ckpt1g_step_overhead_pct": round(step_pct, 3),
             })
+            one_core = (os.cpu_count() or 1) < 2
+            out["ckpt1g_step_overhead_ok"] = bool(step_pct < 0.5 or one_core)
+            if one_core and step_pct >= 0.5:
+                out["ckpt1g_step_overhead_gate_waived"] = "1-core host"
             if n_leaves >= 10:
                 out["ckpt1g_delta_ok"] = bool(delta_pct <= 25.0)
+                out["ckpt1g_delta_d2h_ok"] = bool(d2h_skip_pct >= 80.0)
             else:
                 out["ckpt1g_delta_gate_waived"] = (
+                    f"scaled-down state ({n_leaves} leaves < 10)"
+                )
+                out["ckpt1g_delta_d2h_gate_waived"] = (
                     f"scaled-down state ({n_leaves} leaves < 10)"
                 )
         if time_left_fn() > 30.0:

@@ -43,9 +43,9 @@ def rules_of(findings):
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_fourteen_rules_with_stable_ids(self):
+    def test_fifteen_rules_with_stable_ids(self):
         ids = [r.rule_id for r in all_rules()]
-        assert ids == [f"TPURX{n:03d}" for n in range(1, 15)]
+        assert ids == [f"TPURX{n:03d}" for n in range(1, 16)]
 
     def test_every_rule_documents_itself(self):
         for r in all_rules():
@@ -604,6 +604,51 @@ class TestRawCollective:
             def f(x):
                 return multihost_utils.process_allgather(x)
         """, rule="TPURX014")
+
+
+class TestRawDeviceRead:
+    def test_fires_on_raw_d2h(self, tmp_path):
+        fs = lint_snippet(
+            tmp_path, "tpu_resiliency/checkpointing/capture.py", """
+            import jax
+
+            def grab(tree, shard):
+                shard.data.copy_to_host_async()
+                return jax.device_get(tree)
+        """, rule="TPURX015")
+        assert rules_of(fs) == {"TPURX015"}
+        assert len(fs) == 2
+        assert "staging" in " ".join(f.message for f in fs)
+
+    def test_passes_in_staging_layer_and_out_of_scope(self, tmp_path):
+        # staging.py and device_digest.py are the sanctioned touchpoints
+        for home in (
+            "tpu_resiliency/checkpointing/async_ckpt/staging.py",
+            "tpu_resiliency/checkpointing/async_ckpt/device_digest.py",
+        ):
+            assert not lint_snippet(tmp_path, home, """
+                import jax
+
+                def kick(shard):
+                    shard.data.copy_to_host_async()
+                    return jax.device_get(shard.data)
+            """, rule="TPURX015")
+        # non-checkpoint code may read devices freely
+        assert not lint_snippet(tmp_path, "tpu_resiliency/health/probe.py", """
+            import jax
+
+            def probe(x):
+                return jax.device_get(x)
+        """, rule="TPURX015")
+
+    def test_sanctioned_kick_passes(self, tmp_path):
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/checkpointing/local/cap.py", """
+            from ..async_ckpt.staging import async_d2h
+
+            def grab(shards):
+                async_d2h(s.data for s in shards)
+        """, rule="TPURX015")
 
 
 # ---------------------------------------------------------------------------

@@ -422,7 +422,12 @@ class AsyncCallsQueue:
 
     def drain_progress(self) -> Tuple[int, int]:
         """(bytes_written, bytes_total) summed over unfinalized streamed
-        calls — the worker reports through the pipe as chunks land."""
+        calls — the worker reports through the pipe as chunks land.
+        "Written" counts bytes the save no longer owes, whatever their
+        route: file writes, delta-matched chunks, and D2H-skipped shards
+        (credited in full the moment their provenance payload arrives, not
+        when the drain gets around to them — a delta save that skips
+        everything reports complete immediately)."""
         written = total = 0
         for req in self._pending:
             p = self.caller.progress(req.call_idx)

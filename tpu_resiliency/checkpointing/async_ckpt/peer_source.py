@@ -1,0 +1,326 @@
+"""Peer-memory sourcing for the *global* checkpoint restore path.
+
+The local-checkpoint ladder (``local/manager.py``) already restores a lost
+rank's blob out of clique peers' memory-resident copies.  This module lifts
+the same rung to the global ``load_checkpoint`` path: a host whose shard
+files are gone (lost volume, freshly replaced machine, directory that was
+never local) pulls the missing shards from other ranks' shm-**resident**
+committed generations (``resident.py``) over the existing
+:class:`~..local.replication.PeerExchange` chunk-request protocol, instead
+of falling straight to a cold read of remote storage.
+
+Protocol (mirrors the manager's ``meta``/``chunk`` ops, distinct op names
+and reply-tag space so both handlers coexist on one exchange):
+
+- ``gmeta``  -> {have, save_id, shards: [[leaf, shard, nbytes], ...]} for
+  the peer's resident generation of the requested directory.
+- ``gchunk`` -> 4-byte crc32 + the raw span of one resident shard.
+
+Requests ride ``REQ_BIT`` frames carrying their own reply tag + address;
+replies land in the requester's inbox like any blob.  The server side
+CHAINS with whatever handler the exchange already has (the local manager's)
+— unknown ops fall through, so both protocols share one socket.
+
+Verification is two-layered, like every other rung: each tile is crc32'd by
+the sender and checked on arrival (``site="peer_global"``), and the
+assembled shard is then verified span-by-span against the **committed
+index** chunk crcs before it is offered to the restore engine — which
+re-verifies on copy, same as any resident buffer.  A peer cannot corrupt a
+restore; it can only fail to help.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from ...utils import env as _envknobs
+from ...utils.logging import get_logger
+from ..integrity import CheckpointCorruptError, crc32, verify_chunk
+from ..local.replication import REQ_BIT, PeerExchange
+from . import resident as resident_mod
+from .writer import default_chunk_bytes, shard_filename
+
+log = get_logger("ckpt.peer_source")
+
+_CRC = struct.Struct("<I")
+# Reply-tag space: 0x30000000 | seq.  Disjoint from save replication (low
+# tags), retrieval rounds (>= 0x40000000), and the local manager's
+# peer-memory replies (0x60000000 | seq) — see replication.py's tag map.
+_REPLY_BASE = 0x30000000
+_SEQ_MASK = 0x0FFFFFFF
+
+
+class PeerRestoreSource:
+    """Serve our resident generation to peers + fetch shards we lack.
+
+    One instance per process, installed on the shared exchange via
+    :meth:`install` (chains the previous handler).  Pass the instance as
+    ``load_checkpoint(..., peers=...)`` to enable the rung on restore."""
+
+    def __init__(
+        self,
+        exchange: PeerExchange,
+        rank: int,
+        peers: List[int],
+        timeout: Optional[float] = None,
+        streams: Optional[int] = None,
+    ):
+        self.exchange = exchange
+        self.rank = rank
+        self.peers = [p for p in peers if p != rank]
+        # reuse the local rung's budget knobs: one operator story for "how
+        # long may a memory fetch take before disk wins"
+        self._timeout = timeout
+        self._streams = streams
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._prev_handler = None
+        self._installed = False
+        self.stats: Dict[str, int] = {"bytes_served": 0, "bytes_fetched": 0}
+
+    # -- server ------------------------------------------------------------
+
+    def install(self) -> "PeerRestoreSource":
+        """Chain onto the exchange's request handler: ``gmeta``/``gchunk``
+        are ours, everything else falls through to the previous handler
+        (the local manager's ``meta``/``chunk``)."""
+        if not self._installed:
+            self._prev_handler = self.exchange.request_handler
+            self.exchange.request_handler = self._serve
+            self._installed = True
+        return self
+
+    def close(self) -> None:
+        if self._installed:
+            self.exchange.request_handler = self._prev_handler
+            self._prev_handler = None
+            self._installed = False
+
+    def _serve(self, sender: int, tag: int, payload: bytes) -> None:
+        try:
+            req = json.loads(payload.decode())
+            op = req.get("op")
+        except (ValueError, UnicodeDecodeError):
+            return
+        if op not in ("gmeta", "gchunk"):
+            prev = self._prev_handler
+            if prev is not None:
+                prev(sender, tag, payload)
+            return
+        reply_tag = int(req["reply_tag"])
+        reply_addr = req["reply_addr"]
+        rc = resident_mod.lookup(req["dir"])
+        if op == "gmeta":
+            if rc is None:
+                meta = {"have": False}
+            else:
+                bufs = rc.buffers()
+                meta = {
+                    "have": True,
+                    "save_id": rc.save_id,
+                    "shards": [
+                        [l, s, len(buf)] for (l, s), buf in bufs.items()
+                    ],
+                }
+            self.exchange.send_addr(
+                reply_addr, reply_tag, json.dumps(meta).encode()
+            )
+            return
+        # gchunk: one span of one resident shard, sender-crc'd.  Anything
+        # unservable is dropped — the requester times out and falls through.
+        if rc is None:
+            return
+        buf = rc.buffers().get((int(req["leaf"]), int(req["shard"])))
+        if buf is None:
+            return
+        off, length = int(req["off"]), int(req["len"])
+        if off < 0 or length < 0 or off + length > len(buf):
+            return
+        data = bytes(buf[off:off + length])
+        self.stats["bytes_served"] += length
+        self.exchange.send_addr(
+            reply_addr, reply_tag, _CRC.pack(crc32(data)) + data
+        )
+
+    # -- client ------------------------------------------------------------
+
+    def _next_tag(self) -> int:
+        with self._lock:
+            self._seq = (self._seq + 1) & _SEQ_MASK
+            return _REPLY_BASE | self._seq
+
+    def _ask(self, peer: int, req: Dict[str, Any], timeout: float) -> bytes:
+        reply_tag = self._next_tag()
+        req["reply_tag"] = reply_tag
+        req["reply_addr"] = self.exchange.advertised_addr
+        self.exchange.send(
+            peer, REQ_BIT | (reply_tag & _SEQ_MASK), json.dumps(req).encode(),
+            timeout=timeout,
+        )
+        return self.exchange.recv(peer, reply_tag, timeout=timeout)
+
+    def _missing_shards(
+        self,
+        ckpt_dir: str,
+        meta: Dict[str, Any],
+        res_bufs: Dict[Tuple[int, int, int], Any],
+    ) -> List[Dict[str, Any]]:
+        """Shards the local ladder cannot serve: not resident here, and at
+        least one physical file (own or delta base) absent on disk.  Only
+        chunk-sealed shards qualify — peer bytes without committed index
+        crcs to verify against are not accepted."""
+        missing = []
+        for s in meta["shards"]:
+            key = (s["process_index"], s["leaf_idx"], s["shard_idx"])
+            if key in res_bufs:
+                continue
+            if not s.get("chunks"):
+                continue
+            own = os.path.join(
+                ckpt_dir, f"process_{s['process_index']}",
+                shard_filename(s["leaf_idx"], s["shard_idx"]),
+            )
+            paths = [own] + [
+                b if os.path.isabs(b) else os.path.join(ckpt_dir, b)
+                for b in (s.get("bases") or [])
+            ]
+            if all(os.path.exists(p) for p in paths):
+                continue
+            missing.append(s)
+        return missing
+
+    def fetch_missing(
+        self,
+        ckpt_dir: str,
+        meta: Dict[str, Any],
+        res_bufs: Dict[Tuple[int, int, int], Any],
+    ) -> int:
+        """Fetch every shard ``res_bufs``/disk cannot serve from peers'
+        resident generations, verify it, and merge it into ``res_bufs`` for
+        the restore engine.  Returns bytes fetched over the wire.  A shard
+        no peer can serve (or that fails verification) is simply left out —
+        the engine's disk fallback then decides the restore's fate, which
+        is the designed degradation."""
+        missing = self._missing_shards(ckpt_dir, meta, res_bufs)
+        if not missing or not self.peers:
+            return 0
+        budget = (
+            self._timeout if self._timeout is not None
+            else _envknobs.CKPT_PEER_MEM_TIMEOUT.get()
+        )
+        if not budget:
+            return 0
+        deadline = time.monotonic() + budget
+        want_id = str((meta.get("extra") or {}).get("save_id") or "")
+        adir = os.path.abspath(ckpt_dir)
+
+        def _probe(peer: int) -> Optional[Tuple[int, Dict[Tuple[int, int], int]]]:
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                g = json.loads(
+                    self._ask(peer, {"op": "gmeta", "dir": adir}, remaining)
+                    .decode()
+                )
+                if not g.get("have"):
+                    return None
+                if want_id and str(g.get("save_id") or "") != want_id:
+                    return None  # stale generation: its crcs would fail anyway
+                return peer, {
+                    (int(l), int(s)): int(n) for l, s, n in g["shards"]
+                }
+            except (TimeoutError, OSError, ValueError, KeyError):
+                return None
+
+        with ThreadPoolExecutor(
+            max_workers=len(self.peers),
+            thread_name_prefix="tpurx-peersrc-probe",
+        ) as pool:
+            holders = [h for h in pool.map(_probe, self.peers) if h is not None]
+        if not holders:
+            return 0
+
+        streams = (
+            self._streams if self._streams is not None
+            else max(1, _envknobs.CKPT_PEER_STREAMS.get())
+        )
+        chunk = default_chunk_bytes()
+        fetched = 0
+        for s in missing:
+            key = (s["process_index"], s["leaf_idx"], s["shard_idx"])
+            skey = (s["leaf_idx"], s["shard_idx"])
+            nbytes = int(s["nbytes"])
+            srcs = [p for p, have in holders if have.get(skey) == nbytes]
+            if not srcs:
+                continue
+            name = shard_filename(*skey)
+            tiles = [
+                (off, min(chunk, nbytes - off))
+                for off in range(0, nbytes, chunk)
+            ] or [(0, 0)]
+            buf = bytearray(nbytes)
+
+            def _tile(idx: int) -> bool:
+                off, length = tiles[idx]
+                peer = srcs[idx % len(srcs)]  # stripe across all holders
+                try:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    reply = self._ask(
+                        peer,
+                        {"op": "gchunk", "dir": adir, "leaf": skey[0],
+                         "shard": skey[1], "off": off, "len": length},
+                        remaining,
+                    )
+                    if len(reply) != _CRC.size + length:
+                        return False
+                    (want,) = _CRC.unpack_from(reply)
+                    data = memoryview(reply)[_CRC.size:]
+                    verify_chunk(data, want, site="peer_global",
+                                 name=name, off=off)
+                    buf[off:off + length] = data
+                    return True
+                except (TimeoutError, OSError, CheckpointCorruptError) as exc:
+                    log.warning(
+                        "peer shard fetch failed (%s %s off %s from rank "
+                        "%s): %s", ckpt_dir, name, off, peer, exc,
+                    )
+                    return False
+
+            if len(tiles) == 1:
+                ok = [_tile(0)]
+            else:
+                with ThreadPoolExecutor(
+                    max_workers=min(streams, len(tiles)),
+                    thread_name_prefix="tpurx-peersrc-fetch",
+                ) as pool:
+                    ok = list(pool.map(_tile, range(len(tiles))))
+            if not all(ok):
+                continue
+            try:
+                # seal against the COMMITTED index before offering the bytes
+                # to the engine: sender crcs only prove transport integrity
+                mv = memoryview(buf)
+                for row in s["chunks"]:
+                    off, length, want = int(row[0]), int(row[1]), int(row[2])
+                    verify_chunk(mv[off:off + length], want,
+                                 site="peer_global", name=name, off=off)
+            except CheckpointCorruptError as exc:
+                log.warning(
+                    "peer-fetched shard %s failed committed-index "
+                    "verification (%s); leaving it to the disk path",
+                    name, exc,
+                )
+                continue
+            res_bufs[key] = memoryview(buf)
+            fetched += nbytes
+        self.stats["bytes_fetched"] += fetched
+        return fetched

@@ -25,6 +25,14 @@ Lifecycle (the registry is the single source of truth for validity):
   segments are closed when the generation is invalidated instead of
   immediately, keeping the warm source alive across pool churn.
 
+This publish/invalidate protocol is also the ordering backbone of the
+device-digest D2H-skip path: ``StagedTree.content_id`` records which
+committed save's bytes a pooled tree holds, and a delta save may skip a
+shard's transfer only when the tree it reuses carries the *baseline*
+generation's content — a skipped shard's segment is published resident
+as-is, so the invalidate-on-reuse + content_id pair is what guarantees the
+published bytes equal the device bytes the fingerprints vouched for.
+
 Thread-safety: all registry mutation happens under one module lock; the
 published buffer views are read-only from the restore engine's perspective
 (writes only ever happen after an invalidate-on-reuse).

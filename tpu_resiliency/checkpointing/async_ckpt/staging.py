@@ -24,24 +24,54 @@ checkpointer): a steady-state save of an unchanged layout allocates zero new
 shm bytes and — critically on Linux — pays zero first-touch page-fault cost,
 which dominates fresh-segment staging at GiB scale.
 
+**Save planning is derived from the sharding itself**: for every jax leaf
+the global ``device -> index`` map (``NamedSharding.devices_indices_map``)
+is reduced to one owning device per distinct index box (lowest device id
+wins), and exactly-once global coverage is ASSERTED — the distinct boxes
+must tile the global shape with volumes summing to its total, which plain
+interval cover would not prove (overlapping boxes can still union to the
+shape).  Each host then drains exactly its addressable shards that own
+their box: replicated leaves are written once cluster-wide (by whichever
+process holds the lowest-id device), never double-drained, with no special
+"process 0" case.  Shardings that cannot enumerate the map fall back to
+the replica-id ownership rule.
+
+**Device-side change mask** (``device_digest.py``): when a
+:class:`~.device_digest.DigestContext` rides along, every owned shard's
+per-chunk fingerprints are computed ON DEVICE and one small readback of
+the mask decides, per shard and before any ``copy_to_host_async`` is
+issued, whether the shard transfers at all.  A shard whose every chunk
+matches the committed baseline is recorded as skipped spans with their
+base-generation provenance (``ShardInfo.skip_spans``) — no D2H, no memcpy,
+its pooled shm segment keeps the (identical) baseline bytes for the
+resident publish.  Shards that do transfer carry their per-chunk device
+verdicts (``ShardInfo.dev_unchanged``) so the drain can cross-check them
+against the host crc32.
+
 A leaf can be a replicated or sharded global array: we stage only
 **addressable** shards and record their global index, so multi-host saves
-write disjoint data per process (process 0 additionally owns fully-replicated
-leaves to avoid N identical writes).
+write disjoint data per process.
+
+This module and ``device_digest.py`` are the ONLY sanctioned device->host
+touchpoints for checkpoint state (lint rule TPURX015); external capture
+paths (``local/state_dict.py``) kick their transfers through
+:func:`async_d2h`.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import math
 import time
 from multiprocessing import shared_memory
 
 from ...utils.shm import create_shm, unlink_shm
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from ...utils.logging import get_logger
+from ..coverage import covers
 
 log = get_logger("ckpt.staging")
 
@@ -51,6 +81,25 @@ try:
     _HAVE_JAX = True
 except Exception:  # pragma: no cover
     _HAVE_JAX = False
+
+
+def async_d2h(datas: Iterable[Any]) -> int:
+    """Kick a non-blocking device→host transfer for each array in ``datas``
+    (single-device shard ``.data`` arrays or whole addressable arrays).
+
+    THE sanctioned transfer kick for checkpoint state outside this module:
+    lint rule TPURX015 bans raw ``copy_to_host_async``/``jax.device_get``
+    on checkpoint bytes elsewhere, so every capture path funnels through
+    here (or through the staging pipeline itself) and inherits whatever
+    scheduling/accounting this layer grows.  Returns the number of
+    transfers started; host-backed arrays are skipped."""
+    n = 0
+    for d in datas:
+        fn = getattr(d, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+            n += 1
+    return n
 
 
 @dataclasses.dataclass
@@ -63,6 +112,13 @@ class ShardInfo:
     shm_name: str
     nbytes: int
     replica_owner: bool                   # False -> another process owns this data
+    # -- per-save device-digest annotations (reset every staging pass) ------
+    d2h_skipped: bool = False             # True -> no D2H happened this save
+    # full provenance rows (off, len, crc, base_path) for a skipped shard
+    skip_spans: Optional[List[Tuple[int, int, int, str]]] = None
+    # (off, len) chunks whose device fingerprint matched the baseline, for a
+    # shard that transferred anyway (the drain cross-checks host crcs)
+    dev_unchanged: Optional[List[Tuple[int, int]]] = None
 
 
 @dataclasses.dataclass
@@ -77,6 +133,16 @@ class StagedTree:
     stage_wait_s: float = 0.0             # summed per-shard D2H completion waits
     stage_copy_s: float = 0.0             # summed memcpy-into-shm time
     stage_overlap_pct: float = 0.0        # % of memcpy overlapped with live D2H
+    # which save's bytes these shm segments hold (the committed-generation
+    # identity the D2H-skip gate compares against the delta baseline)
+    content_id: str = ""
+    # device fingerprints of every owned jax shard from the last staging
+    # pass, keyed (leaf_idx, shard_idx) — the next save's skip baseline
+    device_fps: Dict[Tuple[int, int], np.ndarray] = dataclasses.field(
+        default_factory=dict
+    )
+    device_digest_s: float = 0.0          # fingerprint dispatch + mask readback
+    d2h_skipped_bytes: int = 0            # bytes that never left the device
     _shms: List[shared_memory.SharedMemory] = dataclasses.field(default_factory=list)
 
     def close(self, unlink: bool = True) -> None:
@@ -104,8 +170,13 @@ def _leaf_paths(tree: Any) -> Tuple[Any, List[str], List[Any]]:
 
 
 def _shard_index(shard, global_shape) -> Tuple[Tuple[int, int], ...]:
+    return _norm_box(shard.index, global_shape)
+
+
+def _norm_box(index, global_shape) -> Tuple[Tuple[int, int], ...]:
+    """Normalize a per-dim slice tuple to concrete (start, stop) bounds."""
     out = []
-    for dim, sl in enumerate(shard.index):
+    for dim, sl in enumerate(index):
         start = sl.start if sl.start is not None else 0
         stop = sl.stop if sl.stop is not None else global_shape[dim]
         out.append((int(start), int(stop)))
@@ -144,6 +215,91 @@ def plan_signature(tree: Any, process_index: Optional[int] = None) -> str:
     return h.hexdigest()[:32]
 
 
+# -- sharding-derived save planning ------------------------------------------
+
+
+def _dev_key(dev) -> int:
+    """Global owner ordering: the lowest device id wins a box.  Device ids
+    are cluster-global in JAX, so every process derives the same owner from
+    the same sharding without any exchange."""
+    return int(getattr(dev, "id", 0))
+
+
+def _box_volume(box: Tuple[Tuple[int, int], ...]) -> int:
+    v = 1
+    for a, b in box:
+        v *= max(0, b - a)
+    return v
+
+
+def shard_owner_map(leaf) -> Optional[Dict[Tuple[Tuple[int, int], ...], Any]]:
+    """Derive the save plan's owner assignment from the sharding itself:
+    the global ``device -> index`` map reduced to ONE owning device per
+    distinct index box (lowest device id), so replicas — including fully
+    replicated leaves, where every device maps to the whole-shape box —
+    are written exactly once cluster-wide.
+
+    Asserts exactly-once global coverage before returning: the distinct
+    boxes must cover the global shape (interval accounting) AND their
+    volumes must sum to its total element count — cover alone tolerates
+    overlapping boxes, which would double-drain bytes.
+
+    Returns None when the sharding cannot enumerate the map (host arrays,
+    shardings without ``devices_indices_map``); callers fall back to the
+    replica-id ownership rule."""
+    sharding = getattr(leaf, "sharding", None)
+    dmap_fn = getattr(sharding, "devices_indices_map", None)
+    if dmap_fn is None:
+        return None
+    global_shape = tuple(int(s) for s in leaf.shape)
+    try:
+        dmap = dmap_fn(global_shape)
+    except Exception:  # noqa: BLE001 - unenumerable sharding: use fallback
+        return None
+    owners: Dict[Tuple[Tuple[int, int], ...], Any] = {}
+    for dev, index in dmap.items():
+        box = _norm_box(index, global_shape)
+        cur = owners.get(box)
+        if cur is None or _dev_key(dev) < _dev_key(cur):
+            owners[box] = dev
+    boxes = list(owners)
+    total = math.prod(global_shape) if global_shape else 1
+    vol = sum(_box_volume(b) for b in boxes)
+    if vol != total or not covers(global_shape, boxes):
+        raise ValueError(
+            f"sharding does not tile the global shape exactly once: shape "
+            f"{global_shape} has {total} elements but the {len(boxes)} "
+            f"distinct index boxes {'cover' if vol > total else 'reach'} "
+            f"{vol} — a save from this plan would "
+            f"{'double-drain' if vol > total else 'lose'} data"
+        )
+    return owners
+
+
+def _replica_owner(leaf, shard, pidx: int) -> bool:
+    """Fallback ownership rule for shardings without an enumerable device
+    map: one replica owner per distinct shard; fully-replicated leaves are
+    written by process 0 only (avoids N identical writes)."""
+    replicated = getattr(leaf.sharding, "is_fully_replicated", False)
+    if replicated:
+        return pidx == 0 and shard.replica_id == 0
+    return shard.replica_id == 0
+
+
+def shard_is_owner(leaf, shard, pidx: int, owners=None) -> bool:
+    """Does THIS process drain this addressable shard?  With a derived
+    owner map, yes iff the shard sits on the device that owns its box;
+    otherwise the replica-id fallback decides."""
+    if owners is None:
+        return _replica_owner(leaf, shard, pidx)
+    box = _norm_box(shard.index, tuple(leaf.shape))
+    own_dev = owners.get(box)
+    dev = getattr(shard, "device", None)
+    if own_dev is None or dev is None:
+        return _replica_owner(leaf, shard, pidx)
+    return _dev_key(own_dev) == _dev_key(dev)
+
+
 @dataclasses.dataclass
 class _OwnedWork:
     """One owned shard awaiting its bytes: plan slot + data source."""
@@ -160,6 +316,7 @@ def stage_pytree(
     plan_sig: Optional[str] = None,
     on_plan: Optional[Callable[[int], None]] = None,
     on_shard_staged: Optional[Callable[[ShardInfo], None]] = None,
+    digest_ctx: Optional[Any] = None,
 ) -> StagedTree:
     """Stage all array leaves into shared memory.  Scalars / numpy leaves are
     staged too (uniform handling keeps the writer simple).
@@ -173,7 +330,15 @@ def stage_pytree(
     ``on_plan(total_owned_bytes)`` fires once, before any bytes move, as soon
     as the full shard plan is known.  ``on_shard_staged(info)`` fires per
     owned shard the moment its bytes are fully in shm — a streaming writer
-    can persist it immediately while later shards are still staging."""
+    can persist it immediately while later shards are still staging.
+
+    ``digest_ctx`` (a :class:`~.device_digest.DigestContext`) turns on the
+    on-device change mask: fingerprints are computed for every owned jax
+    shard, and shards the mask proves unchanged are SKIPPED — no D2H, no
+    memcpy; their ``on_shard_staged`` fires immediately with provenance-only
+    info (``skip_spans`` set).  Skipping additionally requires ``reuse``
+    (the pooled segment must keep holding the shard's — identical —
+    bytes for the resident publish)."""
     treedef, paths, leaves = _leaf_paths(tree)
     pidx = process_index
     if pidx is None:
@@ -188,28 +353,20 @@ def stage_pytree(
         )
     try:
         return _stage_pipelined(staged, leaves, pidx, reusing,
-                                on_plan, on_shard_staged)
+                                on_plan, on_shard_staged, digest_ctx)
     except BaseException:
         if not reusing:
             staged.close(unlink=True)  # partial staging must not leak shm
         raise
 
 
-def _owner(leaf, shard, pidx: int) -> bool:
-    # One replica owner per distinct shard; fully-replicated leaves are
-    # written by process 0 only (avoids N identical writes).
-    replicated = getattr(leaf.sharding, "is_fully_replicated", False)
-    if replicated:
-        return pidx == 0 and shard.replica_id == 0
-    return shard.replica_id == 0
-
-
 def _build_plan(
     staged: StagedTree, leaves: List[Any], pidx: int, reusing: bool
 ) -> List[_OwnedWork]:
     """Metadata-only pass: the complete shard list (owned + non-owned) before
-    a single byte moves.  Reuse carries the prior plan over verbatim — only
-    the data sources are rebound."""
+    a single byte moves.  Fresh plans derive ownership from the sharding
+    (``shard_owner_map``, exactly-once asserted); reuse carries the prior
+    plan over verbatim — only the data sources are rebound."""
     work: List[_OwnedWork] = []
     if reusing:
         for info in staged.shards:
@@ -232,8 +389,9 @@ def _build_plan(
     for i, leaf in enumerate(leaves):
         if _HAVE_JAX and isinstance(leaf, jax.Array):
             global_shape = tuple(leaf.shape)
+            owners = shard_owner_map(leaf)
             for j, shard in enumerate(leaf.addressable_shards):
-                owner = _owner(leaf, shard, pidx)
+                owner = shard_is_owner(leaf, shard, pidx, owners)
                 index = _shard_index(shard, global_shape)
                 info = ShardInfo(
                     leaf_idx=i, shard_idx=j, global_shape=global_shape,
@@ -265,23 +423,74 @@ def _stage_pipelined(
     reusing: bool,
     on_plan: Optional[Callable[[int], None]],
     on_shard_staged: Optional[Callable[[ShardInfo], None]],
+    digest_ctx: Optional[Any] = None,
 ) -> StagedTree:
     work = _build_plan(staged, leaves, pidx, reusing)
     total = sum(w.info.nbytes for w in work)
     if on_plan is not None:
         on_plan(total)
 
-    # Kick off async D2H for every owned jax shard before copying anything:
-    # all DMAs are in flight while shard-by-shard memcpys land below.
+    # per-save annotations: pooled infos persist across saves, so clear them
+    for w in work:
+        w.info.d2h_skipped = False
+        w.info.skip_spans = None
+        w.info.dev_unchanged = None
+    staged.device_fps = {}
+    staged.device_digest_s = 0.0
+    staged.d2h_skipped_bytes = 0
+
+    if digest_ctx is not None:
+        # On-device change mask BEFORE any transfer is issued: fingerprint
+        # every owned jax shard where its bytes live, then one batched
+        # readback of the tiny mask decides transfer-vs-skip per shard.
+        from . import device_digest as dd
+
+        t0 = time.perf_counter()
+        fps_dev = [
+            dd.shard_fingerprints(
+                w.source.data, digest_ctx.chunk_bytes, digest_ctx.use_direct
+            )
+            if w.is_jax else None
+            for w in work
+        ]
+        fps = dd.read_fingerprints(fps_dev)
+        staged.device_digest_s = time.perf_counter() - t0
+        for w, fp in zip(work, fps):
+            if fp is None:
+                continue
+            key = (w.info.leaf_idx, w.info.shard_idx)
+            staged.device_fps[key] = fp
+            skip_rows, unchanged = digest_ctx.verdict(key, w.info.nbytes, fp)
+            if skip_rows is not None and reusing:
+                # pooled segment k keeps the baseline generation's bytes —
+                # identical to the current ones, per the fingerprint match
+                w.info.d2h_skipped = True
+                w.info.skip_spans = skip_rows
+                staged.d2h_skipped_bytes += w.info.nbytes
+            elif unchanged is not None:
+                w.info.dev_unchanged = unchanged
+
+    # Kick off async D2H for every owned jax shard that transfers, before
+    # copying anything: all DMAs are in flight while shard-by-shard memcpys
+    # land below.  Skipped shards never transfer.
     jax_pending = 0
     for w in work:
-        if w.is_jax:
+        if w.is_jax and not w.info.d2h_skipped:
             w.source.data.copy_to_host_async()
             jax_pending += 1
+
+    # skipped shards complete instantly: stream their provenance-only
+    # payloads first so the drain credits their bytes before any wait
+    if on_shard_staged is not None:
+        for w in work:
+            if w.info.d2h_skipped:
+                on_shard_staged(w.info)
 
     shms = staged._shms if reusing else []
     wait_s = copy_s = hidden_copy_s = 0.0
     for k, w in enumerate(work):
+        if w.info.d2h_skipped:
+            continue  # slot k's shm keeps the (identical) baseline bytes
         t0 = time.perf_counter()
         if w.is_jax:
             arr = np.asarray(w.source.data)  # completes THIS shard's D2H only
@@ -321,15 +530,25 @@ def _stage_pipelined(
 
 
 def shard_payload(info: ShardInfo) -> Dict[str, Any]:
-    """Picklable description handed to the writer process."""
+    """Picklable description handed to the writer process.  Skipped shards
+    travel as provenance-only payloads (``skip_spans``, no shm — the bytes
+    never left the device); transferred shards under an active device
+    digest carry their per-chunk verdicts (``dev_unchanged``) for the
+    drain's crc cross-check."""
     shape = tuple(b - a for a, b in info.index)
-    return {
+    p = {
         "leaf_idx": info.leaf_idx,
         "shard_idx": info.shard_idx,
         "global_shape": list(info.global_shape),
-        "index": [list(p) for p in info.index],
+        "index": [list(pair) for pair in info.index],
         "dtype": info.dtype,
         "shm_name": info.shm_name,
         "shape": list(shape),
         "nbytes": info.nbytes,
     }
+    if info.skip_spans is not None:
+        p["shm_name"] = ""
+        p["skip_spans"] = [list(r) for r in info.skip_spans]
+    elif info.dev_unchanged is not None:
+        p["dev_unchanged"] = [list(t) for t in info.dev_unchanged]
+    return p

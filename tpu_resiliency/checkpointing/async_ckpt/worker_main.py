@@ -19,7 +19,15 @@ Requests (trainer → worker):
                                      ``fn(*args, item_iter, progress_cb)``
                                      where ``item_iter`` yields subsequent
                                      stream items as they arrive
-    ("sitem",  call_idx, item)       feed one item to the streamed call
+    ("sitem",  call_idx, item)       feed one item to the streamed call.
+                                     For the drain, items are shard payload
+                                     lists; a payload may carry delta
+                                     baseline rows ("delta"), device-digest
+                                     verdicts ("dev_unchanged"), or be
+                                     provenance-only ("skip_spans": the
+                                     shard's bytes never left the device —
+                                     the writer materializes base-generation
+                                     rows and credits progress immediately)
     ("send",   call_idx, err)        end the stream; ``err`` != None aborts
                                      (the iterator raises inside ``fn``)
     None                             shutdown: drain active calls and exit

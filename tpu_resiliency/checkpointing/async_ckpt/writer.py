@@ -52,6 +52,16 @@ Drain engine (:class:`_WriteEngine`):
   reader before a single element reaches a template leaf.  Disable with
   ``TPURX_CKPT_DIGEST=0`` (or per-save ``digest=False``) for A/B
   measurement; readers treat digest-less shards as legacy (size check only).
+- **Device-digest integration.**  When the on-device fingerprint kernel ran
+  (``device_digest.py``), payloads arrive annotated: a shard every one of
+  whose chunks matched the committed baseline comes as a ``skip_spans``
+  payload — no shm, no D2H ever happened; the sink materializes a sparse
+  file whose index rows are pure provenance, and its bytes count toward
+  drain progress at ``add_payload`` time.  Shards that do transfer carry
+  the per-chunk device verdicts (``dev_unchanged``) and every chunk's host
+  crc verdict is cross-checked against them — disagreement is a detected
+  corruption class: the save aborts and the partial file is quarantined
+  ``*.corrupt``, never committed.
 """
 
 from __future__ import annotations
@@ -73,7 +83,9 @@ from ..integrity import (
     ChunkReader,
     combine_crcs,
     crc32,
+    quarantine_blob,
     read_verified_shard,
+    record_corruption,
     span_plan,
     verify_chunk,
     verify_composed,
@@ -142,6 +154,18 @@ _DELTA_SKIPPED_BYTES = counter(
     "Bytes a delta save did NOT drain because the chunk crc matched the "
     "previous committed generation",
 )
+_D2H_SKIPPED_BYTES = counter(
+    "tpurx_ckpt_d2h_skipped_bytes_total",
+    "Bytes a delta save never transferred off-device: the on-device "
+    "fingerprint kernel proved every chunk of the shard unchanged against "
+    "the committed baseline, so no D2H was issued at all",
+)
+_DIGEST_DISAGREE = counter(
+    "tpurx_ckpt_device_digest_disagreements_total",
+    "Transferred chunks whose on-device fingerprint verdict contradicted "
+    "the host crc32 verdict against the same baseline — a detected "
+    "corruption class (torn D2H or stale staging buffer); the save aborts",
+)
 
 
 def _join_pool(threads: List["threading.Thread"], what: str,
@@ -192,6 +216,35 @@ def resolve_restore_threads(requested: Optional[int] = None) -> int:
     return resolve_write_threads(None)
 
 
+def chunk_grid(
+    nbytes: int,
+    chunk_bytes: Optional[int] = None,
+    use_direct: Optional[bool] = None,
+) -> List[Tuple[int, int]]:
+    """The drain engine's chunk layout for one shard: ``(off, length)``
+    spans.  Chunks never straddle the direct/buffered boundary — the region
+    below the O_DIRECT-aligned end splits into block-aligned chunks, the
+    unaligned tail is one buffered chunk.
+
+    This layout is a FORMAT contract, not an engine detail: the index's
+    per-chunk crc rows, the delta baseline's match keys, and the on-device
+    fingerprint kernel (``device_digest.py``) all address bytes by this
+    grid.  It is deterministic given ``(nbytes, chunk_bytes, use_direct)``
+    so the device side reproduces exactly the grid the host crcs use."""
+    if chunk_bytes is None:
+        chunk_bytes = default_chunk_bytes()
+    if use_direct is None:
+        use_direct = _envknobs.CKPT_DIRECT_IO.get()
+    aligned_end = (nbytes // _ALIGN) * _ALIGN if use_direct else 0
+    chunks: List[Tuple[int, int]] = []
+    for lo, hi in ((0, aligned_end), (aligned_end, nbytes)):
+        off = lo
+        while off < hi:
+            chunks.append((off, min(chunk_bytes, hi - off)))
+            off += chunk_bytes
+    return chunks
+
+
 def shard_filename(leaf_idx: int, shard_idx: int) -> str:
     return f"shard_{leaf_idx}_{shard_idx}.bin"
 
@@ -231,6 +284,37 @@ class _ShardSink:
         self.base_spans: List[Tuple[int, int, int, str]] = []  # + base path
         self.bytes_skipped = 0
         self.crc_ns = 0                # CPU ns spent digesting (stats)
+        # device-digest cross-check: the (off, len) spans whose ON-DEVICE
+        # fingerprint matched the committed baseline.  For every chunk that
+        # transfers anyway, write_chunk demands the host crc verdict agree
+        # — disagreement is detected corruption (torn D2H / stale staging
+        # buffer) and fails the save before anything commits.
+        _dev = payload.pop("dev_unchanged", None)
+        self.dev_unchanged: Optional[set] = (
+            {(int(a), int(b)) for a, b in _dev}
+            if digest and _dev is not None else None
+        )
+        self.corrupt = False           # cross-check tripped: quarantine tmp
+        # fully-skipped shard: the device fingerprints proved EVERY chunk
+        # unchanged, so staging issued no D2H and there is no shm segment.
+        # complete() materializes the sparse file + provenance rows from
+        # these (off, len, crc, base_path) spans alone.
+        _skip = payload.pop("skip_spans", None)
+        self.skip_all = bool(_skip)
+        if self.skip_all:
+            if not digest:
+                # the provenance rows ARE the shard's only content — without
+                # digests in the index the sparse file would restore zeros
+                raise ValueError(
+                    "skip_spans payload requires digest=True (provenance "
+                    "rows are the shard's only on-disk content)"
+                )
+            self.base_spans = [
+                (int(o), int(ln), int(c), str(b)) for o, ln, c, b in _skip
+            ]
+            self.bytes_skipped = sum(s[1] for s in self.base_spans)
+            self.delta = {}  # non-None: complete() must ftruncate to size
+            use_direct = False  # nothing to write; one buffered fd suffices
         self.fd_direct = -1
         self.fd_buf = -1
         # the planned direct/buffered split; if the O_DIRECT open later
@@ -250,7 +334,8 @@ class _ShardSink:
                 os.unlink(self.tmp)  # stale tmp from a crashed predecessor
             except OSError:
                 pass
-            self.shm = attach_shm(self.payload["shm_name"])
+            if not self.skip_all:
+                self.shm = attach_shm(self.payload["shm_name"])
             if self._want_direct and self.aligned_end > 0:
                 try:
                     self.fd_direct = os.open(
@@ -277,6 +362,8 @@ class _ShardSink:
         a delta baseline proved the chunk unchanged (provenance recorded
         instead of a write)."""
         self._ensure_open()
+        if self.skip_all:
+            return False  # no shm, no bytes: the one task just opens the fd
         mv = self.shm.buf[off : off + length]
         try:
             if self.digest and length:
@@ -288,6 +375,8 @@ class _ShardSink:
                     ent = self.delta.get((off, length))
                     if ent is not None and int(ent[0]) == c:
                         base = str(ent[1])
+                    if self.dev_unchanged is not None:
+                        self._cross_check(off, length, base is not None)
                 with self.lock:
                     self.crc_ns += crc_spent
                     if base is not None:
@@ -307,6 +396,34 @@ class _ShardSink:
             return True
         finally:
             mv.release()
+
+    def _cross_check(self, off: int, length: int, host_unchanged: bool) -> None:
+        """Device-vs-host verdict agreement for one transferred chunk.
+
+        Both sides judged the SAME chunk against the SAME committed
+        baseline: the device fingerprint before staging, the host crc32
+        after D2H.  If the staged bytes are the device bytes, the verdicts
+        must agree.  Disagreement means the bytes changed in flight — a
+        torn D2H, a stale staging buffer, or (device-unchanged /
+        host-changed only) a fingerprint collision, which at 64 bits is
+        negligible next to the corruption it would mask — so the save
+        fails closed and the partial output is quarantined, never
+        committed."""
+        dev_unchanged = (off, length) in self.dev_unchanged
+        if dev_unchanged == host_unchanged:
+            return
+        _DIGEST_DISAGREE.inc()
+        with self.lock:
+            self.corrupt = True
+        raise record_corruption(
+            "device_digest",
+            f"device_digest: shard {os.path.basename(self.final)} chunk at "
+            f"offset {off} (+{length} bytes): on-device fingerprint says "
+            f"{'unchanged' if dev_unchanged else 'changed'} but host crc32 "
+            f"says {'unchanged' if host_unchanged else 'changed'} against "
+            f"the same baseline — staged bytes are not the device bytes; "
+            f"save aborted",
+        )
 
     def complete(self) -> None:
         """Last chunk landed: one durability pass + atomic rename; the
@@ -351,10 +468,16 @@ class _ShardSink:
                 except OSError:
                     pass
         self.fd_direct = self.fd_buf = -1
-        try:
-            os.unlink(self.tmp)
-        except OSError:
-            pass
+        if self.corrupt:
+            # keep the disagreeing bytes for post-mortem: rename to
+            # *.corrupt (counted/quarantined like every other detected
+            # corruption) instead of deleting the evidence
+            quarantine_blob(self.tmp, site="device_digest")
+        else:
+            try:
+                os.unlink(self.tmp)
+            except OSError:
+                pass
         self._close_shm()
 
     def _close_shm(self) -> None:
@@ -399,6 +522,7 @@ class _WriteEngine:
         self.total_bytes: Optional[int] = None  # announced plan total, if any
         self.bytes_written = 0
         self.bytes_skipped = 0       # delta: crc-matched chunks not drained
+        self.bytes_d2h_skipped = 0   # subset that never even left the device
         self.chunks_skipped = 0
         self.payloads_done: List[Dict[str, Any]] = []
         self._sinks: List[_ShardSink] = []
@@ -424,19 +548,37 @@ class _WriteEngine:
         self._report_progress(force=True)
 
     def add_payload(self, payload: Dict[str, Any]) -> None:
-        if not payload.get("shm_name"):
+        if not payload.get("shm_name") and not payload.get("skip_spans"):
             return  # non-owned: metadata-only entry, nothing to write
         sink = _ShardSink(self.pdir, payload, self.use_direct, self.digest)
         _SHARD_BYTES.observe(sink.nbytes)
-        # Chunks never straddle the direct/buffered boundary: the region
-        # below ``aligned_end`` splits into block-aligned chunks for the
-        # O_DIRECT fd, the unaligned tail is one buffered chunk.
-        chunks: List[Tuple[int, int]] = []
-        for lo, hi in ((0, sink.aligned_end), (sink.aligned_end, sink.nbytes)):
-            off = lo
-            while off < hi:
-                chunks.append((off, min(self.chunk_bytes, hi - off)))
-                off += self.chunk_bytes
+        if sink.skip_all:
+            # D2H-skipped shard: no bytes ever left the device, so there is
+            # nothing for the pool to digest or write — one no-op task just
+            # materializes the sparse provenance file.  Credit the skipped
+            # bytes toward progress NOW, not when a pool thread reaches the
+            # task: drain_progress() (and the stall/cadence telemetry built
+            # on it) must see skipped bytes the moment the plan does, or a
+            # mostly-frozen delta save reads as stalled below 100%.
+            sink.chunks_left = 1
+            with self._cv:
+                if self._error is not None:
+                    sink.discard()
+                    return
+                self._sinks.append(sink)
+                self.bytes_skipped += sink.bytes_skipped
+                self.bytes_d2h_skipped += sink.bytes_skipped
+                self.chunks_skipped += len(sink.base_spans)
+                self._buckets.setdefault(0, collections.deque()).append(
+                    (sink, 0, 0)
+                )
+                self._pending_chunks += 1
+                self._cv.notify_all()
+            _DELTA_SKIPPED_BYTES.inc(sink.bytes_skipped)
+            _D2H_SKIPPED_BYTES.inc(sink.bytes_skipped)
+            self._report_progress(force=True)
+            return
+        chunks = chunk_grid(sink.nbytes, self.chunk_bytes, self.use_direct)
         if not chunks:
             chunks.append((0, 0))  # empty shard still produces its file
         sink.chunks_left = len(chunks)
@@ -503,6 +645,7 @@ class _WriteEngine:
         return {
             "bytes_written": self.bytes_written,
             "bytes_skipped": self.bytes_skipped,
+            "d2h_skipped_bytes": self.bytes_d2h_skipped,
             "chunks_skipped": self.chunks_skipped,
             "shards": len(self.payloads_done),
             "drain_ns": elapsed_ns,
@@ -569,7 +712,9 @@ class _WriteEngine:
             sink, off, length = task
             try:
                 wrote = sink.write_chunk(off, length)
-                if wrote:
+                if sink.skip_all:
+                    pass  # bytes + progress credited at add_payload
+                elif wrote:
                     _WRITE_BYTES.inc(length)
                     _WRITE_CHUNKS.inc()
                 else:
@@ -580,7 +725,9 @@ class _WriteEngine:
                 if last:
                     sink.complete()
                 with self._cv:
-                    if wrote:
+                    if sink.skip_all:
+                        pass
+                    elif wrote:
                         self.bytes_written += length
                     else:
                         self.bytes_skipped += length

@@ -109,6 +109,16 @@ class CheckpointCorruptError(RuntimeError):
         self.site = site
 
 
+def record_corruption(site: str, msg: str) -> CheckpointCorruptError:
+    """Count a detected-corruption event under ``site`` and build (not
+    raise) the error.  For verdicts reached OUTSIDE the verifying readers —
+    e.g. the drain's device-digest vs host-crc cross-check — so every
+    corruption class lands in the same ``tpurx_ckpt_corrupt_detected_total``
+    series the dashboards already watch."""
+    _CORRUPT.labels(site=site).inc()
+    return CheckpointCorruptError(msg, site)
+
+
 def crc32(data: _Buf, value: int = 0) -> int:
     """Running crc32 (zlib), masked to u32 — composable via the ``value``
     seed for sequential streams."""

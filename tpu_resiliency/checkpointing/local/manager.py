@@ -170,7 +170,13 @@ class LocalCheckpointManager:
         # the peer-memory rung needs the TCP exchange; ICI-backed
         # replication strategies replicate on-device and have none
         self._exchange = getattr(replication, "exchange", None)
+        # handler CHAINING: other request protocols (the global restore's
+        # peer source, async_ckpt/peer_source.py) share this exchange; keep
+        # whatever handler is already installed and delegate unknown ops to
+        # it, and restore it on close instead of clobbering the chain
+        self._prev_request_handler = None
         if self._exchange is not None:
+            self._prev_request_handler = self._exchange.request_handler
             self._exchange.request_handler = self._serve_peer_request
         if scrub_interval is None:
             scrub_interval = _envknobs.CKPT_SCRUB_INTERVAL.get()
@@ -445,7 +451,8 @@ class LocalCheckpointManager:
         no longer holds."""
         self.stop_scrubber()
         if self._exchange is not None:
-            self._exchange.request_handler = None
+            self._exchange.request_handler = self._prev_request_handler
+            self._prev_request_handler = None
         with self._warm_lock:
             self._resident = None
         if self.store is not None:
@@ -491,6 +498,11 @@ class LocalCheckpointManager:
             )
             return
         req = json.loads(payload.decode())
+        if req.get("op") not in ("meta", "chunk"):
+            prev = self._prev_request_handler
+            if prev is not None:
+                prev(sender, tag, payload)
+            return
         reply_tag = int(req["reply_tag"])
         # reply straight to the requester's advertised address: resolving it
         # through the shared store client could block behind this manager's

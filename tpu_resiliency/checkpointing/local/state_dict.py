@@ -68,13 +68,19 @@ class TensorAwareTree:
         leaves_with_paths, treedef = jtu.tree_flatten_with_path(tree)
         paths = [jtu.keystr(p) for p, _ in leaves_with_paths]
 
-        # start async D2H for everything we will materialize
+        # start async D2H for everything we will materialize, through the
+        # staging layer's sanctioned kick (TPURX015: raw device reads of
+        # checkpoint state live in staging.py/device_digest.py only)
         if to_host:
-            for _, leaf in leaves_with_paths:
-                if isinstance(leaf, jax.Array):
-                    for shard in leaf.addressable_shards:
-                        if shard.replica_id == 0:
-                            shard.data.copy_to_host_async()
+            from ..async_ckpt.staging import async_d2h
+
+            async_d2h(
+                shard.data
+                for _, leaf in leaves_with_paths
+                if isinstance(leaf, jax.Array)
+                for shard in leaf.addressable_shards
+                if shard.replica_id == 0
+            )
 
         metas: List[LeafMeta] = []
         arrays: List[np.ndarray] = []

@@ -330,6 +330,21 @@ CKPT_DELTA = Knob(
     "committed index (requires digests; per-save delta= overrides; the "
     "index records per-chunk provenance so restores cover every byte).",
     group="checkpoint")
+CKPT_DEVICE_DIGEST = Knob(
+    "TPURX_CKPT_DEVICE_DIGEST", bool, False,
+    "Compute per-chunk change fingerprints on-device before staging: delta "
+    "saves skip the D2H transfer (not just the disk write) for shards whose "
+    "fingerprints all match the committed baseline, and every transferred "
+    "chunk's device verdict is cross-checked against the host crc32 "
+    "(disagreement fails the save as a detected corruption).",
+    group="checkpoint")
+CKPT_STAGE_BUFFERS = Knob(
+    "TPURX_CKPT_STAGE_BUFFERS", int, 2,
+    "Device-side snapshot slots of the async-save ring (snapshot stage "
+    "mode): with >=2, the next step's snapshot reuses a slot whose staging "
+    "already drained (donated buffers) so compute overlaps the previous "
+    "slice's D2H; 1 restores the single-copy behavior.",
+    group="checkpoint")
 CKPT_PEER_STREAMS = Knob(
     "TPURX_CKPT_PEER_STREAMS", int, 4,
     "Concurrent chunk streams of one peer-memory restore fetch.",

@@ -15,4 +15,5 @@ from . import (  # noqa: F401
     deadline_prop,
     store_keys,
     collectives,
+    d2h,
 )

@@ -1,0 +1,52 @@
+"""TPURX015: device->host reads of checkpoint state stay in the staging layer."""
+
+from __future__ import annotations
+
+import ast
+
+from ..registry import Rule, register
+
+# the sanctioned device->host touchpoints (see staging.py module docstring)
+_ALLOWED = (
+    "tpu_resiliency/checkpointing/async_ckpt/staging.py",
+    "tpu_resiliency/checkpointing/async_ckpt/device_digest.py",
+)
+
+
+@register
+class RawDeviceReadRule(Rule):
+    rule_id = "TPURX015"
+    name = "raw-d2h-read"
+    rationale = (
+        "Checkpoint state leaves the device only through the staging layer "
+        "(async_ckpt/staging.py, device_digest.py) — a raw copy_to_host_async "
+        "or jax.device_get elsewhere bypasses the D2H-skip planning, the "
+        "double-buffer ordering fence, and the drain's digest accounting, "
+        "silently re-serializing transfers the save was designed to avoid. "
+        "Kick transfers via staging.async_d2h instead."
+    )
+    scope = ("tpu_resiliency/checkpointing/",)
+    exclude = _ALLOWED
+
+    def check_file(self, pf):
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr == "copy_to_host_async":
+                yield pf.finding(
+                    self.rule_id, node,
+                    "raw copy_to_host_async on checkpoint state outside the "
+                    "staging layer (use staging.async_d2h)",
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "device_get"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "jax"
+            ) or (isinstance(func, ast.Name) and func.id == "device_get"):
+                yield pf.finding(
+                    self.rule_id, node,
+                    "raw jax.device_get of checkpoint state outside the "
+                    "staging layer (route reads through staging/device_digest)",
+                )
