@@ -382,6 +382,9 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "policy_goodput_gain", "policy_adaptive_goodput",
         "policy_best_fixed_goodput", "policy_trial_gains",
         "policy_retunes", "policy_hang_start_rung", "policy_ok",
+        "tm_flight_append_ns", "tm_flight_append_disabled_ns",
+        "tm_flight_dump_ms", "episode_phase_coverage_pct",
+        "flight_episodes", "flight_ok", "flight_gate_waived",
     ):
         if key in partial:
             line[key] = partial[key]
@@ -1504,6 +1507,85 @@ def bench_policy_goodput() -> dict:
     }
 
 
+def bench_flight() -> dict:
+    """tm_flight lane: the flight recorder's hot-append cost (enabled and
+    ``TPURX_FLIGHT=0`` no-op), black-box dump latency at a full ring, and
+    the MTTR phase-coverage gate over the fault episodes the
+    detect->restart lane actually ran.
+
+    Gates: enabled append p50 < 1 µs and disabled (no-op) call p50 <
+    0.1 µs — both waived on a 1-core host, where the GIL shares the only
+    core with every monitor thread; phase coverage >= 95% (no waiver:
+    coverage is arithmetic over monotonic marks, not a scheduling race).
+    """
+    from tpu_resiliency.telemetry import episode as episode_mod
+    from tpu_resiliency.telemetry import flight
+
+    try:
+        ev = flight.declare_event("bench.append_probe", "i")
+    except ValueError:  # already declared (supervisor re-entry)
+        ev = "bench.append_probe"
+
+    n = 20_000
+
+    def append_p50_ns(record):
+        samples = []
+        for _ in range(7):
+            t0 = time.perf_counter_ns()
+            for i in range(n):
+                record(ev, i)
+            samples.append((time.perf_counter_ns() - t0) / n)
+        return _median(samples)
+
+    out = {}
+    try:
+        flight.configure(enabled=True, capacity=4096)
+        enabled_ns = append_p50_ns(flight.record)
+        # dump latency with every slot occupied (the fault-time cost: the
+        # ring is always full by the time anything trips)
+        fd, path = tempfile.mkstemp(suffix=".jsonl")
+        os.close(fd)
+        try:
+            t0 = time.perf_counter_ns()
+            flight.dump("bench", path=path, min_interval_s=0.0)
+            dump_ms = (time.perf_counter_ns() - t0) / 1e6
+        finally:
+            os.unlink(path)
+        flight.configure(enabled=False)
+        disabled_ns = append_p50_ns(flight.record)
+    finally:
+        flight.configure()  # back to the env-configured recorder
+
+    out["tm_flight_append_ns"] = round(enabled_ns, 1)
+    out["tm_flight_append_disabled_ns"] = round(disabled_ns, 1)
+    out["tm_flight_dump_ms"] = round(dump_ms, 3)
+
+    # phase coverage over the episodes this process really closed (the
+    # detect->restart lane's injected faults); a synthetic episode walks
+    # all six phases when that lane didn't run
+    episodes = [ep for ep in episode_mod.recent() if ep.closed_ns]
+    if not episodes:
+        ep = episode_mod.begin(fault_class="bench_synthetic")
+        for phase in episode_mod.PHASES[1:]:
+            time.sleep(0.001)
+            ep.phase(phase)
+        ep.close()
+        episodes = [ep]
+    coverage = min(ep.coverage_pct() for ep in episodes)
+    out["episode_phase_coverage_pct"] = round(coverage, 2)
+    out["flight_episodes"] = len(episodes)
+
+    one_core = (os.cpu_count() or 1) < 2
+    en_ok = enabled_ns < 1000.0
+    dis_ok = disabled_ns < 100.0
+    out["flight_ok"] = bool(
+        (en_ok or one_core) and (dis_ok or one_core) and coverage >= 95.0
+    )
+    if one_core and not (en_ok and dis_ok):
+        out["flight_gate_waived"] = "1-core host"
+    return out
+
+
 def _telemetry_keys() -> dict:
     """Derive bench keys from the in-process telemetry registry — the same
     series production scrapes from the per-rank exporter, so bench numbers
@@ -1792,6 +1874,16 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional lane, never fatal
                 print(f"bench: policy goodput arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 5:
+            try:
+                # AFTER detect->restart so the coverage gate sees the
+                # episodes those injected faults minted and closed
+                _PARTIAL.update(bench_flight())
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: flight recorder arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
     except _ChildDeadline:
         print("bench: child hit its internal deadline — finalizing from "

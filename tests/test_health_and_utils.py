@@ -114,9 +114,10 @@ class TestProfilingRecorder:
         lat = rec.latency_ns(ProfilingEvent.FAILURE_DETECTED, ProfilingEvent.WORKER_STARTED)
         assert lat is not None and lat > 0
         lines = [json.loads(l) for l in open(path)]
-        assert lines[0]["event"] == "failure_detected"
-        assert lines[0]["cycle"] == 2
-        assert lines[0]["rank"] == 3
+        assert lines[0]["event"] == "_flight_meta"  # alignment header
+        assert lines[1]["event"] == "failure_detected"
+        assert lines[1]["cycle"] == 2
+        assert lines[1]["rank"] == 3
 
     def test_latency_none_when_missing(self):
         rec = ProfilingRecorder()

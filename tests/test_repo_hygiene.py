@@ -20,6 +20,7 @@ importing the defining module must actually register it.
 import ast
 import importlib
 import os
+import re
 import subprocess
 
 import pytest
@@ -190,6 +191,82 @@ def test_declared_metrics_register_on_import():
         importlib.import_module(mod)
     registered = set(get_registry().names())
     missing = {n for n, _r, _l in declared} - registered
+    assert not missing, f"declared but never registered: {sorted(missing)}"
+
+
+def _declared_flight_events():
+    """(name, fields, rel, lineno) for every ``declare_event`` call with a
+    literal first argument anywhere in the package — the flight-recorder
+    analog of :func:`_declared_metric_names`."""
+    out = []
+    for rel, path in _library_sources():
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=rel)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                ctor = func.id
+            elif isinstance(func, ast.Attribute):
+                ctor = func.attr
+            else:
+                continue
+            if ctor != "declare_event" or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                fields = tuple(
+                    a.value for a in node.args[1:]
+                    if isinstance(a, ast.Constant) and isinstance(a.value, str)
+                )
+                out.append((first.value, fields, rel, node.lineno))
+    return out
+
+
+_FLIGHT_EVENT_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)+$")
+
+
+def test_flight_event_names_valid_and_declared_exactly_once():
+    """Flight-event names follow the metric-name discipline: dotted
+    lowercase (``subsystem.event`` — the prefix becomes the trace
+    category), declared ONCE at module scope with a literal string, and
+    record sites import the handle."""
+    declared = _declared_flight_events()
+    assert declared, "no declare_event declarations found — scanner broken?"
+    seen = {}
+    for name, fields, rel, lineno in declared:
+        assert _FLIGHT_EVENT_RE.match(name), (
+            f"flight event {name!r} at {rel}:{lineno} is not dotted "
+            f"lowercase (subsystem.event)"
+        )
+        for field in fields:
+            assert re.match(r"^[a-z][a-z0-9_]*$", field), (
+                f"flight event {name!r} field {field!r} at {rel}:{lineno} "
+                f"is not a lowercase identifier"
+            )
+        seen.setdefault(name, []).append(f"{rel}:{lineno}")
+    dupes = {n: sites for n, sites in seen.items() if len(sites) > 1}
+    assert not dupes, (
+        f"flight event names declared at more than one call site (declare "
+        f"once at module scope, import the handle): {dupes}"
+    )
+
+
+def test_declared_flight_events_register_on_import():
+    """Importing each declaring module must land its event names in the
+    flight module's registry — a never-imported declaration would dump
+    records with positional ``argN`` keys instead of field names."""
+    from tpu_resiliency.telemetry import flight
+
+    declared = _declared_flight_events()
+    for _name, _fields, rel, _lineno in declared:
+        mod = rel[: -len(".py")].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        importlib.import_module(mod)
+    registered = set(flight.event_names())
+    missing = {n for n, _f, _r, _l in declared} - registered
     assert not missing, f"declared but never registered: {sorted(missing)}"
 
 

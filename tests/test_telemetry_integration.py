@@ -266,7 +266,8 @@ class TestProfilingRecorder:
         assert rec.events[0]["i"] == 40  # oldest evicted
         rec.close()
         with open(path) as f:
-            lines = [json.loads(l) for l in f]
+            meta, *lines = [json.loads(l) for l in f]
+        assert meta["event"] == "_flight_meta"  # alignment header
         assert len(lines) == 50  # file keeps the full stream
         assert [l["i"] for l in lines] == list(range(50))
 

@@ -43,9 +43,9 @@ def rules_of(findings):
 # ---------------------------------------------------------------------------
 
 class TestRegistry:
-    def test_fifteen_rules_with_stable_ids(self):
+    def test_sixteen_rules_with_stable_ids(self):
         ids = [r.rule_id for r in all_rules()]
-        assert ids == [f"TPURX{n:03d}" for n in range(1, 16)]
+        assert ids == [f"TPURX{n:03d}" for n in range(1, 17)]
 
     def test_every_rule_documents_itself(self):
         for r in all_rules():
@@ -649,6 +649,73 @@ class TestRawDeviceRead:
             def grab(shards):
                 async_d2h(s.data for s in shards)
         """, rule="TPURX015")
+
+
+class TestWallClockDuration:
+    def test_fires_on_direct_subtraction(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+
+            def f(t0):
+                return time.time() - t0
+        """, rule="TPURX016")
+        assert rules_of(fs) == {"TPURX016"}
+
+    def test_fires_on_assigned_name_used_in_subtraction(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+
+            def f(stamp):
+                now = time.time()
+                return now - stamp
+        """, rule="TPURX016")
+        assert len(fs) == 1
+
+    def test_fires_on_datetime_now(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import datetime
+
+            def f(started):
+                return datetime.datetime.now() - started
+        """, rule="TPURX016")
+        assert rules_of(fs) == {"TPURX016"}
+
+    def test_passes_monotonic_and_labels(self, tmp_path):
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+
+            def f(t0):
+                dur = time.monotonic_ns() - t0
+                return {"dur": dur, "ts": time.time()}
+        """, rule="TPURX016")
+
+    def test_wall_name_in_one_function_does_not_taint_another(self, tmp_path):
+        # `now` is wall-clock in f but monotonic in g: only f may fire
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import time
+
+            def f(t):
+                now = time.time()
+                return now - t
+
+            def g(t):
+                now = time.monotonic()
+                return now - t
+        """, rule="TPURX016")
+        assert len(fs) == 1
+
+    def test_allowlisted_file_and_out_of_scope_pass(self, tmp_path):
+        snippet = """
+            import time
+
+            def age(m):
+                return time.time() - m.ts
+        """
+        assert not lint_snippet(
+            tmp_path, "tpu_resiliency/attribution/trace_analyzer.py",
+            snippet, rule="TPURX016")
+        assert not lint_snippet(
+            tmp_path, "benchmarks/x.py", snippet, rule="TPURX016")
 
 
 # ---------------------------------------------------------------------------

@@ -165,6 +165,13 @@ def main():
         f"final_rank={final_rank} ret={ret}",
         flush=True,
     )
+    if os.environ.get("TPURX_FLIGHT_DIR"):
+        # trip-time black boxes end at the detection instant; the soak tests
+        # also want the full episode story (decide..resume), so drop one
+        # final dump with the complete ring before exiting
+        from tpu_resiliency.telemetry import flight
+
+        flight.dump("worker_exit", min_interval_s=0.0)
 
 
 if __name__ == "__main__":

@@ -267,6 +267,83 @@ def analyze_fingerprints(
     )
 
 
+# -- flight-recorder dump analysis -------------------------------------------
+
+
+def analyze_flight_dump(records) -> Optional[str]:
+    """One-line verdict over a flight-recorder black-box dump.
+
+    Fed the parsed records of a single process's dump (the
+    ``telemetry.flight`` dump-hook contract).  Answers the first question a
+    responder asks of a black box: *what was this process doing when it
+    tripped?* — the monitor section it died inside (begin without a matching
+    end), the collective it dispatched but never settled, store
+    retries/failovers in the tail, and the trip/abort context.  Returns
+    ``None`` when the dump carries nothing actionable.
+    """
+    if not records:
+        return None
+    reason = ""
+    open_sections: list = []
+    pending_coll: Dict[tuple, dict] = {}
+    last_hb_ns = None
+    last_ns = None
+    trip = None
+    retries = 0
+    failovers = 0
+    stages: list = []
+    for rec in records:
+        if not isinstance(rec, dict):
+            continue
+        ev = rec.get("event", "")
+        if ev == "_flight_meta":
+            reason = str(rec.get("reason", "") or reason)
+            continue
+        t = rec.get("mono_ns")
+        if isinstance(t, int):
+            last_ns = t if last_ns is None else max(last_ns, t)
+        if ev == "monitor.section_begin":
+            open_sections.append(str(rec.get("section", "?")))
+        elif ev == "monitor.section_end":
+            name = str(rec.get("section", "?"))
+            if name in open_sections:
+                open_sections.remove(name)
+        elif ev == "monitor.heartbeat":
+            last_hb_ns = rec.get("mono_ns")
+        elif ev == "collective.dispatch":
+            pending_coll[(rec.get("op"), rec.get("axis"))] = rec
+        elif ev == "collective.settle":
+            pending_coll.pop((rec.get("op"), rec.get("axis")), None)
+        elif ev == "monitor.trip":
+            trip = rec
+        elif ev == "store.op_retry":
+            retries += 1
+        elif ev == "store.failover":
+            failovers += 1
+        elif ev == "abort.stage":
+            stages.append(f"{rec.get('stage')}={rec.get('outcome')}")
+    parts = []
+    if open_sections:
+        parts.append(f"open section '{open_sections[-1]}'")
+    if pending_coll:
+        op, axis = next(reversed(pending_coll))
+        parts.append(f"unsettled collective {op}@{axis}")
+    if last_hb_ns is not None and last_ns is not None and last_ns > last_hb_ns:
+        parts.append(
+            f"last heartbeat {(last_ns - last_hb_ns) / 1e9:.1f}s before dump"
+        )
+    if trip is not None:
+        parts.append(f"trip[{trip.get('interruptions', '')}]")
+    if retries or failovers:
+        parts.append(f"store retries={retries} failovers={failovers}")
+    if stages:
+        parts.append("abort stages: " + ",".join(stages[-4:]))
+    if not parts:
+        return None
+    prefix = f"{reason}: " if reason else ""
+    return prefix + "; ".join(parts)
+
+
 # -- machine-readable degrade verdict ---------------------------------------
 
 

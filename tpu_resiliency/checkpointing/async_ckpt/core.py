@@ -34,12 +34,18 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...store.client import StoreError
+from ...telemetry import flight
 from ...utils import env
 from ...store.protocol import itob
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
 
 log = get_logger("async_ckpt")
+
+# flight-recorder span pair: one drain from schedule to finalize (the
+# black-box answer to "was a checkpoint in flight when the fault hit")
+EV_DRAIN_BEGIN = flight.declare_event("ckpt.drain_begin", "call_idx")
+EV_DRAIN_END = flight.declare_event("ckpt.drain_end", "call_idx")
 
 
 @dataclasses.dataclass
@@ -388,6 +394,7 @@ class AsyncCallsQueue:
         self._call_idx += 1
         req = dataclasses.replace(req, call_idx=self._call_idx)
         record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, call_idx=req.call_idx)
+        flight.record(EV_DRAIN_BEGIN, req.call_idx)
         try:
             if req.preload_fn is not None:
                 req.preload_fn()
@@ -408,6 +415,7 @@ class AsyncCallsQueue:
         self._call_idx += 1
         req = dataclasses.replace(req, call_idx=self._call_idx)
         record_event(ProfilingEvent.CHECKPOINT_SAVE_STARTED, call_idx=req.call_idx)
+        flight.record(EV_DRAIN_BEGIN, req.call_idx)
         try:
             if req.preload_fn is not None:
                 req.preload_fn()
@@ -479,6 +487,7 @@ class AsyncCallsQueue:
             if stats is not None:
                 self.last_call_stats = stats
             record_event(ProfilingEvent.CHECKPOINT_SAVE_FINALIZED, call_idx=req.call_idx)
+            flight.record(EV_DRAIN_END, req.call_idx)
             self._pending.pop(0)
             finalized.append(req.call_idx)
         return finalized

@@ -130,6 +130,35 @@ def main() -> None:
     threads: list = []
     streams: dict = {}
 
+    # Sidecar episode adoption: tag this worker's flight/profiling events
+    # with the job's live fault episode so a mid-drain fault's dump joins
+    # the trainer's timeline.  Best-effort — a worker without a reachable
+    # store just runs untagged.
+    adopt_state: dict = {"store": None, "failed": False}
+
+    def adopt_episode() -> None:
+        if adopt_state["failed"]:
+            return
+        if env.STORE_ADDR.name not in os.environ:
+            # no store explicitly configured: don't burn a connect timeout
+            # on the default address from inside the drain path
+            adopt_state["failed"] = True
+            return
+        try:
+            from ...telemetry import episode as episode_mod
+
+            if adopt_state["store"] is None:
+                from ...store.client import StoreClient
+
+                adopt_state["store"] = StoreClient(
+                    env.STORE_ADDR.get(), env.STORE_PORT.get()
+                )
+            episode_mod.adopt(adopt_state["store"])
+        except Exception:  # noqa: BLE001 - tagging must never break a drain
+            # one failed connect disables adoption for the worker's lifetime:
+            # an unreachable store must not tax every subsequent call frame
+            adopt_state["failed"] = True
+
     def run(call_idx, fn, args, item_q=None) -> None:
         t0 = time.monotonic()
         try:
@@ -178,9 +207,11 @@ def main() -> None:
         kind = req[0]
         if kind == "call":
             _, call_idx, fn, args = req
+            adopt_episode()
             spawn(call_idx, fn, args)
         elif kind == "sbegin":
             _, call_idx, fn, args = req
+            adopt_episode()
             q: "queue_mod.Queue" = queue_mod.Queue()
             streams[call_idx] = q
             spawn(call_idx, fn, args, q)

@@ -68,7 +68,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from zlib import crc32
 
 from ...store.tree import combine_json_merge, tree_gather
-from ...telemetry import counter, gauge
+from ...telemetry import counter, flight, gauge
 from ...utils import env as _envknobs
 from ...utils.logging import get_logger
 from ...utils.profiling import ProfilingEvent, record_event
@@ -93,6 +93,13 @@ from .replication import REQ_BIT, CliqueReplication
 from .state_dict import TensorAwareTree
 
 log = get_logger("local_ckpt")
+
+# flight-recorder span pair: a restore from ladder entry to the rebuilt
+# tree — on the episode timeline this is most of the "restore" phase
+EV_RESTORE_BEGIN = flight.declare_event("ckpt.restore_begin", "kind")
+EV_RESTORE_END = flight.declare_event(
+    "ckpt.restore_end", "kind", "iteration", "fallback_depth"
+)
 
 _ITER_RE = re.compile(r"^iter_(\d+)$")
 
@@ -822,6 +829,7 @@ class LocalCheckpointManager:
         all ranks — ``tpurx_ckpt_fallback_depth`` records how far it fell.
         """
         record_event(ProfilingEvent.CHECKPOINT_LOAD_STARTED, kind="local")
+        flight.record(EV_RESTORE_BEGIN, "local")
         depth = 0
         if iteration is None:
             iteration, blob, depth = self._load_ladder(fallback)
@@ -841,6 +849,7 @@ class LocalCheckpointManager:
             ProfilingEvent.CHECKPOINT_LOAD_COMPLETED, kind="local",
             iteration=iteration, fallback_depth=depth,
         )
+        flight.record(EV_RESTORE_END, "local", iteration, depth)
         return tree, iteration
 
     def _load_ladder(self, fallback: bool) -> Tuple[int, bytes, int]:

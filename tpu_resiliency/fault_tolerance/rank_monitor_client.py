@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
 from ..utils import env
 from ..utils.ipc import recv_msg, send_msg
 from ..utils.logging import get_logger
@@ -56,6 +56,12 @@ _SECTION_NS = histogram(
 
 ENV_MONITOR_SOCKET = env.RANK_MONITOR_SOCKET.name
 ENV_LAUNCHER_IPC_SOCKET = env.LAUNCHER_IPC_SOCKET.name
+
+# flight-recorder events: a fault-time dump shows the monitored workload's
+# last heartbeats and which instrumented section it died inside
+EV_HEARTBEAT = flight.declare_event("monitor.heartbeat", "cycle")
+EV_SECTION_BEGIN = flight.declare_event("monitor.section_begin", "section")
+EV_SECTION_END = flight.declare_event("monitor.section_end", "section")
 
 
 class RankMonitorClientError(RuntimeError):
@@ -159,6 +165,7 @@ class RankMonitorClient:
 
     def send_heartbeat(self) -> None:
         ack = not self.cfg.skip_section_response
+        flight.record(EV_HEARTBEAT, self.cycle)
         t0 = time.monotonic_ns()
         self._send({"type": MsgType.HEARTBEAT.value}, want_ack=ack)
         _HB_SEND_NS.observe(time.monotonic_ns() - t0)
@@ -168,6 +175,7 @@ class RankMonitorClient:
 
     def start_section(self, name: str) -> None:
         ack = not self.cfg.skip_section_response
+        flight.record(EV_SECTION_BEGIN, name)
         t0 = time.monotonic_ns()
         self._send({"type": MsgType.SECTION_START.value, "name": name}, want_ack=ack)
         _SECTION_NS.observe(time.monotonic_ns() - t0)
@@ -176,6 +184,7 @@ class RankMonitorClient:
 
     def end_section(self, name: str) -> None:
         ack = not self.cfg.skip_section_response
+        flight.record(EV_SECTION_END, name)
         t0 = time.monotonic_ns()
         self._send({"type": MsgType.SECTION_END.value, "name": name}, want_ack=ack)
         _SECTION_NS.observe(time.monotonic_ns() - t0)

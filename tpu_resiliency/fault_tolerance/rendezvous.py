@@ -33,7 +33,7 @@ from typing import Dict, List, Optional
 
 from ..store.client import StoreTimeout
 from ..store.protocol import ADD_SLOT
-from ..telemetry import counter, gauge, histogram
+from ..telemetry import counter, episode as episode_mod, gauge, histogram
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
 
@@ -128,6 +128,12 @@ def k_done(n: int) -> str:
     return f"rdzv/{n}/done"
 
 
+def k_episode(n: int) -> str:
+    """Fault episode round ``n`` belongs to — the flight-recorder join key
+    that ties a rendezvous round to the fault that forced it."""
+    return f"rdzv/{n}/episode"
+
+
 def gc_round(store, n: int) -> None:
     """Delete every key round ``n`` may have created (idempotent).
 
@@ -144,6 +150,7 @@ def gc_round(store, n: int) -> None:
     store.delete(k_result(n))
     store.delete(k_done(n))
     store.delete(k_restart_req(n))
+    store.delete(k_episode(n))
     for raw in store.list_keys(f"rdzv/{n}/node/"):
         store.delete(k_node(n, raw.decode().rsplit("/", 1)[-1]))
     for raw in store.list_keys(f"rdzv/{n}/count/"):
@@ -379,6 +386,11 @@ class RendezvousHost:
                 r: ns for r, ns in self._opened_ns.items() if r >= target - 2
             }
             self._opened_ns[target] = time.monotonic_ns()
+            # stamp the live fault episode (if any) onto the round — joins
+            # this round's records to the flight-recorder episode timeline
+            eid = episode_mod.adopt(self.store)
+            if eid:
+                self.store.set(k_episode(target), eid)
             record_event(ProfilingEvent.RENDEZVOUS_STARTED, round=target, cycle=cycle)
             return target
         return n
@@ -513,6 +525,7 @@ class RendezvousHost:
             "cycle": int(self.store.get(
                 K_CYCLE, timeout=max(0.01, deadline - time.monotonic()),
             )) - 1,
+            "episode": (self.store.try_get(k_episode(n)) or b"").decode(),
         }
         self.store.set(k_result(n), json.dumps(result))
         self.store.set(k_done(n), b"1")
@@ -629,6 +642,9 @@ class RendezvousJoiner:
                 self._check_shutdown()
                 raise RendezvousTimeout(f"round {n} never completed: {exc}") from exc
             result = json.loads(self.store.get(k_result(n)))
+            # adopt the fault episode the round belongs to: this joiner's
+            # flight/profiling events join the same cross-host timeline
+            episode_mod.adopt(self.store)
             mine = result["assignment"].get(self.desc.node_id)
             if mine is None:
                 # Raced the round close: our info write landed after the host

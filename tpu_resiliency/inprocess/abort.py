@@ -48,11 +48,14 @@ import threading
 import time
 from typing import Callable, List, Optional
 
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
 from ..utils import env
 from ..utils.logging import get_logger
 
 log = get_logger("inproc.abort")
+
+EV_LADDER = flight.declare_event("abort.ladder", "name")
+EV_STAGE = flight.declare_event("abort.stage", "stage", "outcome", "dur_ms")
 
 _STAGE_OUTCOMES = counter(
     "tpurx_abort_stage_outcomes_total",
@@ -196,6 +199,17 @@ class AbortLadder:
     def __call__(self, state=None):
         with self._lock:  # one abort episode at a time per wrapper
             _LADDER_RUNS.inc()
+            flight.record(EV_LADDER, self.name)
+            # entering the ladder: mark the live episode's abort phase (the
+            # degrade ladder runs outside any episode — phase() is a no-op
+            # guarded by the episode's own lifecycle) and drop a black box
+            # before teardown overwrites the pre-fault ring tail
+            from ..telemetry import episode as episode_mod
+
+            ep = episode_mod.current()
+            if ep is not None:
+                ep.phase("abort")
+            flight.dump("abort_ladder")
             results: List[StageResult] = []
             escalated = False
             for stage in self.stages:
@@ -211,6 +225,10 @@ class AbortLadder:
                     if res.outcome == ESCALATE:
                         escalated = True
                 _STAGE_OUTCOMES.labels(stage.name, res.outcome).inc()
+                flight.record(
+                    EV_STAGE, stage.name, res.outcome,
+                    round(res.duration_ms, 3),
+                )
                 results.append(res)
             self.last_results = results
             log.warning("abort ladder: %s", self.summary(results))

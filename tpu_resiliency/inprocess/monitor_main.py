@@ -100,7 +100,7 @@ def run_monitor(
             soft_reported_at = None
             continue
         stamp = shared.timestamp_slot.value
-        age = time.time() - stamp
+        age = time.time() - stamp  # tpurx: disable=TPURX016 -- cross-process shm stamp; wall clock is the only shared domain
         if age > hard_timeout:
             log.error(
                 "monitor: rank %s wedged for %.1fs (> hard %.1fs) — killing",

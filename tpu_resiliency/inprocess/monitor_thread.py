@@ -20,12 +20,14 @@ import threading
 import time
 from typing import Callable, Optional
 
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
 from ..utils.logging import get_logger
 from .exceptions import RankShouldRestart
 from .store_ops import InprocStore
 
 log = get_logger("monitor_thread")
+
+EV_TRIP = flight.declare_event("monitor.trip", "iteration", "interruptions")
 
 _TRIPS = counter(
     "tpurx_monitor_trips_total",
@@ -120,6 +122,10 @@ class MonitorThread:
             [(r.rank, r.interruption.value) for r in records],
         )
         _TRIPS.inc()
+        flight.record(
+            EV_TRIP, self.iteration,
+            ",".join(f"{r.rank}:{r.interruption.value}" for r in records),
+        )
         self._trip_ns = time.monotonic_ns()
         self.tripped.set()
         if self.on_trip:

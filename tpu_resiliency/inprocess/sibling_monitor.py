@@ -57,7 +57,7 @@ class SiblingMonitor:
                 last = self.ops.last_heartbeat(self.sibling)
                 if last is None:
                     continue  # sibling not started yet
-                age = time.time() - last
+                age = time.time() - last  # tpurx: disable=TPURX016 -- sibling heartbeat stamps live in the wall-clock domain (quorum contract)
                 if age > self.timeout:
                     log.error(
                         "rank %s: sibling %s heartbeat stale %.1fs — recording",

@@ -155,6 +155,23 @@ class InprocStore:
             self.store, f"{self.ns}/initial_barrier", rank, world_size, timeout=timeout
         )
 
+    # -- fault-episode identity -------------------------------------------
+
+    def k_episode(self, iteration: int) -> str:
+        return f"{self.ns}/iter/{iteration}/episode"
+
+    def claim_episode(self, iteration: int, proposed: str) -> str:
+        """One episode id per fault: the first detecting rank's CAS wins and
+        every later claimant adopts the winner's id.  Iteration-scoped, so
+        :meth:`gc_iteration` retires the claim with the fault's other keys."""
+        ok, actual = self.store.compare_set_ex(
+            self.k_episode(iteration), b"", proposed.encode()
+        )
+        if ok:
+            return proposed
+        winner = (actual or b"").decode()
+        return winner or proposed
+
     # -- per-iteration key GC ---------------------------------------------
 
     def gc_iteration(self, iteration: int) -> None:
@@ -173,4 +190,5 @@ class InprocStore:
         self.store.delete(self.k_interruptions(iteration))
         self.store.delete(self.k_fingerprints(iteration))
         self.store.delete(self.k_completed(iteration))
+        self.store.delete(self.k_episode(iteration))
         gc_barrier(self.store, f"{self.ns}/iter/{iteration}/barrier")

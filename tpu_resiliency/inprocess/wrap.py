@@ -19,14 +19,16 @@ from __future__ import annotations
 import contextlib
 import gc
 import inspect
+import json
 import threading
 import time
 from typing import Any, Callable, Optional
 
 from ..store.barrier import BarrierTimeout
-from ..store.client import StoreClient, StoreError, store_from_env
+from ..store.client import StoreClient, StoreError, StoreTimeout, store_from_env
 from ..policy.ledger import ledger
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
+from ..telemetry import episode as episode_mod
 from ..utils import env
 from ..utils.logging import get_logger
 from ..utils.profiling import ProfilingEvent, record_event
@@ -162,6 +164,7 @@ class Wrapper:
                 try:
                     return cw.run(*args, **kwargs)
                 except RestartAbort:
+                    flight.dump("restart_abort")
                     if self.terminate:
                         # Terminate plugin (reference `terminate.py` ABC):
                         # last hook before this rank leaves the loop for good
@@ -169,6 +172,10 @@ class Wrapper:
                             self.terminate(cw.state.freeze())
                         except Exception:  # noqa: BLE001
                             log.exception("terminate plugin failed")
+                    raise
+                except Exception:
+                    # black box for the failure the wrapper could NOT absorb
+                    flight.dump("wrapper_exception")
                     raise
 
         wrapped.__name__ = getattr(fn, "__name__", "wrapped")
@@ -192,9 +199,10 @@ class CallWrapper:
         self._accepts_cw = "call_wrapper" in inspect.signature(fn).parameters
         # stamp of the last fault, cleared when the restarted fn re-enters
         self._restart_started_ns: Optional[int] = None
-        # (fault_class, rung) of the restart episode in flight; closed into
-        # the policy rung ledger when the restarted fn re-enters
+        # (fault_class, rung, episode_id) of the restart episode in flight;
+        # closed into the policy rung ledger when the restarted fn re-enters
         self._episode: Optional[tuple] = None
+        self._clock_ref = None  # telemetry.clock.ClockReference on rank 0
 
     # -- public API for the wrapped fn ------------------------------------
 
@@ -287,13 +295,46 @@ class CallWrapper:
                 shared_state=shared,
                 fptail_name=self._tail.name if self._tail else None,
             ).start()
+        # flight-recorder plumbing: SIGUSR2 dump trigger, and every dump is
+        # fed to the attribution engine's trace analyzer
+        flight.install_signal_handler()
+        flight.add_dump_hook(self._analyze_dump_hook)
+        # rank 0 serves the job's reference clock; it must be answering
+        # before peers leave the barrier and calibrate against it
+        clock_cal = False
+        try:
+            clock_cal = bool(env.CLOCK_CAL.get())
+        except ValueError:
+            pass
+        if clock_cal and self.state.initial_rank == 0:
+            from ..telemetry import clock
+
+            try:
+                self._clock_ref = clock.serve_reference(self._store)
+            except (OSError, StoreError):
+                log.debug("clock reference unavailable", exc_info=True)
         self.ops.initial_barrier(
             self.state.initial_rank, self.state.initial_world_size,
             timeout=self.w.barrier_timeout,
         )
+        if clock_cal and self.state.initial_rank != 0:
+            from ..telemetry import clock
+            from ..utils.profiling import get_recorder
+
+            try:
+                clock.calibrate(self._store)
+                # re-emit the profiling meta header so the file carries the
+                # freshly estimated offset for the trace merger
+                get_recorder().write_meta()
+            except (OSError, StoreError, StoreTimeout):
+                log.debug("clock calibration failed", exc_info=True)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        flight.remove_dump_hook(self._analyze_dump_hook)
+        if self._clock_ref is not None:
+            self._clock_ref.stop()
+            self._clock_ref = None
         if self.quorum:
             self.quorum.stop()
         if self.watchdog:
@@ -355,6 +396,7 @@ class CallWrapper:
                 abort_fn=self._abort_fn,
                 last_call_wait=w.last_call_wait,
                 poll_interval=w.monitor_thread_interval,
+                on_trip=self._on_trip,
             )
             sibling = None
             if w.enable_sibling_monitor and len(survivors) > 1:
@@ -400,11 +442,16 @@ class CallWrapper:
                             # re-entering fn closes the episode: the rung
                             # that ran recovered this fault class, at this
                             # measured cost — the policy ledger's input
-                            cls, rung = self._episode
+                            cls, rung, eid = self._episode
                             self._episode = None
                             ledger().record(
-                                cls, rung, True, recovery_ns / 1e9
+                                cls, rung, True, recovery_ns / 1e9,
+                                episode_id=eid,
                             )
+                        ep = episode_mod.current()
+                        if ep is not None:
+                            # fn re-entered: MTTR decomposition complete
+                            ep.close()
                     record_event(
                         ProfilingEvent.INPROCESS_RESTART_COMPLETED
                         if iteration
@@ -498,6 +545,17 @@ class CallWrapper:
                 ProfilingEvent.INPROCESS_RESTART_STARTED,
                 iteration=iteration, rank=state.initial_rank,
             )
+            # the episode usually already exists (minted in _on_trip at the
+            # detection instant); a locally-raised fault reaching here first
+            # mints it now — begin() is idempotent on a live episode
+            ep = episode_mod.begin(
+                store=self._store,
+                claim=lambda eid: self.ops.claim_episode(iteration, eid),
+                fault_class=(
+                    "exception" if fault_exc is not None else "peer_signal"
+                ),
+                rank=state.initial_rank,
+            )
             self.watchdog.ping()
             # let the monitor thread finish abort duties (the trip flow runs
             # independently of the raise loop the finally already silenced);
@@ -508,6 +566,9 @@ class CallWrapper:
                 monitor.abort_done.wait(
                     timeout=sum(s.timeout for s in self.ladder.stages) + 5.0
                 )
+            # abort duties done: the episode moves to its decision phase
+            # (fault classification, rung choice, attribution verdict)
+            ep.phase("decide")
             # the ladder already counted stage outcomes in telemetry; emit
             # them into the profiling stream too so cross-process gates
             # (chaos soak) can assert rung behavior from the JSONL
@@ -532,7 +593,8 @@ class CallWrapper:
                 )
                 else "in_process"
             )
-            self._episode = (fault_class, rung)
+            ep.set_fault_class(fault_class)
+            self._episode = (fault_class, rung, ep.id)
             self._fingerprint_verdict(iteration, survivors)
             if (
                 env.POLICY.get()
@@ -544,8 +606,10 @@ class CallWrapper:
                 ledger().record(
                     fault_class, "in_process", False,
                     (time.monotonic_ns() - self._restart_started_ns) / 1e9,
+                    episode_id=ep.id,
                 )
                 self._episode = None
+                ep.close()
                 raise RestartAbort(
                     f"policy: start rung for {fault_class} is in_job"
                 )
@@ -562,7 +626,11 @@ class CallWrapper:
                     "rank %s: job completed during restart of iteration %s;"
                     " exiting", state.initial_rank, iteration,
                 )
+                ep.close()
                 return None
+            # finalize + health check + survivor barrier = regrouping the
+            # job around the fault: the episode's rendezvous phase
+            ep.phase("rendezvous")
             if w.finalize:
                 w.finalize(state.freeze())
             phase_t0 = _observe_phase("finalize", phase_t0)
@@ -574,13 +642,15 @@ class CallWrapper:
                 if self._episode is not None:
                     # episode escalates out of the process: the in-process
                     # rung failed for this fault class
-                    cls, rung = self._episode
+                    cls, rung, eid = self._episode
                     self._episode = None
                     ledger().record(
                         cls, rung, False,
                         (time.monotonic_ns() - self._restart_started_ns)
                         / 1e9,
+                        episode_id=eid,
                     )
+                ep.close()
                 log.error("rank %s failed restart health check: %s", state.initial_rank, exc)
                 self.ops.mark_terminated(state.initial_rank)
                 self.ops.record_interruption(
@@ -599,8 +669,11 @@ class CallWrapper:
                     "rank %s: job completed while waiting at the iteration"
                     " %s barrier; exiting", state.initial_rank, iteration,
                 )
+                ep.close()
                 return None
             phase_t0 = _observe_phase("iteration_barrier", phase_t0)
+            # survivors regrouped: restoring this rank's place in the job
+            ep.phase("restore")
             # the iteration-i barrier closing means every survivor advanced
             # past i-2: its interruption/fingerprint/barrier keys are settled
             # and can be GC'd (idempotent; any rank may do it)
@@ -615,6 +688,8 @@ class CallWrapper:
             state.world_size = state.initial_world_size
             self._assign()
             _observe_phase("reassign", phase_t0)
+            # last leg: initialize + loop re-entry, closed when fn restarts
+            ep.phase("resume")
             state.advance()
             self.watchdog.ping()
             gc.collect()
@@ -664,6 +739,32 @@ class CallWrapper:
         stages.append(ShrinkMeshStage())
         return AbortLadder(*stages)
 
+    def _on_trip(self) -> None:
+        """Runs on the monitor thread at the detection instant: mint the
+        fault episode (first detector job-wide wins the id) and drop the
+        black box while the ring still holds the pre-fault picture."""
+        iteration = self.state.iteration
+        try:
+            episode_mod.begin(
+                store=self._store,
+                claim=lambda eid: self.ops.claim_episode(iteration, eid),
+                fault_class="peer_signal",
+                rank=self.state.initial_rank,
+            )
+        except (OSError, StoreError):
+            log.debug("episode mint at trip failed", exc_info=True)
+        flight.dump("monitor_trip")
+
+    def _analyze_dump_hook(self, records) -> None:
+        try:
+            from ..attribution.trace_analyzer import analyze_flight_dump
+
+            summary = analyze_flight_dump(records)
+            if summary:
+                log.warning("flight dump analysis: %s", summary)
+        except Exception:  # noqa: BLE001 - analysis never worsens a fault
+            log.debug("flight dump analysis failed", exc_info=True)
+
     def _abort_fn(self) -> None:
         with self.atomic_lock:  # never abort inside a user atomic section
             self.ladder(self.state.freeze())
@@ -692,6 +793,19 @@ class CallWrapper:
                 "abort fingerprint verdict: category=%s culprits=%s — %s",
                 verdict.category, verdict.culprit_ranks, verdict.summary,
             )
+            ep = episode_mod.current()
+            if ep is not None and self._store is not None:
+                # attach the attribution verdict to the episode record so
+                # smonsvc's GET /episodes can name the implicated ranks
+                # tpurx: disable=TPURX013 -- GC'd by telemetry.episode._gc: rank 0 prefix-sweeps episode/ep{n-EPISODE_KEEP}/ at every close
+                self._store.set(
+                    f"episode/{ep.id}/verdict",
+                    json.dumps({
+                        "category": verdict.category,
+                        "culprit_ranks": list(verdict.culprit_ranks),
+                        "summary": verdict.summary,
+                    }),
+                )
             # machine-readable half: pre-arm the implicated collective's
             # route so the first post-restart call starts at the verdict's
             # degrade rung instead of re-burning its deadline

@@ -42,7 +42,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..inprocess.fingerprint import record_dispatch
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
 from ..utils import env
 from ..utils.logging import get_logger
 from ..utils.retry import RetryExhausted
@@ -77,6 +77,13 @@ _DEGRADES = counter(
     "Degrade-ladder rungs taken by wrapped collectives",
     labels=("op", "action"),
 )
+
+# flight-recorder events: trace.py pairs dispatch/settle into spans keyed
+# on (op, axis)
+EV_DISPATCH = flight.declare_event(
+    "collective.dispatch", "op", "axis", "deadline_ms", "lane"
+)
+EV_SETTLE = flight.declare_event("collective.settle", "op", "axis", "status")
 
 
 # -- instrumentation choke point --------------------------------------------
@@ -181,6 +188,7 @@ class ResilientCollective:
 
     def _attempt(self, fn, args, kwargs, budget_ms: float, lane_kind: str):
         t0 = instrument_dispatch(self.op)
+        flight.record(EV_DISPATCH, self.op, self.axis, budget_ms, lane_kind)
         stalled = lane_kind == "primary" and _stall_armed()
 
         def call():
@@ -189,10 +197,15 @@ class ResilientCollective:
                 time.sleep(budget_ms / 1e3 * 2 + 0.1)
             return fn(*args, **kwargs)
 
-        out = self.lane().run(
-            call, op=self.op, axis=self.axis, budget_ms=budget_ms
-        )
+        try:
+            out = self.lane().run(
+                call, op=self.op, axis=self.axis, budget_ms=budget_ms
+            )
+        except CollectiveTimeout:
+            flight.record(EV_SETTLE, self.op, self.axis, "timeout")
+            raise
         elapsed = time.monotonic_ns() - t0
+        flight.record(EV_SETTLE, self.op, self.axis, "ok")
         observe_latency_ns(self.op, elapsed, self.axis)
         health().note_ok(self.op, self.axis, elapsed)
         return out
@@ -213,7 +226,9 @@ class ResilientCollective:
         budget = self.budget_ms()
         if budget <= 0:
             t0 = instrument_dispatch(self.op)
+            flight.record(EV_DISPATCH, self.op, self.axis, 0.0, "inline")
             out = self.fn(*args, **kwargs)
+            flight.record(EV_SETTLE, self.op, self.axis, "ok")
             observe_latency_ns(self.op, time.monotonic_ns() - t0, self.axis)
             return out
         pol = self.policy()
@@ -284,6 +299,9 @@ class ResilientCollective:
                 except CollectiveTimeout as exc:
                     last = exc
                     self._note_timeout()
+        # degrade ladder exhausted: this CollectiveTimeout escapes to the
+        # caller — drop the black box while the ring still shows the ladder
+        flight.dump("collective_timeout")
         raise last if last is not None else CollectiveTimeout(
             self.op, self.axis, budget
         )

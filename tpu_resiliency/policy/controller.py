@@ -27,6 +27,8 @@ import threading
 import time
 from typing import List, Optional, Tuple
 
+from ..telemetry import flight
+from ..telemetry import episode as episode_mod
 from ..telemetry.registry import counter, gauge
 from ..utils import env
 from ..utils.logging import get_logger
@@ -35,6 +37,8 @@ from .estimator import GoodputEstimator, TelemetryFeed
 from .ledger import ledger
 
 log = get_logger("policy.controller")
+
+EV_DECISION = flight.declare_event("policy.decision", "action", "episode")
 
 K_JOURNAL_PREFIX = "policy/journal"
 K_DECISION_LATEST = "policy/decision/latest"
@@ -200,12 +204,19 @@ class PolicyController:
 
     def _journal(self, actions: List[Action]) -> None:
         batch = []
+        # the live fault episode (if any) these decisions belong to: makes
+        # journal rows joinable against flight dumps and episode summaries.
+        # Additive key — decisions_from_json replay ignores it.
+        episode_id = episode_mod.current_or_store_id(self.store)
         for action in actions:
             self.seq += 1
             record = {"seq": self.seq, "t": time.time(), **action.to_dict()}
+            if episode_id:
+                record["episode_id"] = episode_id
             batch.append(record)
             self.journal.append(record)
             _DECISIONS.labels(action=action.kind).inc()
+            flight.record(EV_DECISION, action.kind, episode_id)
         del self.journal[: -self.journal_keep]
         if self.store is None:
             return

@@ -63,15 +63,30 @@ class RungStats:
 class RungLedger:
     """Registry of per-(fault_class, rung) outcome stats."""
 
+    # recent episode records kept for the snapshot (joinable against the
+    # flight recorder's per-episode dumps via episode_id)
+    _EPISODE_LOG_KEEP = 32
+
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: Dict[Tuple[str, str], RungStats] = {}
         self._armed: Dict[str, Tuple[str, str]] = {}  # class -> (rung, reason)
+        self._episode_log: list = []
 
     def record(
-        self, fault_class: str, rung: str, success: bool, cost_s: float
+        self,
+        fault_class: str,
+        rung: str,
+        success: bool,
+        cost_s: float,
+        episode_id: str = "",
     ) -> None:
-        """One restart episode's outcome at ``rung`` for ``fault_class``."""
+        """One restart episode's outcome at ``rung`` for ``fault_class``.
+
+        ``episode_id`` (optional, additive) names the flight-recorder fault
+        episode this outcome belongs to — the join key between the ledger's
+        cost accounting and the episode's MTTR decomposition.
+        """
         if rung not in RUNGS:
             raise ValueError(f"unknown restart rung {rung!r} (know {RUNGS})")
         with self._lock:
@@ -80,6 +95,14 @@ class RungLedger:
             if success:
                 st.successes += 1
             st.total_cost_s += max(0.0, float(cost_s))
+            self._episode_log.append({
+                "episode_id": episode_id or "",
+                "fault_class": fault_class,
+                "rung": rung,
+                "success": bool(success),
+                "cost_s": round(float(cost_s), 6),
+            })
+            del self._episode_log[: -self._EPISODE_LOG_KEEP]
 
     def stats(self, fault_class: str, rung: str) -> RungStats:
         with self._lock:
@@ -154,7 +177,8 @@ class RungLedger:
                 for (cls, rung), st in self._stats.items()
             }
             armed = {cls: rung for cls, (rung, _) in self._armed.items()}
-        return {"stats": stats, "armed": armed}
+            episodes = list(self._episode_log)
+        return {"stats": stats, "armed": armed, "episodes": episodes}
 
 
 _ledger: Optional[RungLedger] = None

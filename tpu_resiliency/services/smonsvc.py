@@ -732,6 +732,29 @@ def make_status_server(monitor: JobMonitor, host: str, port: int) -> ThreadingHT
                     200 if ok else 503,
                     {"status": "ok" if ok else "stalled"},
                 )
+            if self.path == "/episodes" or self.path.startswith("/episodes?"):
+                store = getattr(monitor, "episode_store", None)
+                if store is None:
+                    return self._send(200, {"enabled": False, "episodes": []})
+                from ..telemetry import episode as episode_mod
+
+                n = 10
+                if "?" in self.path:
+                    from urllib.parse import parse_qs, urlsplit
+
+                    qs = parse_qs(urlsplit(self.path).query)
+                    try:
+                        n = max(1, min(100, int(qs.get("n", ["10"])[0])))
+                    except ValueError:
+                        pass
+                try:
+                    episodes = episode_mod.read_episodes(store, n=n)
+                except Exception:  # noqa: BLE001 - a flaky store reads empty
+                    log.exception("episode read failed")
+                    episodes = []
+                return self._send(
+                    200, {"enabled": True, "episodes": episodes}
+                )
             if self.path == "/policy":
                 controller = getattr(monitor, "policy_controller", None)
                 if controller is None:
@@ -830,6 +853,8 @@ def main(argv=None) -> None:
         policy_store = StoreClient(shost or "127.0.0.1", int(sport))
         controller = host_policy_controller(policy_store)
         monitor.policy_controller = controller
+        # same store backs GET /episodes (per-rank episode summaries)
+        monitor.episode_store = policy_store
         # the same snapshot feed powers the /metrics job-level splice
         monitor.aggregated_text_fn = lambda: render_job_metrics(
             aggregate_snapshots(read_latest_snapshots(policy_store)),

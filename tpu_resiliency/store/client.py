@@ -18,7 +18,7 @@ import threading
 import time
 from typing import List, Optional, Sequence
 
-from ..telemetry import counter, histogram
+from ..telemetry import counter, flight, histogram
 from ..utils import env
 from ..utils.retry import (
     CONNECT_POLICY,
@@ -42,6 +42,13 @@ _OP_LATENCY = histogram(
 )
 # per-op metric children resolved once — the hot path does one dict lookup
 _OP_METRICS: dict = {}
+
+# flight-recorder events: every issued op plus the rare recovery paths, so
+# a fault-time dump shows what the control plane was doing and whether it
+# was limping (retries/failovers) before the trip
+EV_OP_ISSUE = flight.declare_event("store.op_issue", "op")
+EV_OP_RETRY = flight.declare_event("store.op_retry", "op", "error")
+EV_FAILOVER = flight.declare_event("store.failover", "addr")
 
 
 def _op_metrics(op: Op):
@@ -169,6 +176,7 @@ class StoreClient:
         self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
     ) -> tuple[Status, List[bytes]]:
         ops_total, op_latency = _op_metrics(op)
+        flight.record(EV_OP_ISSUE, op.name)
         t0 = time.monotonic_ns()
         try:
             return self._roundtrip_inner(op, args, io_timeout)
@@ -228,6 +236,9 @@ class StoreClient:
                         raise StoreError(
                             f"store op {op.name} failed: {exc}"
                         ) from give_up
+                    flight.record(
+                        EV_OP_RETRY, op.name, type(exc).__name__
+                    )
                     self._connect(10.0)
 
     def _drop_socket(self) -> None:
@@ -613,8 +624,11 @@ class FailoverStoreClient(StoreClient):
         if endpoints is None:  # during base __init__
             return super()._connect(connect_timeout)
         per_endpoint = max(2.0, connect_timeout / len(endpoints))
-        for _ in range(len(endpoints)):
+        for attempt in range(len(endpoints)):
             self.host, self.port = endpoints[self._endpoint_idx]
+            if attempt:
+                # not the preferred endpoint anymore: an actual failover
+                flight.record(EV_FAILOVER, f"{self.host}:{self.port}")
             try:
                 super()._connect(per_endpoint)
                 return

@@ -1,0 +1,184 @@
+"""Store-mediated per-host clock-offset estimation (RTT-midpoint, NTP-style).
+
+Every process records flight/profiling timestamps on its own
+``time.monotonic_ns()`` — a clock domain that is meaningless across hosts.
+This module estimates, per process, the offset from the local monotonic
+clock to a shared *reference* clock (rank 0's monotonic domain, reached
+through the control-plane store), so multi-host dumps can be merged onto
+ONE aligned timeline by ``telemetry/trace.py``.
+
+Protocol (two store keys + one counter, all under ``clock/``):
+
+- the reference host runs :class:`ClockReference` — a daemon thread that
+  blocks in ``wait_ge("clock/seq", n+1)`` server-side, then answers request
+  ``n`` by publishing its own ``mono_ns`` under ``clock/resp/<n>``;
+- a calibrating client runs :func:`calibrate`: per round it stamps ``t0``,
+  claims a sequence number with an ADD, posts ``clock/req/<n>``, blocks on
+  ``clock/resp/<n>``, stamps ``t1``, and computes the NTP-style midpoint
+  estimate ``offset = ref_ns - (t0 + t1) / 2``.  The round with the
+  smallest RTT wins (least queueing noise); its RTT bounds the error.
+
+The estimate is held process-global (:func:`offset`) and embedded in every
+flight dump and profiling meta record, where the trace merger applies it.
+
+``TPURX_CLOCK_TEST_SKEW_NS`` injects an artificial skew into
+:func:`mono_ns` (the stamp source shared by flight/profiling) so tests can
+prove the estimator actually recovers and cancels a known offset.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from ..utils import env
+from ..utils.logging import get_logger
+
+log = get_logger("telemetry.clock")
+
+_SEQ_KEY = "clock/seq"
+_REQ_KEY = "clock/req/{n}"
+_RESP_KEY = "clock/resp/{n}"
+_GC_LAG = 64  # settled req/resp keys older than this are deleted
+
+_TEST_SKEW = 0
+try:
+    _TEST_SKEW = env.CLOCK_TEST_SKEW_NS.get()
+except ValueError:
+    _TEST_SKEW = 0
+
+if _TEST_SKEW:
+    def mono_ns() -> int:
+        return time.monotonic_ns() + _TEST_SKEW
+else:
+    mono_ns = time.monotonic_ns
+
+
+@dataclasses.dataclass(frozen=True)
+class ClockOffset:
+    """``local_mono + offset_ns`` lands in the reference clock domain."""
+
+    offset_ns: int
+    rtt_ns: int      # RTT of the winning round; error bound ~ rtt/2
+    ref: str = "rank0"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_offset_lock = threading.Lock()
+_offset: Optional[ClockOffset] = None
+
+
+def offset() -> Optional[ClockOffset]:
+    """The process's calibrated offset, or None when never calibrated."""
+    with _offset_lock:
+        return _offset
+
+
+def set_offset(off: Optional[ClockOffset]) -> None:
+    global _offset
+    with _offset_lock:
+        _offset = off
+
+
+class ClockReference:
+    """Reference-side responder: one answered probe per store sequence
+    number, served in order from a daemon thread.  Run on exactly one
+    process per job (rank 0 by convention); requests posted before the
+    thread starts are answered from the counter backlog."""
+
+    def __init__(self, store, poll_timeout: float = 0.5):
+        # clone: the responder thread must not serialize behind the
+        # owning process's own store traffic on a shared client lock
+        self.store = store.clone()
+        self.poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._served = 0
+
+    def start(self) -> "ClockReference":
+        self._thread = threading.Thread(
+            target=self._run, name="tpurx-clock-ref", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=self.poll_timeout * 4)
+        try:
+            self.store.close()
+        except OSError:
+            pass
+
+    def _run(self) -> None:
+        from ..store.client import StoreError, StoreTimeout
+
+        while not self._stop.is_set():
+            n = self._served + 1
+            try:
+                self.store.wait_ge(_SEQ_KEY, n, timeout=self.poll_timeout)
+            except StoreTimeout:
+                continue
+            except (OSError, StoreError):
+                if self._stop.is_set():
+                    return
+                time.sleep(self.poll_timeout)
+                continue
+            try:
+                # blocking get: the client's ADD may land before its SET
+                self.store.get(_REQ_KEY.format(n=n), timeout=2.0)
+                self.store.set(
+                    _RESP_KEY.format(n=n), str(time.monotonic_ns())
+                )
+                self.store.delete(_REQ_KEY.format(n=n))
+                if n > _GC_LAG:
+                    self.store.delete(_RESP_KEY.format(n=n - _GC_LAG))
+            except (OSError, StoreError):
+                pass  # a lost round is the client's timeout to absorb
+            self._served = n
+
+
+def calibrate(
+    store,
+    rounds: Optional[int] = None,
+    round_timeout: float = 2.0,
+    set_global: bool = True,
+) -> ClockOffset:
+    """RTT-midpoint offset estimation against the job's ClockReference.
+
+    Raises ``StoreError``/``StoreTimeout`` when no responder answers
+    within ``round_timeout`` per round — callers on the startup path
+    should treat calibration as best-effort (dumps then simply carry no
+    offset and the trace merger warns).
+    """
+    if rounds is None:
+        rounds = env.CLOCK_CAL_ROUNDS.get()
+    best: Optional[ClockOffset] = None
+    for _ in range(max(1, rounds)):
+        n = store.add(_SEQ_KEY, 1)
+        t0 = mono_ns()
+        store.set(_REQ_KEY.format(n=n), b"probe")
+        raw = store.get(_RESP_KEY.format(n=n), timeout=round_timeout)
+        t1 = mono_ns()
+        ref_ns = int(raw)
+        rtt = t1 - t0
+        est = ClockOffset(offset_ns=ref_ns - (t0 + t1) // 2, rtt_ns=rtt)
+        if best is None or rtt < best.rtt_ns:
+            best = est
+    assert best is not None
+    if set_global:
+        set_offset(best)
+    log.debug(
+        "clock calibrated: offset=%dns rtt=%dns", best.offset_ns, best.rtt_ns
+    )
+    return best
+
+
+def serve_reference(store) -> ClockReference:
+    """Start (and return) the reference responder on this process."""
+    return ClockReference(store).start()

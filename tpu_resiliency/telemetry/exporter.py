@@ -138,6 +138,18 @@ class MetricsHTTPServer:
                     self.end_headers()
                     self.wfile.write(b"ok")
                     return
+                if self.path == "/flight":
+                    # the live flight-recorder ring as JSONL — the same
+                    # bytes a black-box dump file would hold, on demand
+                    from . import flight
+
+                    body = flight.render_jsonl("http").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self.send_response(404)
                 self.end_headers()
 
@@ -268,4 +280,9 @@ def serve_from_env_once(registry: Optional[Registry] = None) -> list:
     with _env_lock:
         if _env_exporters is None:
             _env_exporters = serve_from_env(registry)
+            # same per-rank entry points want the flight recorder's
+            # on-demand dump trigger; best-effort (non-main threads skip)
+            from . import flight
+
+            flight.install_signal_handler()
         return _env_exporters

@@ -392,6 +392,39 @@ LOG_FUNNEL = Knob(
     "TPURX_LOG_FUNNEL", str, None,
     "Unix socket of the per-node log funnel root (set by the launcher "
     "for workers).", group="telemetry")
+FLIGHT = Knob(
+    "TPURX_FLIGHT", bool, True,
+    "Fault-episode flight recorder; 0 swaps the ring append for a shared "
+    "no-op (same discipline as TPURX_TELEMETRY).", group="telemetry")
+FLIGHT_RING = Knob(
+    "TPURX_FLIGHT_RING", int, 4096,
+    "Flight-recorder ring capacity in events (rounded up to a power of "
+    "two; oldest events overwritten).", group="telemetry")
+FLIGHT_DIR = Knob(
+    "TPURX_FLIGHT_DIR", str, None,
+    "Directory for flight-recorder black-box dumps (default: the "
+    "system temp dir).", group="telemetry")
+FLIGHT_DUMP_KEEP = Knob(
+    "TPURX_FLIGHT_DUMP_KEEP", int, 32,
+    "Dump files retained per process; older dumps this process wrote "
+    "are unlinked.", group="telemetry")
+EPISODE_KEEP = Knob(
+    "TPURX_EPISODE_KEEP", int, 16,
+    "Fault-episode summaries retained in the store; older episodes are "
+    "GC'd at close.", group="telemetry")
+CLOCK_CAL = Knob(
+    "TPURX_CLOCK_CAL", bool, True,
+    "Store-mediated per-host clock-offset calibration at wrapper "
+    "startup (rank 0 serves the reference).", group="telemetry")
+CLOCK_CAL_ROUNDS = Knob(
+    "TPURX_CLOCK_CAL_ROUNDS", int, 8,
+    "Ping-pong rounds per clock calibration; the minimum-RTT round's "
+    "midpoint estimate wins.", group="telemetry")
+CLOCK_TEST_SKEW_NS = Knob(
+    "TPURX_CLOCK_TEST_SKEW_NS", int, 0,
+    "TEST-ONLY: artificial offset added to this process's monotonic "
+    "clock so alignment tests can prove offset recovery.",
+    group="telemetry")
 
 # -- health / fault injection ----------------------------------------------
 NODE_HEALTH_ENDPOINT = Knob(

@@ -7,6 +7,12 @@ hang-detection latency and restart latency are measured end to end.
 
 Events are JSON lines so external tooling (and our own bench) can consume
 them without importing the package.
+
+Each record carries the live fault-episode id (``telemetry/episode.py``)
+and is mirrored into the flight-recorder ring, and each sink file opens
+with a ``_flight_meta`` header naming the host and its estimated clock
+offset — so ``telemetry/trace.py`` can merge profiling streams and flight
+dumps from many hosts onto one aligned timeline.
 """
 
 from __future__ import annotations
@@ -55,6 +61,34 @@ class ProfilingEvent(str, enum.Enum):
 ENV_HISTORY = env.PROFILING_HISTORY.name
 _DEFAULT_HISTORY = 4096
 
+# Test-skew-aware monotonic stamps, duplicated from telemetry/clock.py:
+# utils/__init__ imports this module, so the telemetry package cannot be
+# imported here at module scope.
+try:
+    _TEST_SKEW = env.CLOCK_TEST_SKEW_NS.get()
+except ValueError:
+    _TEST_SKEW = 0
+
+if _TEST_SKEW:
+    def _mono_ns() -> int:
+        return time.monotonic_ns() + _TEST_SKEW
+else:
+    _mono_ns = time.monotonic_ns
+
+_flight_mod_cache: Any = None
+
+
+def _flight():
+    """Lazy handle on telemetry.flight (None until it is importable)."""
+    global _flight_mod_cache
+    if _flight_mod_cache is None:
+        try:
+            from ..telemetry import flight as fl
+        except ImportError:
+            return None
+        _flight_mod_cache = fl
+    return _flight_mod_cache
+
 
 class ProfilingRecorder:
     """Thread-safe in-memory recorder with optional JSONL file sink.
@@ -100,7 +134,24 @@ class ProfilingRecorder:
                 self._path = None  # don't retry the open on every event
                 return None
             atexit.register(self.close)
+            self._write_meta_locked(self._file)
         return self._file
+
+    def _write_meta_locked(self, f) -> None:
+        """Append the host/clock meta header the trace merger keys on."""
+        fl = _flight()
+        if fl is None or f is None:
+            return
+        try:
+            f.write(json.dumps(fl._meta("profiling"), default=repr) + "\n")
+        except (OSError, ValueError):
+            pass
+
+    def write_meta(self) -> None:
+        """Re-emit the meta record (call after clock calibration so the
+        file carries the estimated offset, not just the header's None)."""
+        with self._lock:
+            self._write_meta_locked(self._sink())
 
     def close(self) -> None:
         with self._lock:
@@ -113,14 +164,20 @@ class ProfilingRecorder:
                 pass
 
     def record(self, event: ProfilingEvent, **extra: Any) -> Dict[str, Any]:
+        fl = _flight()
         rec = {
-            "ts": time.time(),
-            "mono_ns": time.monotonic_ns(),
+            "ts": time.time(),  # tpurx: disable=TPURX016 -- record label; durations use mono_ns
+            "mono_ns": _mono_ns(),
             "event": str(event.value),
             "cycle": self._cycle,
             "pid": os.getpid(),
             **extra,
         }
+        if fl is not None:
+            eid = fl.current_episode_id()
+            if eid:
+                rec.setdefault("episode", eid)
+            fl.record(fl.EV_PROFILING, str(event.value), self._cycle)
         with self._lock:
             self._events.append(rec)
             f = self._sink()

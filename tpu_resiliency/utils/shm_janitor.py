@@ -91,7 +91,7 @@ def sweep(min_age_s: float = 600.0, prefixes=_PREFIXES, dry_run: bool = False) -
 
 def _age(path: str) -> float:
     try:
-        return time.time() - os.stat(path).st_mtime
+        return time.time() - os.stat(path).st_mtime  # tpurx: disable=TPURX016 -- file mtime age; mtimes are wall-clock by definition
     except OSError:
         return 0.0
 

@@ -16,4 +16,5 @@ from . import (  # noqa: F401
     store_keys,
     collectives,
     d2h,
+    wall_clock_duration,
 )

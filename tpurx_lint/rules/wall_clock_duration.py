@@ -1,0 +1,114 @@
+"""TPURX016: durations are measured on the monotonic clock, never wall time."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from ..registry import Rule, register
+
+# wall-clock call forms: time.time(), time.time_ns(), datetime.now(),
+# datetime.utcnow(), datetime.datetime.now(), ...
+_TIME_ATTRS = {"time", "time_ns"}
+_DATETIME_ATTRS = {"now", "utcnow"}
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_wall_clock_call(node) -> bool:
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    attr = node.func.attr
+    base = node.func.value
+    if attr in _TIME_ATTRS:
+        return isinstance(base, ast.Name) and base.id == "time"
+    if attr in _DATETIME_ATTRS:
+        if isinstance(base, ast.Name):
+            return base.id == "datetime"
+        return isinstance(base, ast.Attribute) and base.attr == "datetime"
+    return False
+
+
+def _shallow_walk(scope) -> Iterator[ast.AST]:
+    """Every node of ``scope`` excluding nested function/lambda bodies —
+    each nested scope gets its own pass, so a name bound from a wall clock
+    in one function never taints a same-named monotonic stamp in another."""
+    body = scope.body if not isinstance(scope, ast.Lambda) else [scope.body]
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _SCOPE_NODES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _wall_names(scope) -> Set[str]:
+    """Names bound directly in ``scope`` from a bare wall-clock call."""
+    out: Set[str] = set()
+    for node in _shallow_walk(scope):
+        if isinstance(node, ast.Assign) and _is_wall_clock_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif (
+            isinstance(node, ast.AnnAssign)
+            and node.value is not None
+            and _is_wall_clock_call(node.value)
+            and isinstance(node.target, ast.Name)
+        ):
+            out.add(node.target.id)
+    return out
+
+
+def _operand_is_wall(node, wall_names: Set[str]) -> bool:
+    return _is_wall_clock_call(node) or (
+        isinstance(node, ast.Name) and node.id in wall_names
+    )
+
+
+@register
+class WallClockDurationRule(Rule):
+    rule_id = "TPURX016"
+    name = "wall-clock-duration"
+    rationale = (
+        "A duration computed as the difference of time.time() / datetime.now() "
+        "readings jumps with NTP steps, leap smearing and manual clock sets — "
+        "on a fleet under clock calibration that can turn a deadline check or "
+        "a phase measurement negative or wildly long.  Durations inside "
+        "tpu_resiliency/ subtract monotonic readings (time.monotonic[_ns], "
+        "telemetry.clock.mono_ns); wall clocks are for labeling, not "
+        "measuring.  Sites that legitimately subtract wall stamps (cross-"
+        "process marker ages, where monotonic clocks are incomparable) carry "
+        "an inline suppression naming why."
+    )
+    scope = ("tpu_resiliency/",)
+    # marker ages compare time.time() stamps ACROSS processes — monotonic
+    # readings of different processes are incomparable, wall time is the
+    # only shared clock there; smonsvc ages external artifacts (file mtimes,
+    # cycle stamps written by watched jobs), all wall-domain by nature
+    exclude = (
+        "tpu_resiliency/attribution/trace_analyzer.py",
+        "tpu_resiliency/services/smonsvc.py",
+    )
+
+    def check_file(self, pf) -> Iterator:
+        scopes = [pf.tree] + [
+            n for n in ast.walk(pf.tree) if isinstance(n, _SCOPE_NODES)
+        ]
+        for scope in scopes:
+            wall = _wall_names(scope)
+            for node in _shallow_walk(scope):
+                if not (
+                    isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                ):
+                    continue
+                if _operand_is_wall(node.left, wall) or _operand_is_wall(
+                    node.right, wall
+                ):
+                    yield pf.finding(
+                        self.rule_id, node,
+                        "duration measured by subtracting wall-clock readings "
+                        "(time.time/datetime.now) — use time.monotonic_ns() / "
+                        "telemetry.clock.mono_ns so NTP steps cannot skew it",
+                    )
