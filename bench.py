@@ -382,6 +382,9 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "policy_goodput_gain", "policy_adaptive_goodput",
         "policy_best_fixed_goodput", "policy_trial_gains",
         "policy_retunes", "policy_hang_start_rung", "policy_ok",
+        "evac_goodput_gain", "evac_goodput", "react_goodput",
+        "evac_trial_gains", "evac_join_mttr_ms", "evac_false_positives",
+        "evac_missed", "evac_ok",
         "tm_flight_append_ns", "tm_flight_append_disabled_ns",
         "tm_flight_dump_ms", "episode_phase_coverage_pct",
         "flight_episodes", "flight_ok", "flight_gate_waived",
@@ -1507,6 +1510,33 @@ def bench_policy_goodput() -> dict:
     }
 
 
+def bench_evac_goodput() -> dict:
+    """Predict-and-evacuate vs react-after-failure gate: a seeded ramping-
+    degradation schedule drives the REAL PolicyController end to end (the
+    RankRiskModel's noisy-OR fusion, the streak guard, the hysteresis
+    latch, the one-shot Actuator evacuate) with noisy healthy ranks as
+    false-positive bait; the evacuate arm pays the planned handoff, the
+    react arm the full reactive episode.  Single-source: the sim lives in
+    benchmarks/bench_evac.py (standalone: ``python
+    benchmarks/bench_evac.py --seed N``).  Gates: mean gain >= 1.1x
+    (1-core waiver, like the soak lanes), zero healthy-rank evacuations,
+    zero missed ramps."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from benchmarks.bench_evac import run as evac_run
+
+    report = evac_run(seed=0xE7AC, trials=3)
+    return {
+        "evac_goodput_gain": report["evac_goodput_gain"],
+        "evac_goodput": report["evac_goodput"],
+        "react_goodput": report["react_goodput"],
+        "evac_trial_gains": report["evac_trial_gains"],
+        "evac_join_mttr_ms": report["evac_join_mttr_ms"],
+        "evac_false_positives": report["evac_false_positives"],
+        "evac_missed": report["evac_missed"],
+        "evac_ok": report["evac_ok"],
+    }
+
+
 def bench_flight() -> dict:
     """tm_flight lane: the flight recorder's hot-append cost (enabled and
     ``TPURX_FLIGHT=0`` no-op), black-box dump latency at a full ring, and
@@ -1874,6 +1904,14 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional lane, never fatal
                 print(f"bench: policy goodput arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 5:
+            try:
+                _PARTIAL.update(bench_evac_goodput())
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: evac goodput arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
 
         if time_left() > 5:

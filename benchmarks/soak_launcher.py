@@ -59,6 +59,16 @@ deadline trip (``tpurx_collective_timeouts_total`` > 0), degrade ladder
 walked (``tpurx_collective_degrades_total`` > 0 on the armed rank only),
 every rank FINISHED, and the launcher ring recorded ZERO restart cycles.
 
+With ``--ramp-degrade`` the soak runs the predict-and-evacuate campaign:
+one rank's health and straggler scores ramp worse round by round while
+rank 0 hosts a ``PolicyController`` over the tree-gathered snapshot feed
+with ``TPURX_EVAC=1``.  The gate asserts the fused rank risk evacuated
+the ramping victim (checkpoint-ahead + published ``evac/`` record)
+BEFORE its hard-fault deadline, that no healthy rank was ever evacuated,
+and that the evacuated slot warm-joined chunk-granular from peer
+holders' resident copies — peer-memory bytes > 0, disk bytes == 0, no
+global restore round.
+
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
 for both rings from those events and ASSERTS bounds, so a regression in
@@ -401,6 +411,146 @@ print(f"soakcoll[{rank}] result=done "
 """
 
 
+WORKLOAD_EVAC = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+import numpy as np
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.store.client import store_from_env
+from tpu_resiliency.checkpointing.local.manager import LocalCheckpointManager
+from tpu_resiliency.checkpointing.local.replication import (
+    CliqueReplication, PeerExchange)
+from tpu_resiliency.policy import (
+    EvacuationPipeline, PolicyController, SnapshotFeed,
+    set_evacuation_handler)
+from tpu_resiliency.telemetry import get_registry
+from tpu_resiliency.telemetry.aggregate import (
+    CrossRankAggregator, read_latest_snapshots)
+
+rank = int(os.environ["TPURX_RANK"])
+world = int(os.environ["TPURX_WORLD_SIZE"])
+cycle = int(os.environ["TPURX_CYCLE"])
+victim = int(os.environ.get("SOAK_EVAC_VICTIM", "1"))
+ramp_rounds = int(os.environ.get("SOAK_EVAC_RAMP_ROUNDS", "12"))
+deadline_step = int(os.environ.get("SOAK_EVAC_DEADLINE", "45"))
+root = os.environ["SOAK_CKPT_ROOT"]
+save_every = int(os.environ.get("SOAK_LCKPT_EVERY", "5"))
+total = int(os.environ.get("SOAK_STEPS", "200"))
+
+client = RankMonitorClient(); client.init_workload_monitoring()
+store = store_from_env(timeout=15.0)
+ex = PeerExchange(store, rank, namespace=f"soakev-c{cycle}")
+repl = CliqueReplication(ex, world, replication_factor=min(2, world))
+mgr = LocalCheckpointManager(
+    os.path.join(root, f"n{rank}"), rank, world, store=store,
+    replication=repl, keep_last=8, peer_timeout=30.0,
+    store_namespace=f"localckpt/c{cycle}",
+)
+agg = CrossRankAggregator(store, rank, world)
+reg = get_registry()
+health = reg.gauge("tpurx_health_score", labels=("check",))
+strag = reg.gauge("tpurx_straggler_score", labels=("rank",))
+
+
+def source_bytes(src):
+    return get_registry().value_of(
+        "tpurx_ckpt_restore_source_total", {"source": src})
+
+
+def make_tree(step):
+    return {"w": np.full((4096,), float(step), dtype=np.float32),
+            "step": np.int64(step),
+            "rank_marker": np.array([rank], dtype=np.int32)}
+
+
+pipe = EvacuationPipeline(store=store, rank=rank,
+                          shrink_fn=lambda victim_rank: None)
+ctl = None
+if rank == 0:
+    # job-level controller over the tree-gathered snapshot feed; the
+    # handler runs the real pipeline (checkpoint-ahead + record publish)
+    # and announces a future JOIN step every rank will reach in lockstep
+    step_box = {"step": 0}
+
+    def on_evacuate(victim_rank, reason):
+        join_step = step_box["step"] + 10
+        pipe.evacuate(victim_rank, reason=reason)
+        store.set(f"soakev/c{cycle}/evacuate", json.dumps(
+            {"victim": victim_rank, "join_step": join_step}))
+        print(f"soakev[0] EVACUATE rank={victim_rank} "
+              f"at step={step_box['step']} join_step={join_step}",
+              flush=True)
+
+    set_evacuation_handler(on_evacuate)
+    ctl = PolicyController(
+        feed=SnapshotFeed(lambda: read_latest_snapshots(store)),
+        store=store)
+
+joined = False
+for step in range(total):
+    client.send_heartbeat()
+    time.sleep(0.05)
+    if step and step % save_every == 0 and not joined:
+        mgr.save(make_tree(step), iteration=step, is_async=False)
+    # the ramping degradation: the victim's node health worsens round by
+    # round, and the straggler report scores it slower and slower —
+    # nothing hard-faults until the deadline below
+    if rank == victim:
+        health.labels("soak_ramp").set(min(1.0, step / ramp_rounds))
+    if rank == 0:
+        for r in range(world):
+            score = (max(0.2, 1.0 - step / ramp_rounds)
+                     if r == victim else 1.0)
+            strag.labels(str(r)).set(score)
+    agg.round(reg, timeout=60.0)
+    if ctl is not None:
+        step_box["step"] = step
+        ctl.tick()
+    plan_raw = store.try_get(f"soakev/c{cycle}/evacuate")
+    if plan_raw is not None and not joined:
+        plan = json.loads(plan_raw.decode()
+                          if isinstance(plan_raw, bytes) else plan_raw)
+        if step >= int(plan["join_step"]):
+            # the handoff: every rank joins the collective restore round;
+            # the evacuated slot drops its resident copy first, so its
+            # bytes must come CHUNK-GRANULAR off peer holders' memory —
+            # never a disk rung, never a global restore
+            it = mgr.find_latest()
+            peer0 = source_bytes("peer_memory")
+            disk0 = (source_bytes("local_disk")
+                     + source_bytes("peer_disk"))
+            if rank == int(plan["victim"]):
+                mgr.drop_resident()
+                out = pipe.warm_join(mgr, make_tree(0), iteration=it)
+                peer_b = int(source_bytes("peer_memory") - peer0)
+                disk_b = int(source_bytes("local_disk")
+                             + source_bytes("peer_disk") - disk0)
+                assert int(out["tree"]["rank_marker"][0]) == rank
+                print(f"soakev[{rank}] JOIN warm={out['warm']} "
+                      f"iter={out['iteration']} peer_b={peer_b} "
+                      f"disk_b={disk_b} "
+                      f"dur_ms={out['dur_ms']:.1f}", flush=True)
+            else:
+                mgr.load(make_tree(0), iteration=it)
+            joined = True
+            break  # every rank leaves at the SAME plan step
+    if rank == victim and step >= deadline_step and not joined:
+        print(f"soakev[{rank}] HARD FAULT at step {step}", flush=True)
+        os._exit(41)
+# gang-synchronized exit (a lone early exit reads as a failure to the
+# launcher ring and would restart the gang)
+store.set(f"soakev/c{cycle}/done/r{rank}", "1")
+t_barrier = time.monotonic()
+while time.monotonic() - t_barrier < 120.0:
+    client.send_heartbeat()
+    if all(store.try_get(f"soakev/c{cycle}/done/r{r}") is not None
+           for r in range(world)):
+        break
+    time.sleep(0.2)
+print(f"soakev[{rank}] result=done joined={joined}", flush=True)
+"""
+
+
 WORKLOAD_GOODPUT = r"""
 import json, os, sys, time
 sys.path.insert(0, os.environ["TPURX_REPO"])
@@ -720,6 +870,14 @@ def main() -> None:
                         "serving rank mid-restore drill; the other ranks' "
                         "ladders must fall through to their own disk with "
                         "fallback depth 0")
+    p.add_argument("--ramp-degrade", action="store_true",
+                   help="predict-and-evacuate campaign: one rank's health "
+                        "and straggler scores ramp worse round by round; "
+                        "the policy's fused rank risk must EVACUATE it "
+                        "(checkpoint-ahead, published record, peer "
+                        "warm-join with zero disk bytes) before its "
+                        "hard-fault deadline, and never evacuate a "
+                        "healthy rank")
     p.add_argument("--link-degrade", action="store_true",
                    help="self-healing-collectives campaign: one rank's "
                         "primary collective lane is fault-armed to stall "
@@ -774,6 +932,8 @@ def main() -> None:
     with open(wl_path, "w") as f:
         if args.goodput_arm:
             f.write(WORKLOAD_GOODPUT)
+        elif args.ramp_degrade:
+            f.write(WORKLOAD_EVAC)
         elif args.link_degrade:
             f.write(WORKLOAD_COLL)
         elif args.corrupt_blob or args.peer_mem_kill:
@@ -854,6 +1014,17 @@ def main() -> None:
         })
         if not args.corrupt_blob:
             env["SOAK_CORRUPT_STEP"] = "-1"  # drill only, no corruption leg
+    if args.ramp_degrade:
+        env.update({
+            "SOAK_CKPT_ROOT": os.path.join(workdir, "lckpt"),
+            "SOAK_LCKPT_EVERY": "5",
+            "SOAK_EVAC_VICTIM": "1",
+            "SOAK_EVAC_RAMP_ROUNDS": "12",
+            "SOAK_EVAC_DEADLINE": "45",
+            "TPURX_EVAC": "1",
+            # saves/tree rounds/joins pause heartbeats briefly
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "15.0",
+        })
     if args.link_degrade:
         env.update({
             # stall rank 0's PRIMARY collective lane past its deadline;
@@ -1098,6 +1269,48 @@ def main() -> None:
             b >= a for a, b in zip(progress_samples, progress_samples[1:])
         )
         final = len(marks)
+    # predict-and-evacuate campaign (--ramp-degrade): the fused rank risk
+    # must evacuate the ramping victim BEFORE its hard-fault deadline and
+    # never touch a healthy rank, and the victim's slot must warm-join
+    # chunk-granular off peer memory (zero disk bytes, no global restore)
+    evac_report: dict = {}
+    evac_ok = True
+    if args.ramp_degrade:
+        import re as re_mod
+
+        evacs = [
+            (int(r), int(s))
+            for r, s in re_mod.findall(
+                r"soakev\[0\] EVACUATE rank=(\d+) at step=(\d+)", out)
+        ]
+        joins = [
+            (r, int(it), int(pb), int(db))
+            for r, it, pb, db in re_mod.findall(
+                r"soakev\[\d+\] JOIN warm=(\w+) iter=(\d+) peer_b=(\d+) "
+                r"disk_b=(\d+)", out)
+        ]
+        hard_faults = out.count("] HARD FAULT at step")
+        done = len(re_mod.findall(r"soakev\[\d+\] result=done joined=True",
+                                  out))
+        victim_rank = 1
+        evac_ok = bool(
+            evacs
+            and {r for r, _s in evacs} == {victim_rank}  # nobody healthy
+            and hard_faults == 0                # fired before the deadline
+            and joins
+            and all(w == "True" and pb > 0 and db == 0
+                    for w, _it, pb, db in joins)
+            and done == args.nproc
+        )
+        evac_report = {
+            "ramp_degrade": True,
+            "evacuations": evacs,
+            "evac_joins": joins,
+            "hard_faults": hard_faults,
+            "evac_ok": evac_ok,
+        }
+        monotone = True
+        final = done
     ckpt_report: dict = {}
     ckpt_ok = True
     if args.corrupt_blob:
@@ -1140,7 +1353,9 @@ def main() -> None:
         # not the progress file — those checks don't apply
         monotone = True
         final = max((r[1] for r in restores), default=0)
-    if args.corrupt_blob:
+    if args.ramp_degrade:
+        ok = bool(evac_ok)
+    elif args.corrupt_blob:
         ok = bool(ckpt_ok and peer_ok and cycles >= 1)
     elif args.link_degrade:
         ok = bool(coll_ok and monotone)
@@ -1174,6 +1389,7 @@ def main() -> None:
                 "saves_ok": saves_ok,
                 **coll_report,
                 **peer_report,
+                **evac_report,
                 **ckpt_report,
                 "ok": ok,
             }

@@ -641,9 +641,9 @@ def test_two_rank_soak_black_boxes_and_aligned_timeline(
         and e["args"].get("episode") == "ep1"
     ]
     phase_names = {e["name"].replace(" (unfinished)", "") for e in ep_spans}
-    assert phase_names >= set(episode_mod.PHASES), (
+    assert phase_names >= set(episode_mod.REACTIVE_PHASES), (
         f"episode phases missing from merged trace: "
-        f"{set(episode_mod.PHASES) - phase_names}"
+        f"{set(episode_mod.REACTIVE_PHASES) - phase_names}"
     )
     assert {e["pid"] for e in ep_spans} == {0, 1}
     flows = [
@@ -675,7 +675,7 @@ def test_two_rank_soak_black_boxes_and_aligned_timeline(
         eps = episode_mod.read_episodes(client, n=5)
         assert eps and eps[0]["id"] == "ep1"
         phase_ns = eps[0]["phase_ns"]
-        assert set(phase_ns) >= set(episode_mod.PHASES)
+        assert set(phase_ns) >= set(episode_mod.REACTIVE_PHASES)
         assert all(v > 0 for v in phase_ns.values())
 
         monitor = types.SimpleNamespace(episode_store=client)

@@ -162,6 +162,34 @@ def test_soak_smoke_store_outage_mid_save():
     assert report["monotone_progress"], report
 
 
+def test_soak_smoke_ramp_degrade_evacuates_before_hard_fault():
+    """The predict-and-evacuate campaign: one rank's health/straggler
+    scores ramp worse round by round; the fused per-rank risk must
+    evacuate it BEFORE its hard-fault deadline (zero HARD FAULT markers),
+    never evacuate the healthy rank, and the evacuated slot must
+    warm-join from peer memory with zero disk bytes — no global
+    restore."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "120", "--ramp-degrade",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["evac_ok"], report
+    assert report["hard_faults"] == 0, report
+    # only the ramping victim was evacuated, exactly once
+    assert [r for r, _s in report["evacuations"]] == [1], report
+    # the slot's replacement joined warm: peer bytes, zero disk bytes
+    for warm, _it, peer_b, disk_b in report["evac_joins"]:
+        assert warm == "True" and peer_b > 0 and disk_b == 0, report
+
+
 def test_fault_schedule_generation_is_deterministic():
     """Same seed -> byte-identical injection timeline (the property the
     adaptive-vs-fixed A/B rests on); different seed -> different draws;

@@ -185,7 +185,8 @@ def test_exporter_scrapes_all_series_during_restart_and_save(
         "tpurx_ckpt_saves_total",
         "tpurx_ckpt_stage_bytes_total",
         "tpurx_ckpt_drain_progress",
-        'tpurx_straggler_verdicts_total{straggler="false"}',
+        'tpurx_straggler_verdicts_total{verdict="nominal"}',
+        'tpurx_straggler_score{rank="0"}',
         "tpurx_log_forwarder_dropped_total",
         "tpurx_store_ops_total",
         "tpurx_monitor_trips_total",

@@ -489,3 +489,27 @@ def default_ladder(ops=None, rank: Optional[int] = None,
         ShrinkMeshStage(),
         ClearJaxCaches(),
     )
+
+
+def evacuation_ladder(victim_rank: int, rank: Optional[int] = None,
+                      *extra_stages) -> Optional[AbortLadder]:
+    """Victim-scoped teardown for a policy-driven evacuation.
+
+    Unlike the reactive ``default_ladder`` (which every rank walks after a
+    fault fired), an evacuation tears down ONE predicted-to-fail rank
+    while the survivors keep training: only the victim gets a ladder —
+    mesh-shrink force-enabled (evacuation IS a planned shrink; the opt-in
+    gate guards the measured-risk reactive path, not a deliberate
+    decision) plus whatever engine-teardown stages the caller composes in.
+    Every other rank gets ``None`` and must not run anything.
+    """
+    if rank is None:
+        rank = env.RANK.get()
+    if rank != victim_rank:
+        return None
+    return AbortLadder(
+        *extra_stages,
+        ShrinkMeshStage(enabled=True),
+        ClearJaxCaches(),
+        name="evacuate",
+    )

@@ -21,6 +21,7 @@ import dataclasses
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..telemetry import gauge
 from ..utils.logging import get_logger
 
 log = get_logger("coll.health")
@@ -30,6 +31,15 @@ log = get_logger("coll.health")
 SUSPECT_AFTER = 2
 
 _EWMA_ALPHA = 0.2
+
+# the RankRiskModel's route component: worst consecutive-trip pressure
+# across this process's routes, normalized so a route reaching suspect is
+# 0.5 and saturation needs sustained tripping past it
+_SUSPECT_BIAS = gauge(
+    "tpurx_route_suspect_bias",
+    "Worst consecutive-timeout pressure across this rank's collective "
+    "routes, 0-1 (0.5 = a route just crossed the suspect threshold)",
+)
 
 
 @dataclasses.dataclass
@@ -67,6 +77,13 @@ class RouteHealth:
                 st = self._routes[key] = RouteState(op=op, axis=axis)
             return st
 
+    def _bias_locked(self) -> float:
+        worst = max(
+            (st.consecutive_timeouts for st in self._routes.values()),
+            default=0,
+        )
+        return min(1.0, worst / float(2 * SUSPECT_AFTER))
+
     def note_ok(self, op: str, axis: str, latency_ns: int) -> None:
         st = self.route(op, axis)
         with self._lock:
@@ -78,12 +95,16 @@ class RouteHealth:
                 st.ewma_latency_ns += _EWMA_ALPHA * (
                     latency_ns - st.ewma_latency_ns
                 )
+            bias = self._bias_locked()
+        _SUSPECT_BIAS.set(bias)
 
     def note_timeout(self, op: str, axis: str) -> None:
         st = self.route(op, axis)
         with self._lock:
             st.timeout_count += 1
             st.consecutive_timeouts += 1
+            bias = self._bias_locked()
+        _SUSPECT_BIAS.set(bias)
 
     def note_degrade(self, op: str, axis: str, action: str) -> None:
         st = self.route(op, axis)
@@ -102,6 +123,8 @@ class RouteHealth:
             if action not in ("", "retry"):
                 st.start_rung = action
                 st.start_rung_reason = "recovered via this rung"
+            bias = self._bias_locked()
+        _SUSPECT_BIAS.set(bias)
 
     def start_rung(self, op: str, axis: str = "") -> str:
         """Rung the ladder should start at for this route ('' = top)."""
@@ -120,6 +143,8 @@ class RouteHealth:
             st.start_rung = ""
             st.start_rung_reason = ""
             st.consecutive_timeouts = 0
+            bias = self._bias_locked()
+        _SUSPECT_BIAS.set(bias)
 
     def apply_verdict(self, verdict) -> None:
         """Consume a trace-analyzer :class:`DegradeVerdict` on the restart
@@ -161,3 +186,4 @@ def _reset_health_for_tests() -> None:
     global _health
     with _health_lock:
         _health = None
+    _SUSPECT_BIAS.set(0.0)

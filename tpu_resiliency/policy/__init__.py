@@ -9,6 +9,12 @@ the **ledger** scores restart/degrade rungs per fault class, and the
 **controller** ticks the loop, journals every decision to the store, and
 exports ``tpurx_policy_*`` metrics.
 
+Predict-and-evacuate (ISSUE 18): the **risk model** fuses per-rank
+straggler/health/kmsg/route signals into damped risk scores, and the
+**evacuation pipeline** converts an over-threshold rank into a planned,
+checkpoint-warm handoff (checkpoint-ahead → spare promotion →
+victim-scoped shrink → peer warm join) instead of a reactive restart.
+
 Job-level hosting lives in ``services/smonsvc.py`` (tree-gathered
 snapshots → decisions published to the store); the per-rank client in
 ``fault_tolerance/control_plane.py`` applies published decisions locally.
@@ -23,6 +29,12 @@ from .estimator import (
     young_daly_interval,
 )
 from .ledger import RungLedger, RungStats, ledger, _reset_ledger_for_tests
+from .risk import RankRiskModel, RankSignals
+from .evacuation import (
+    EvacuationPipeline,
+    promote_via_shard_map,
+    set_evacuation_handler,
+)
 from .controller import (
     K_DECISION_LATEST,
     PolicyController,
@@ -41,6 +53,11 @@ __all__ = [
     "RungLedger",
     "RungStats",
     "ledger",
+    "RankRiskModel",
+    "RankSignals",
+    "EvacuationPipeline",
+    "promote_via_shard_map",
+    "set_evacuation_handler",
     "PolicyController",
     "K_DECISION_LATEST",
     "decisions_from_json",

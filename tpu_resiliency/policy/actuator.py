@@ -61,6 +61,7 @@ class Actuator:
     def __init__(self, max_replication: int = 4):
         self.max_replication = int(max_replication)
         self._armed: Dict[str, str] = {}  # fault_class -> rung (no-op filter)
+        self._evacuated: set = set()  # ranks already evacuated (one-shot)
 
     # -- save cadence ------------------------------------------------------
 
@@ -159,6 +160,36 @@ class Actuator:
             "set_degrade_ladder", env.COLL_DEGRADE.name, composition, reason
         )
 
+    # -- evacuation --------------------------------------------------------
+
+    def evacuate(self, rank: int, reason: str) -> Optional[Action]:
+        """Emit the one-shot ``evacuate`` action for ``rank`` and dispatch
+        it to the installed pipeline handler (the deciding controller's
+        local side).  A rank is evacuated at most once per actuator — a
+        risk score lingering above threshold must not re-fire on a slot
+        already being handed off."""
+        rank = int(rank)
+        if rank in self._evacuated:
+            return None
+        self._evacuated.add(rank)
+        action = Action("evacuate", f"rank:{rank}", str(rank), reason)
+        log.warning("evacuate rank %d (%s)", rank, reason)
+        self._dispatch_evacuation(rank, reason)
+        return action
+
+    @staticmethod
+    def _dispatch_evacuation(rank: int, reason: str) -> None:
+        from .evacuation import get_evacuation_handler
+
+        handler = get_evacuation_handler()
+        if handler is None:
+            log.warning(
+                "no evacuation handler installed; evacuate(rank=%d) is "
+                "journal-only on this process", rank,
+            )
+            return
+        handler(rank, reason)
+
     # -- remote application ------------------------------------------------
 
     def apply(self, action: Action) -> None:
@@ -171,6 +202,16 @@ class Actuator:
             self._armed[fault_class] = action.value
             if action.value == "mesh_shrink":
                 env.set_runtime_override(env.SHRINK_MESH.name, "1")
+            return
+        # evacuate targets a rank, not a knob: dispatch to the installed
+        # pipeline handler (MUST precede the override path — "rank:N" is
+        # not a declared knob and would KeyError there)
+        if action.kind == "evacuate" and action.target.startswith("rank:"):
+            rank = int(action.target.split(":", 1)[1])
+            if rank in self._evacuated:
+                return
+            self._evacuated.add(rank)
+            self._dispatch_evacuation(rank, action.reason)
             return
         if action.value == "":
             env.clear_runtime_override(action.target)

@@ -25,7 +25,7 @@ import json
 import math
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..telemetry import flight
 from ..telemetry import episode as episode_mod
@@ -34,6 +34,7 @@ from ..utils import env
 from ..utils.logging import get_logger
 from .actuator import Action, Actuator
 from .estimator import GoodputEstimator, TelemetryFeed
+from .evacuation import EV_RISK_CROSS
 from .ledger import ledger
 
 log = get_logger("policy.controller")
@@ -60,6 +61,11 @@ _MTBF = gauge(
     labels=("fault_class",))
 _NODE_RISK = gauge(
     "tpurx_policy_node_risk", "Worst per-node failure risk score (0-1).")
+_RANK_RISK = gauge(
+    "tpurx_policy_rank_risk",
+    "Fused per-rank failure risk score (0-1): straggler deficit, health "
+    "window, kmsg hard rate and route bias, EWMA-damped.",
+    labels=("rank",))
 _GOODPUT_EST = gauge(
     "tpurx_policy_goodput_est",
     "Modeled goodput fraction at the currently-set cadence.")
@@ -89,6 +95,12 @@ class PolicyController:
         self.seq = 0
         self.journal: List[dict] = []  # in-memory tail (tests, /status)
         self._risk_armed = False
+        # evacuation trigger state: consecutive over-threshold ticks per
+        # rank (false-positive guard) and per-rank re-arm latches
+        # (hysteresis: a score oscillating around the threshold must not
+        # re-fire until it decays below the re-arm level)
+        self._evac_streak: Dict[int, int] = {}
+        self._evac_armed: Dict[int, bool] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -101,6 +113,7 @@ class PolicyController:
         actions: List[Action] = []
         actions += self._decide_cadence()
         actions += self._decide_risk()
+        actions += self._decide_evacuate()
         actions += self._decide_rungs()
         self._export_gauges()
         if actions:
@@ -156,6 +169,52 @@ class PolicyController:
             self._risk_armed = False
         return actions
 
+    # false-positive guard: the fused score must hold above threshold for
+    # this many consecutive ticks before evacuation fires
+    _EVAC_STREAK_TICKS = 2
+
+    def _decide_evacuate(self) -> List[Action]:
+        """Predict-and-evacuate: one rank whose fused risk held above
+        ``TPURX_EVAC_RISK_THRESHOLD`` for consecutive ticks gets the
+        typed ``evacuate`` action (checkpoint-ahead + spare promotion +
+        victim-scoped shrink ride on the installed pipeline handler).
+        Runs after :meth:`_decide_risk` so global hardening (replication
+        bump, delta saves) is always armed at or before evacuation."""
+        if not env.EVAC.get():
+            return []
+        est = self.estimator
+        threshold = env.EVAC_RISK_THRESHOLD.get()
+        rearm_level = threshold * (
+            1.0 - env.EVAC_HYSTERESIS_PCT.get() / 100.0
+        )
+        actions: List[Action] = []
+        for rank, risk in sorted(est.rank_risk.items()):
+            if risk >= threshold:
+                if not self._evac_armed.get(rank, True):
+                    continue  # latched until risk decays below re-arm
+                streak = self._evac_streak.get(rank, 0) + 1
+                self._evac_streak[rank] = streak
+                if streak < self._EVAC_STREAK_TICKS:
+                    continue
+                flight.record(
+                    EV_RISK_CROSS, rank, round(risk, 4),
+                    episode_mod.current_or_store_id(self.store),
+                )
+                act = self.actuator.evacuate(
+                    rank,
+                    f"fused risk {risk:.2f} >= {threshold:.2f} for "
+                    f"{streak} ticks",
+                )
+                self._evac_armed[rank] = False
+                self._evac_streak[rank] = 0
+                if act:
+                    actions.append(act)
+            else:
+                self._evac_streak[rank] = 0
+                if risk <= rearm_level:
+                    self._evac_armed[rank] = True
+        return actions
+
     def _decide_rungs(self) -> List[Action]:
         est = self.estimator
         led = ledger()
@@ -199,6 +258,8 @@ class PolicyController:
                 0.0 if math.isinf(mtbf) else mtbf
             )
         _NODE_RISK.set(est.node_risk)
+        for rank, risk in est.rank_risk.items():
+            _RANK_RISK.labels(str(rank)).set(risk)
 
     # -- journal -----------------------------------------------------------
 

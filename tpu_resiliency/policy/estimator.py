@@ -33,6 +33,7 @@ from typing import Callable, Dict, Mapping, Optional
 from ..telemetry.registry import RateWindow, Registry, get_registry
 from ..utils import env
 from ..utils.logging import get_logger
+from .risk import RankRiskModel, RankSignals
 
 log = get_logger("policy.estimator")
 
@@ -66,6 +67,10 @@ class EstimatorInputs:
     node_risk: float = 0.0
     # cumulative kmsg hard faults (node-death leading indicator)
     kmsg_hard_total: float = 0.0
+    # per-rank raw indicator readings for the fused RankRiskModel
+    rank_signals: Dict[int, RankSignals] = dataclasses.field(
+        default_factory=dict
+    )
 
 
 def _family_sum(
@@ -112,10 +117,36 @@ def _hist_mean_s(reg: Registry, name: str) -> Optional[float]:
 
 
 class TelemetryFeed:
-    """Inputs from this process's metric registry (per-rank shape)."""
+    """Inputs from this process's metric registry (per-rank shape).
 
-    def __init__(self, registry: Optional[Registry] = None):
+    ``rank`` attributes this process's node-local indicators (health,
+    kmsg, route bias) to a rank id in ``rank_signals``; straggler scores
+    carry their own ``{rank}`` label (the report holder publishes every
+    rank's score), so a single-process feed still sees the whole gang's
+    straggler axis."""
+
+    def __init__(self, registry: Optional[Registry] = None, rank: int = 0):
         self._reg = registry
+        self._rank = rank
+
+    @staticmethod
+    def _rank_signals(reg: Registry, own_rank: int,
+                      kmsg_hard: float) -> Dict[int, RankSignals]:
+        signals: Dict[int, RankSignals] = {}
+        metric = reg.get("tpurx_straggler_score")
+        if metric is not None:
+            for labels, value in metric._sample_rows():
+                try:
+                    rank = int(labels.get("rank", ""))
+                except ValueError:
+                    continue
+                sig = signals.setdefault(rank, RankSignals())
+                sig.straggler_score = float(value.get("value", 1.0))
+        own = signals.setdefault(own_rank, RankSignals())
+        own.health_score = _family_max(reg, "tpurx_health_score")
+        own.kmsg_hard_total = kmsg_hard
+        own.route_bias = _family_max(reg, "tpurx_route_suspect_bias")
+        return signals
 
     def collect(self) -> EstimatorInputs:
         reg = self._reg or get_registry()
@@ -129,14 +160,16 @@ class TelemetryFeed:
             "hang": _family_sum(reg, "tpurx_monitor_trips_total"),
             "collective": _family_sum(reg, "tpurx_collective_timeouts_total"),
         }
+        kmsg_hard = _family_sum(
+            reg, "tpurx_kmsg_faults_total", {"class": "hard"}
+        )
         return EstimatorInputs(
             fault_counts=counts,
             ckpt_cost_s=_hist_mean_s(reg, "tpurx_ckpt_save_call_ns"),
             recovery_cost_s=_hist_mean_s(reg, "tpurx_restart_total_latency_ns"),
             node_risk=_family_max(reg, "tpurx_health_score"),
-            kmsg_hard_total=_family_sum(
-                reg, "tpurx_kmsg_faults_total", {"class": "hard"}
-            ),
+            kmsg_hard_total=kmsg_hard,
+            rank_signals=self._rank_signals(reg, self._rank, kmsg_hard),
         )
 
 
@@ -189,6 +222,42 @@ class SnapshotFeed:
                 worst = max(worst, float(sample.get("value", 0.0)))
         return worst
 
+    @classmethod
+    def _rank_signals(
+        cls, snapshots: Dict[int, dict]
+    ) -> Dict[int, RankSignals]:
+        """Per-rank indicator readings: each rank's own snapshot carries
+        its node-local health/kmsg/route series, while straggler scores
+        ride a ``{rank}`` label on whichever rank held the report round
+        (rank 0) — so the straggler axis is scanned across ALL snapshots
+        and assigned by label."""
+        signals: Dict[int, RankSignals] = {}
+        for rank, snap in snapshots.items():
+            one = {rank: snap}
+            signals[int(rank)] = RankSignals(
+                health_score=cls._max(one, "tpurx_health_score"),
+                kmsg_hard_total=cls._sum(
+                    one, "tpurx_kmsg_faults_total", {"class": "hard"}
+                ),
+                route_bias=cls._max(one, "tpurx_route_suspect_bias"),
+            )
+        for snap in snapshots.values():
+            fam = snap.get("tpurx_straggler_score")
+            if not fam:
+                continue
+            for sample in fam.get("samples", ()):
+                try:
+                    rank = int(sample.get("labels", {}).get("rank", ""))
+                except ValueError:
+                    continue
+                sig = signals.setdefault(rank, RankSignals())
+                # several publishers (stale holder + current): keep the
+                # worst (lowest) score for the rank
+                sig.straggler_score = min(
+                    sig.straggler_score, float(sample.get("value", 1.0))
+                )
+        return signals
+
     def collect(self) -> EstimatorInputs:
         snaps = self._snapshots_fn() or {}
         counts = {
@@ -212,6 +281,7 @@ class SnapshotFeed:
             kmsg_hard_total=self._sum(
                 snaps, "tpurx_kmsg_faults_total", {"class": "hard"}
             ),
+            rank_signals=self._rank_signals(snaps),
         )
 
 
@@ -232,6 +302,8 @@ class GoodputEstimator:
         self.recovery_cost_s: Optional[float] = None
         self.node_risk = 0.0
         self.kmsg_hard_rate = 0.0
+        self.rank_model = RankRiskModel(window_s=self.window_s)
+        self.rank_risk: Dict[int, float] = {}
         self.updates = 0
 
     # -- observation -------------------------------------------------------
@@ -262,8 +334,20 @@ class GoodputEstimator:
                 self.recovery_cost_s += _EWMA_ALPHA * (
                     inputs.recovery_cost_s - self.recovery_cost_s
                 )
-        self.node_risk = max(0.0, min(1.0, float(inputs.node_risk)))
+        self.rank_risk = self.rank_model.update(inputs.rank_signals, now=t)
+        # node risk keeps its gauge semantics but now also reflects the
+        # worst FUSED per-rank score, so the pre-existing hardening
+        # rung (replication/delta) always arms at or before evacuation
+        worst_rank_risk = max(self.rank_risk.values(), default=0.0)
+        self.node_risk = max(
+            0.0, min(1.0, max(float(inputs.node_risk), worst_rank_risk))
+        )
         self.updates += 1
+
+    def worst_rank(self) -> tuple:
+        """(rank, fused risk) of the riskiest rank; (None, 0.0) when no
+        per-rank signals have been observed."""
+        return self.rank_model.worst()
 
     # -- model -------------------------------------------------------------
 
@@ -333,6 +417,7 @@ class GoodputEstimator:
             "ckpt_cost_s": c,
             "recovery_cost_s": r,
             "node_risk": self.node_risk,
+            "rank_risk": {str(r): v for r, v in sorted(self.rank_risk.items())},
             "kmsg_hard_rate": self.kmsg_hard_rate,
             "tau_opt_s": None if math.isinf(self.tau_opt()) else self.tau_opt(),
             "updates": self.updates,

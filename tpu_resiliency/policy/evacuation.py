@@ -1,0 +1,321 @@
+"""The evacuation pipeline: planned, checkpoint-warm removal of one rank.
+
+Reactive recovery pays the full episode — fault, abort ladder,
+rendezvous, restore — after work is already lost.  When the
+:class:`~tpu_resiliency.policy.risk.RankRiskModel` flags a rank *before*
+it dies, the controller emits a typed ``evacuate(rank)`` action and this
+pipeline converts the would-be restart into a planned handoff:
+
+1. **checkpoint-ahead** — bump local replication and force an
+   out-of-cadence save so the victim's shards are peer-held (memory-
+   resident on clique peers) before the rank goes away;
+2. **spare promotion** — when the victim co-hosts a control-plane store
+   shard, re-point it to a spare via the CAS'd epoch bump
+   (:func:`~tpu_resiliency.store.sharding.promote_spare`);
+3. **victim-scoped shrink** — the victim (and ONLY the victim) walks
+   :func:`~tpu_resiliency.inprocess.abort.evacuation_ladder`; survivors
+   keep training;
+4. **warm join** — the replacement loads the victim's shards
+   chunk-granular from peer holders' resident copies
+   (:meth:`LocalCheckpointManager._peer_memory_fetch` over the existing
+   ``PeerExchange`` request protocol) instead of forcing a global
+   restore round, bounded by ``TPURX_EVAC_JOIN_TIMEOUT``.
+
+Every step is a phase of an ``evacuation`` fault episode (the new
+``evacuate`` phase in :data:`~tpu_resiliency.telemetry.episode.PHASES`)
+and a flight event, so a merged trace renders the whole handoff as one
+span between ``evac.risk_cross`` and ``evac.join``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..telemetry import counter, flight, histogram
+from ..telemetry import episode as episode_mod
+from ..telemetry.registry import get_registry
+from ..utils import env
+from ..utils.logging import get_logger
+from .actuator import Actuator
+
+log = get_logger("policy.evacuation")
+
+# the four instants of the predict-and-evacuate loop; risk_cross → join
+# is the evacuation span on the merged trace (trace.SPAN_PAIRS).  The
+# evacuated slot is the "victim" field — "rank" would shadow the dump
+# serializer's emitter-rank tag and corrupt trace track assignment.
+EV_RISK_CROSS = flight.declare_event(
+    "evac.risk_cross", "victim", "risk", "episode"
+)
+EV_CKPT_AHEAD = flight.declare_event("evac.ckpt_ahead", "victim", "episode")
+EV_PROMOTE = flight.declare_event(
+    "evac.promote", "victim", "spare", "episode"
+)
+EV_JOIN = flight.declare_event(
+    "evac.join", "victim", "source", "bytes", "dur_ms", "episode"
+)
+
+_STAGE_NS = histogram(
+    "tpurx_evac_stage_ns",
+    "Per-stage wall time of the evacuation pipeline",
+    labels=("stage",),
+)
+_RANKS = counter(
+    "tpurx_evac_ranks_total",
+    "Evacuation outcomes: ranks evacuated, replacements joined warm "
+    "(peer memory, no global restore) or cold (fell back to disk/peer "
+    "disk), and pipelines that failed mid-flight",
+    labels=("outcome",),
+)
+
+K_EVAC_SEQ = "evac/seq"
+_EVAC_KEEP = 16
+
+
+def _restore_source_bytes() -> Dict[str, float]:
+    """Current per-source totals of ``tpurx_ckpt_restore_source_total``
+    (bytes); deltas around a load attribute the serving rung."""
+    metric = get_registry().get("tpurx_ckpt_restore_source_total")
+    out: Dict[str, float] = {}
+    if metric is None:
+        return out
+    for labels, value in metric._sample_rows():
+        source = labels.get("source", "")
+        out[source] = out.get(source, 0.0) + float(value.get("value", 0.0))
+    return out
+
+
+class EvacuationPipeline:
+    """Orchestrates one rank's evacuation; every collaborator injectable.
+
+    ``save_fn()`` forces the out-of-cadence checkpoint-ahead save (e.g.
+    the gang's ``LocalCheckpointManager.save`` at the current step);
+    ``promote_fn(victim_rank)`` re-points any control-plane shard the
+    victim hosted and returns the spare endpoint (or ``None``);
+    ``shrink_fn(victim_rank)`` tears the victim down — the default runs
+    :func:`~tpu_resiliency.inprocess.abort.evacuation_ladder`, a no-op
+    on every rank but the victim.
+    """
+
+    def __init__(
+        self,
+        store=None,
+        rank: Optional[int] = None,
+        actuator: Optional[Actuator] = None,
+        save_fn: Optional[Callable[[], None]] = None,
+        promote_fn: Optional[Callable[[int], Optional[str]]] = None,
+        shrink_fn: Optional[Callable[[int], Optional[str]]] = None,
+        keep: int = _EVAC_KEEP,
+    ):
+        self.store = store
+        self.rank = env.RANK.get() if rank is None else rank
+        self.actuator = actuator or Actuator()
+        self.save_fn = save_fn
+        self.promote_fn = promote_fn
+        self.shrink_fn = shrink_fn
+        self.keep = max(1, int(keep))
+
+    # -- stages ------------------------------------------------------------
+
+    def _timed(self, stage: str, fn: Callable[[], object]) -> object:
+        t0 = time.monotonic_ns()
+        try:
+            return fn()
+        finally:
+            _STAGE_NS.labels(stage).observe(time.monotonic_ns() - t0)
+
+    def _ckpt_ahead(self, victim_rank: int, reason: str) -> None:
+        base = env.LCKPT_REPLICATION.get() or 2
+        self.actuator.set_replication(max(base, 3), reason)
+        if self.save_fn is not None:
+            self.save_fn()
+
+    def _shrink(self, victim_rank: int) -> Optional[str]:
+        if self.shrink_fn is not None:
+            return self.shrink_fn(victim_rank)
+        from ..inprocess.abort import evacuation_ladder
+
+        ladder = evacuation_ladder(victim_rank, self.rank)
+        if ladder is None:
+            return None  # not the victim: survivors keep training
+        ladder(None)
+        return ladder.summary()
+
+    # -- the pipeline ------------------------------------------------------
+
+    def evacuate(self, victim_rank: int, risk: float = 0.0,
+                 reason: str = "") -> Dict[str, object]:
+        """Run checkpoint-ahead → promote → victim-scoped shrink for
+        ``victim_rank``; returns the published evacuation record."""
+        ep = episode_mod.begin(
+            self.store, fault_class="evacuation", rank=self.rank
+        )
+        ep.phase("decide")
+        ep.phase("evacuate")
+        eid = ep.id
+        why = reason or f"risk {risk:.2f}"
+        log.warning(
+            "evacuating rank %d (%s): checkpoint-ahead + promote + "
+            "victim-scoped shrink", victim_rank, why,
+        )
+        record: Dict[str, object] = {
+            "victim_rank": victim_rank,
+            "risk": risk,
+            "reason": why,
+            "episode": eid,
+            "by_rank": self.rank,
+        }
+        try:
+            self._timed(
+                "ckpt_ahead", lambda: self._ckpt_ahead(victim_rank, why)
+            )
+            flight.record(EV_CKPT_AHEAD, victim_rank, eid)
+            spare = None
+            if self.promote_fn is not None:
+                spare = self._timed(
+                    "promote", lambda: self.promote_fn(victim_rank)
+                )
+            flight.record(EV_PROMOTE, victim_rank, spare or "", eid)
+            record["spare"] = spare
+            record["shrink"] = self._timed(
+                "shrink", lambda: self._shrink(victim_rank)
+            )
+        except Exception as exc:
+            _RANKS.labels("failed").inc()
+            record["error"] = repr(exc)
+            log.exception("evacuation of rank %d failed", victim_rank)
+            raise
+        finally:
+            ep.phase("resume")
+            ep.close()
+            self._publish(record)
+        _RANKS.labels("evacuated").inc()
+        return record
+
+    def _publish(self, record: Dict[str, object]) -> None:
+        if self.store is None:
+            return
+        try:
+            n = self.store.add(K_EVAC_SEQ, 1)
+            self.store.set(f"evac/{n}/record", json.dumps(record).encode())
+            stale = n - self.keep
+            if stale > 0:
+                self.store.delete(f"evac/{stale}/record")
+        except Exception:  # noqa: BLE001 - the record is observability, not control
+            log.debug("evacuation record publish failed", exc_info=True)
+
+    # -- the join side -----------------------------------------------------
+
+    def warm_join(
+        self,
+        manager,
+        template,
+        iteration: Optional[int] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict[str, object]:
+        """Replacement-side warm join: load the evacuated slot's shards
+        through ``manager``'s restore ladder (peer holders' resident
+        copies first — chunk-granular over the existing exchange) inside
+        the ``TPURX_EVAC_JOIN_TIMEOUT`` deadline.  Returns
+        ``{tree, iteration, source_bytes, dur_ms, warm}`` where ``warm``
+        means no byte came off a disk rung (no global restore round).
+        Raises ``TimeoutError`` past the deadline — the caller's cue to
+        fall back to a cold global restore."""
+        budget = env.EVAC_JOIN_TIMEOUT.get() if timeout is None else timeout
+        before = _restore_source_bytes()
+        eid = episode_mod.current_or_store_id(self.store)
+        t0 = time.monotonic_ns()
+        box: Dict[str, object] = {}
+
+        def _load():
+            try:
+                box["result"] = manager.load(template, iteration=iteration)
+            except BaseException as exc:  # noqa: BLE001 - re-raised below
+                box["error"] = exc
+
+        worker = threading.Thread(
+            target=_load, name="tpurx-evac-join", daemon=True
+        )
+        worker.start()
+        worker.join(timeout=budget)
+        dur_ms = (time.monotonic_ns() - t0) / 1e6
+        if worker.is_alive():
+            _RANKS.labels("join_timeout").inc()
+            flight.record(
+                EV_JOIN, self.rank, "timeout", 0, round(dur_ms, 3), eid
+            )
+            raise TimeoutError(
+                f"warm join exceeded TPURX_EVAC_JOIN_TIMEOUT ({budget}s); "
+                "fall back to the cold global restore round"
+            )
+        if "error" in box:
+            raise box["error"]
+        tree, loaded_iter = box["result"]
+        after = _restore_source_bytes()
+        deltas = {
+            src: after.get(src, 0.0) - before.get(src, 0.0)
+            for src in set(before) | set(after)
+            if after.get(src, 0.0) != before.get(src, 0.0)
+        }
+        disk_b = deltas.get("local_disk", 0.0) + deltas.get("peer_disk", 0.0)
+        warm_b = deltas.get("peer_memory", 0.0) + deltas.get(
+            "local_resident", 0.0
+        )
+        warm = disk_b == 0.0
+        source = "peer_memory" if warm else "disk_fallback"
+        _RANKS.labels("join_warm" if warm else "join_cold").inc()
+        flight.record(
+            EV_JOIN, self.rank, source, int(warm_b + disk_b),
+            round(dur_ms, 3), eid,
+        )
+        log.info(
+            "warm join served iteration %s in %.1fms (%s: %s)",
+            loaded_iter, dur_ms, source, deltas,
+        )
+        return {
+            "tree": tree,
+            "iteration": loaded_iter,
+            "source_bytes": deltas,
+            "dur_ms": dur_ms,
+            "warm": warm,
+        }
+
+
+def promote_via_shard_map(map_client, shard_idx: int,
+                          spare_endpoint=None) -> Optional[str]:
+    """``promote_fn`` adapter over the PR 13 epoch-bump path: re-point
+    store shard ``shard_idx`` to a spare and return its endpoint."""
+    from ..store.sharding import promote_spare
+
+    promoted = promote_spare(map_client, shard_idx,
+                             spare_endpoint=spare_endpoint)
+    host, port = promoted.endpoints[shard_idx]
+    return f"{host}:{port}"
+
+
+# -- process-global evacuation handler (Actuator.apply dispatch) -------------
+
+_handler: Optional[Callable[[int, str], None]] = None
+_handler_lock = threading.Lock()
+
+
+def set_evacuation_handler(
+    fn: Optional[Callable[[int, str], None]]
+) -> None:
+    """Install the process's ``evacuate`` action handler
+    (``fn(victim_rank, reason)``; ``None`` uninstalls).  The per-rank
+    policy client replays published actions through
+    ``Actuator.apply`` — an evacuate action dispatches here so each rank
+    runs its own side of the pipeline (victim shrinks, peers keep
+    serving)."""
+    global _handler
+    with _handler_lock:
+        _handler = fn
+
+
+def get_evacuation_handler() -> Optional[Callable[[int, str], None]]:
+    with _handler_lock:
+        return _handler

@@ -1,0 +1,139 @@
+"""Per-rank fused risk model (Guard-style predict-before-fail signal).
+
+The node-level risk the estimator carried until now — worst
+``tpurx_health_score`` across every check — says *something* is sick but
+not *who*, so the controller could only harden globally (replication up,
+delta saves on).  Evacuation needs attribution: ONE rank to checkpoint
+ahead, promote a spare for, and shrink around.
+
+:class:`RankRiskModel` fuses, per rank, the four leading indicators the
+plane already measures:
+
+- **health** — worst ``tpurx_health_score`` on the rank's node (0-1,
+  PR 15 health window);
+- **straggler deficit** — ``1 - individual_score`` from the straggler
+  report round (``tpurx_straggler_score{rank}``), capped below 1 so a
+  slowdown alone must be severe before it implies death;
+- **kmsg hard rate** — windowed rate of
+  ``tpurx_kmsg_faults_total{class="hard"}`` on the rank (any hard fault
+  inside the window saturates the component — it is the strongest
+  death predictor we have);
+- **route bias** — ``RouteHealth`` consecutive-trip pressure
+  (``tpurx_route_suspect_bias``), discounted because a timing-out route
+  blames both endpoints.
+
+Components combine noisy-OR (``1 - prod(1 - c_i)``): independent
+indicators compound instead of averaging each other away, and a single
+saturated indicator (health pegged at 1.0) is sufficient on its own.
+The fused score is EWMA-smoothed per rank and published through a
+dead-band — small flutter never moves the published score, so the
+controller's threshold comparisons see a damped series (the
+trigger-level hysteresis lives in ``TPURX_EVAC_HYSTERESIS_PCT``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional, Tuple
+
+from ..telemetry.registry import RateWindow
+from ..utils import env
+
+# severity scale per component: health and kmsg are direct death
+# predictors (full weight); a straggler alone must be severe, and a
+# suspect route implicates two endpoints, so both are discounted
+_STRAGGLER_CAP = 0.8
+_ROUTE_CAP = 0.6
+
+# EWMA smoothing toward the raw fused score (≈2 ticks to cross a 0.7
+# threshold from a pegged raw signal — pairs with the controller's
+# consecutive-tick guard)
+_ALPHA = 0.5
+
+# published-score dead-band: raw EWMA flutter below this never moves
+# the published score
+_DEADBAND = 0.02
+
+
+def _clamp01(x: float) -> float:
+    return max(0.0, min(1.0, float(x)))
+
+
+@dataclasses.dataclass
+class RankSignals:
+    """One rank's raw indicator readings for one control tick."""
+
+    # worst tpurx_health_score across checks on the rank's node (0-1)
+    health_score: float = 0.0
+    # straggler individual score: 1.0 = nominal, lower = slower
+    straggler_score: float = 1.0
+    # cumulative hard kmsg faults attributed to the rank's node
+    kmsg_hard_total: float = 0.0
+    # RouteHealth consecutive-timeout bias (0-1)
+    route_bias: float = 0.0
+
+
+class RankRiskModel:
+    """Windowed, damped per-rank risk scores; one :meth:`update` per tick."""
+
+    def __init__(self, window_s: Optional[float] = None):
+        self.window_s = (
+            env.POLICY_WINDOW_S.get() if window_s is None else float(window_s)
+        )
+        self._kmsg_rates: Dict[int, RateWindow] = {}
+        self._ewma: Dict[int, float] = {}
+        # the damped scores callers read (dead-banded EWMA)
+        self.scores: Dict[int, float] = {}
+
+    @staticmethod
+    def fuse(signals: RankSignals, kmsg_component: float) -> float:
+        """Noisy-OR fusion of one rank's components (raw, undamped)."""
+        c_health = _clamp01(signals.health_score)
+        c_strag = _STRAGGLER_CAP * _clamp01(1.0 - signals.straggler_score)
+        c_kmsg = _clamp01(kmsg_component)
+        c_route = _ROUTE_CAP * _clamp01(signals.route_bias)
+        survive = 1.0
+        for c in (c_health, c_strag, c_kmsg, c_route):
+            survive *= 1.0 - c
+        return 1.0 - survive
+
+    def update(
+        self,
+        signals: Dict[int, RankSignals],
+        now: Optional[float] = None,
+    ) -> Dict[int, float]:
+        """Fold one tick's per-rank readings in; returns the published
+        (damped) scores.  Ranks absent from ``signals`` decay toward 0 —
+        a rank that stopped reporting must not pin the trigger forever."""
+        t = time.monotonic() if now is None else float(now)
+        for rank, sig in signals.items():
+            rate = self._kmsg_rates.setdefault(rank, RateWindow()).rate(
+                self.window_s, float(sig.kmsg_hard_total), now=t
+            )
+            # >=1 hard fault inside the window saturates the component
+            raw = self.fuse(sig, kmsg_component=rate * self.window_s)
+            prev = self._ewma.get(rank, 0.0)
+            self._ewma[rank] = prev + _ALPHA * (raw - prev)
+        for rank in list(self._ewma):
+            if rank not in signals:
+                self._ewma[rank] *= 1.0 - _ALPHA
+        for rank, ewma in self._ewma.items():
+            published = self.scores.get(rank, 0.0)
+            if abs(ewma - published) >= _DEADBAND or ewma == 0.0:
+                self.scores[rank] = ewma
+        return dict(self.scores)
+
+    def worst(self) -> Tuple[Optional[int], float]:
+        """(rank, score) of the riskiest rank; (None, 0.0) when empty."""
+        if not self.scores:
+            return None, 0.0
+        rank = max(self.scores, key=lambda r: self.scores[r])
+        return rank, self.scores[rank]
+
+    def forget(self, rank: int) -> None:
+        """Drop an evacuated rank's state so its ghost score can never
+        re-trigger (its replacement starts clean)."""
+        self._kmsg_rates.pop(rank, None)
+        self._ewma.pop(rank, None)
+        self.scores.pop(rank, None)

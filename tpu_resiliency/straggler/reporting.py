@@ -24,10 +24,19 @@ from .timers import SectionStats
 _VERDICTS = counter(
     "tpurx_straggler_verdicts_total",
     "Per-rank verdicts produced by identify_stragglers",
-    labels=("straggler",),
+    labels=("verdict",),
 )
 _FLAGGED = gauge(
     "tpurx_straggler_flagged_ranks", "Ranks flagged straggler in the last round"
+)
+# the per-rank performance score the RankRiskModel fuses: published by
+# whichever rank held the report round, so SnapshotFeed sees the whole
+# gang's straggler axis in one snapshot (1.0 = nominal, lower = slower)
+_SCORE = gauge(
+    "tpurx_straggler_score",
+    "Worst of a rank's relative and individual performance scores from "
+    "the last straggler report round (1.0 = nominal, lower = slower)",
+    labels=("rank",),
 )
 
 
@@ -188,7 +197,12 @@ class Report:
                 )
             )
         flagged = sum(1 for v in verdicts if v.is_straggler)
-        _VERDICTS.labels("true").inc(flagged)
-        _VERDICTS.labels("false").inc(len(verdicts) - flagged)
+        _VERDICTS.labels("straggler").inc(flagged)
+        _VERDICTS.labels("nominal").inc(len(verdicts) - flagged)
         _FLAGGED.set(flagged)
+        for v in verdicts:
+            score = v.relative_score
+            if v.individual_score is not None:
+                score = min(score, v.individual_score)
+            _SCORE.labels(str(v.rank)).set(score)
         return verdicts

@@ -35,7 +35,12 @@ from .clock import mono_ns
 
 log = get_logger("telemetry.episode")
 
-PHASES = ("detect", "decide", "abort", "rendezvous", "restore", "resume")
+PHASES = (
+    "detect", "decide", "evacuate", "abort", "rendezvous", "restore", "resume"
+)
+# phases a REACTIVE episode (fault fired first) walks; "evacuate" only
+# appears when the policy's predict-and-evacuate loop preempted the fault
+REACTIVE_PHASES = tuple(p for p in PHASES if p != "evacuate")
 
 _PHASE_NS = histogram(
     "tpurx_episode_phase_ns",

@@ -55,6 +55,10 @@ SPAN_PAIRS: Dict[str, Tuple[str, str, str]] = {
     "collective.dispatch": ("collective.settle", "collective", "collective"),
     "ckpt.drain_begin": ("ckpt.drain_end", "ckpt_drain", "checkpointing"),
     "ckpt.restore_begin": ("ckpt.restore_end", "ckpt_restore", "checkpointing"),
+    # predict-and-evacuate: risk crossing → replacement's warm join is
+    # the planned-handoff MTTR span (evac.ckpt_ahead / evac.promote
+    # render as instants inside it)
+    "evac.risk_cross": ("evac.join", "evacuation", "evac"),
 }
 _END_TO_START = {end: start for start, (end, _, _) in SPAN_PAIRS.items()}
 

@@ -520,6 +520,32 @@ POLICY_RISK_THRESHOLD = Knob(
     "Node failure-risk score (0-1) above which the controller raises "
     "replication and flips delta saves on ahead of the predicted "
     "failure.", group="policy")
+EVAC = Knob(
+    "TPURX_EVAC", bool, False,
+    "Enable predict-and-evacuate: when a rank's fused risk score "
+    "(straggler + health + kmsg + route bias) crosses the evacuation "
+    "threshold, the controller emits a typed evacuate(rank) action that "
+    "drives checkpoint-ahead, spare promotion, and a victim-scoped mesh "
+    "shrink before the predicted hard fault.", group="policy")
+EVAC_RISK_THRESHOLD = Knob(
+    "TPURX_EVAC_RISK_THRESHOLD", float, 0.7,
+    "Per-rank fused risk score (0-1) above which the controller "
+    "evacuates the rank.  Must hold for two consecutive ticks (false-"
+    "positive guard); deliberately above TPURX_POLICY_RISK_THRESHOLD so "
+    "checkpoint-ahead hardening always precedes evacuation.",
+    group="policy")
+EVAC_HYSTERESIS_PCT = Knob(
+    "TPURX_EVAC_HYSTERESIS_PCT", float, 25.0,
+    "Relative margin (percent) below TPURX_EVAC_RISK_THRESHOLD a rank's "
+    "risk must fall before the evacuation trigger re-arms — damping "
+    "against a score oscillating around the threshold re-evacuating on "
+    "every crossing.", group="policy")
+EVAC_JOIN_TIMEOUT = Knob(
+    "TPURX_EVAC_JOIN_TIMEOUT", float, 60.0,
+    "Deadline (seconds) for the replacement rank's warm join: fetching "
+    "the evacuated rank's shards chunk-granular from peer holders.  Past "
+    "it the join falls back to the cold global-restore round.",
+    group="policy")
 CKPT_INTERVAL_S = Knob(
     "TPURX_CKPT_INTERVAL_S", float, None,
     "Target seconds between async checkpoint saves; SaveScheduler reads "

@@ -163,6 +163,9 @@ class StoreKeyLifecycleRule(Rule):
         "tpu_resiliency/inprocess/",
         "tpu_resiliency/checkpointing/local/",
         "tpu_resiliency/fault_tolerance/rendezvous.py",
+        # the policy engine's journal and evacuation records (ISSUE 18):
+        # every published decision/evac key needs its keep-window GC
+        "tpu_resiliency/policy/",
     )
     # the store implementation itself (set/delete here are the ops, not
     # protocol-round usage); tree.py is the sanctioned GC discipline home
