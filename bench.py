@@ -371,6 +371,9 @@ def _compose_line(partial: dict, platform: str) -> dict:
         "store_fanin_p50_us", "store_fanin_p50_sharded_us",
         "store_shard_speedup", "store_fanin_ok", "store_fanin_gate_waived",
         "store_rdzv_close_ms", "store_rdzv_close_sharded_ms",
+        "store_fanin_p99_shared_us", "store_fanin_p99_mux_us",
+        "store_mux_speedup", "store_mux_ok", "store_mux_gate_waived",
+        "store_interrupt_latency_ms",
         "rdzv10k_ranks", "rdzv10k_shards", "rdzv_close_10k_ms",
         "rdzv_close_10k_pr6_ms", "rdzv10k_speedup", "rdzv10k_ok",
         "rdzv10k_gate_waived", "barrier_arrival_rtts", "rdzv_join_rtts",
@@ -1465,6 +1468,123 @@ def bench_store_fanin(time_left_fn) -> dict:
             p.kill()
 
 
+def bench_store_mux(time_left_fn) -> dict:
+    """Multiplexed-client A/B plus the interrupt-latency contract number.
+
+    Both arms drive one shard SUBPROCESS (real parallelism against this
+    driver) from 32 threads in a closed loop, every thread SETting and
+    TRY_GETting its own key through ONE shared client object — the
+    process model the mux exists for (monitor threads, checkpoint drains
+    and the main loop sharing a per-shard connection):
+
+    (a) classic ``StoreClient``: the client lock holds each FULL
+        request/response RTT, so concurrent callers queue head-of-line;
+    (b) ``MuxStoreClient``: whole frames leave under a short send lock
+        with correlation ids and replies route out of order, so the RTTs
+        of concurrent callers overlap on the single socket.
+
+    Gate: ``store_mux_speedup`` (p99 ratio) >= 2x, waived on a 1-core
+    host where client, server and receiver thread share one core.
+
+    ``store_interrupt_latency_ms``: a thread parked in a server-held
+    ``wait()`` receives ``PyThreadState_SetAsyncExc``; the poll-quantum
+    I/O core must land the raise between slices.  Reported: the worst
+    landing latency over the trials (contract: ~2x TPURX_STORE_POLL_S)."""
+    import ctypes
+    import threading
+
+    from tpu_resiliency.store.client import StoreClient
+    from tpu_resiliency.store.mux import MuxStoreClient
+    from tpu_resiliency.store.sharding import free_port, spawn_shard_subprocess
+    from tpu_resiliency.utils.env import disarm_platform_sitecustomize
+
+    shard_env = {"JAX_PLATFORMS": "cpu"}
+    disarm_platform_sitecustomize(shard_env)
+    port = free_port()
+    proc = spawn_shard_subprocess(port, env=shard_env)
+    n_threads = 32
+    ops_per_thread = 64
+    try:
+        def shared_client_arm(client) -> list:
+            latencies: list = []
+            lock = threading.Lock()
+
+            def worker(tid):
+                local = []
+                for i in range(ops_per_thread):
+                    key = f"mux/{tid}/{i}"
+                    t0 = time.perf_counter_ns()
+                    if i % 2 == 0:
+                        client.set(key, b"x" * 64)
+                    else:
+                        client.try_get(key)
+                    local.append(time.perf_counter_ns() - t0)
+                with lock:
+                    latencies.extend(local)
+
+            threads = [
+                threading.Thread(target=worker, args=(t,))
+                for t in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return sorted(latencies)
+
+        def quantile(sorted_ns, q):
+            return sorted_ns[min(len(sorted_ns) - 1, int(q * len(sorted_ns)))]
+
+        classic = StoreClient("127.0.0.1", port, timeout=60.0)
+        shared = shared_client_arm(classic)
+        classic.close()
+        mux_client = MuxStoreClient("127.0.0.1", port, timeout=60.0)
+        muxed = shared_client_arm(mux_client)
+
+        p99_shared = quantile(shared, 0.99) / 1e3
+        p99_mux = quantile(muxed, 0.99) / 1e3
+        speedup = p99_shared / max(1e-9, p99_mux)
+        waived = (os.cpu_count() or 1) < 2 and speedup < 2.0
+        out = {
+            "store_fanin_p99_shared_us": round(p99_shared, 1),
+            "store_fanin_p99_mux_us": round(p99_mux, 1),
+            "store_mux_speedup": round(speedup, 2),
+            "store_mux_ok": bool(speedup >= 2.0 or waived),
+        }
+        if waived:
+            out["store_mux_gate_waived"] = "1-core host"
+
+        # the interrupt-latency contract: worst observed landing over trials
+        landings = []
+        for trial in range(5):
+            if time_left_fn() < 10:
+                break
+            box = {}
+
+            def parked():
+                try:
+                    mux_client.wait([f"mux/never/{trial}"], timeout=30.0)
+                except BaseException:  # noqa: BLE001 - the injected raise
+                    box["landed"] = time.perf_counter_ns()
+
+            th = threading.Thread(target=parked, daemon=True)
+            th.start()
+            time.sleep(0.4)  # deep inside the server-held wait
+            t0 = time.perf_counter_ns()
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(th.ident), ctypes.py_object(KeyboardInterrupt)
+            )
+            th.join(timeout=15.0)
+            if "landed" in box:
+                landings.append((box["landed"] - t0) / 1e6)
+        mux_client.close()
+        if landings:
+            out["store_interrupt_latency_ms"] = round(max(landings), 1)
+        return out
+    finally:
+        proc.kill()
+
+
 def bench_rendezvous_10k(time_left_fn) -> dict:
     """10k-rank rendezvous close A/B: affinity-routed one-RTT rounds vs
     the prior protocol (3-RTT joins, per-key host reads, count-marker
@@ -1888,6 +2008,14 @@ def child_main(mode: str) -> None:
                 _save_partial()
             except Exception as exc:  # optional lane, never fatal
                 print(f"bench: store fan-in arm skipped: {exc!r}",
+                      file=sys.stderr, flush=True)
+
+        if time_left() > 25:
+            try:
+                _PARTIAL.update(bench_store_mux(time_left))
+                _save_partial()
+            except Exception as exc:  # optional lane, never fatal
+                print(f"bench: store mux arm skipped: {exc!r}",
                       file=sys.stderr, flush=True)
 
         if time_left() > 60:

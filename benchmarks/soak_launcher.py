@@ -69,6 +69,15 @@ and that the evacuated slot warm-joined chunk-granular from peer
 holders' resident copies — peer-memory bytes > 0, disk bytes == 0, no
 global restore round.
 
+With ``--store-longpoll-abort`` the soak runs the interruptible-long-poll
+campaign: each restart episode parks one rank deep in a server-held store
+``wait()`` and a sibling injects a fault while it is parked.  The gate
+asserts every injected abort LANDED on the parked rank (the async raise
+arrives between poll-quantum I/O slices — the historical flake was a
+~30s uninterruptible C-level recv swallowing it) within the
+abort-propagation budget plus 2x ``TPURX_STORE_POLL_S``, and that no rank
+ever exits ``ret=None``.
+
 Every process appends profiling events to one JSONL
 (``TPURX_PROFILING_FILE``); the report derives detect->recover latencies
 for both rings from those events and ASSERTS bounds, so a regression in
@@ -551,6 +560,75 @@ print(f"soakev[{rank}] result=done joined={joined}", flush=True)
 """
 
 
+WORKLOAD_LONGPOLL = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["TPURX_REPO"])
+from tpu_resiliency.fault_tolerance import RankMonitorClient
+from tpu_resiliency.inprocess import ShiftRanks, Wrapper
+from tpu_resiliency.store.client import StoreTimeout, store_from_env
+
+rank = int(os.environ["TPURX_RANK"])
+cycle = int(os.environ["TPURX_CYCLE"])
+inject_delay = float(os.environ.get("SOAK_LP_INJECT_DELAY", "2.0"))
+
+client = RankMonitorClient(); client.init_workload_monitoring()
+store = store_from_env(timeout=30.0)
+
+
+@Wrapper(
+    group=f"soaklp-c{cycle}",
+    rank_assignment=ShiftRanks(),
+    soft_timeout=3600.0, hard_timeout=7200.0,
+    monitor_thread_interval=0.05,
+    heartbeat_interval=0.1, sibling_timeout=5.0,
+    last_call_wait=0.1,
+    enable_monitor_process=False,
+)
+def run(call_wrapper=None):
+    # One fault EPISODE per restart iteration: active rank 0 parks deep in a
+    # server-held store long poll, active rank 1 raises after inject_delay.
+    # The in-process ring's async abort must LAND on the parked rank between
+    # poll-quantum slices (the historical flake: one ~30s C-level recv
+    # swallowed the raise and the rank exited ret=None).  Both sides print
+    # CLOCK_MONOTONIC stamps (system-wide on Linux) so the report can
+    # compute injection->landing latency across processes.
+    while True:
+        call_wrapper.ping()
+        client.send_heartbeat()
+        ep = call_wrapper.state.iteration
+        me = call_wrapper.state.active_rank
+        if me == 0:
+            print(f"soaklp[{rank}] park ep={ep} t={time.monotonic():.6f}",
+                  flush=True)
+            try:
+                store.wait([f"soaklp/never/c{cycle}/ep{ep}"], timeout=120.0)
+            except StoreTimeout:
+                pass  # episode fizzled (injector restarted first); re-park
+            except BaseException:
+                print(f"soaklp[{rank}] landed ep={ep} "
+                      f"t={time.monotonic():.6f}", flush=True)
+                raise
+        elif me == 1:
+            # stay live for the heartbeat ring while the victim parks
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < inject_delay:
+                call_wrapper.ping()
+                client.send_heartbeat()
+                time.sleep(0.05)
+            print(f"soaklp[{rank}] inject ep={ep} t={time.monotonic():.6f}",
+                  flush=True)
+            raise RuntimeError(f"soaklp scheduled abort ep={ep}")
+        else:
+            # spectator ranks idle-heartbeat until the episode's abort lands
+            while True:
+                call_wrapper.ping()
+                client.send_heartbeat()
+                time.sleep(0.05)
+
+print(f"soaklp[{rank}] result={run()}", flush=True)
+"""
+
+
 WORKLOAD_GOODPUT = r"""
 import json, os, sys, time
 sys.path.insert(0, os.environ["TPURX_REPO"])
@@ -884,6 +962,17 @@ def main() -> None:
                         "past its deadline (TPURX_FAULT=coll_stall); the "
                         "wrapper must degrade (retry -> re-layout) and the "
                         "job must finish with ZERO launcher-ring restarts")
+    p.add_argument("--store-longpoll-abort", action="store_true",
+                   help="interruptible-long-poll campaign: each restart "
+                        "episode parks one rank in a server-held store "
+                        "wait() and injects a sibling fault; the gate "
+                        "asserts the async abort LANDS on the parked rank "
+                        "within the poll-quantum contract and that no rank "
+                        "ever exits ret=None")
+    p.add_argument("--longpoll-bound-s", type=float, default=None,
+                   help="bound on injection->landing latency per episode "
+                        "(default: abort-propagation budget + 2x "
+                        "TPURX_STORE_POLL_S)")
     p.add_argument("--fault-seed", type=int, default=None,
                    help="derive a deterministic per-(rank,step) fault "
                         "schedule from this seed and replay it (each "
@@ -932,6 +1021,8 @@ def main() -> None:
     with open(wl_path, "w") as f:
         if args.goodput_arm:
             f.write(WORKLOAD_GOODPUT)
+        elif args.store_longpoll_abort:
+            f.write(WORKLOAD_LONGPOLL)
         elif args.ramp_degrade:
             f.write(WORKLOAD_EVAC)
         elif args.link_degrade:
@@ -1024,6 +1115,18 @@ def main() -> None:
             "TPURX_EVAC": "1",
             # saves/tree rounds/joins pause heartbeats briefly
             "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "15.0",
+        })
+    lp_poll_s = 0.25
+    if args.store_longpoll_abort:
+        env.update({
+            # a visible (but short) quantum so the landing-latency numbers
+            # actually exercise the slicing, not sub-millisecond noise
+            "TPURX_STORE_POLL_S": str(lp_poll_s),
+            "SOAK_LP_INJECT_DELAY": "2.0",
+            # the parked rank legitimately skips rank-monitor heartbeats
+            # while inside wait(); keep the outer ring's kill threshold
+            # clear of a whole episode
+            "TPURX_FT_RANK_HEARTBEAT_TIMEOUT": "30.0",
         })
     if args.link_degrade:
         env.update({
@@ -1311,6 +1414,58 @@ def main() -> None:
         }
         monotone = True
         final = done
+    # interruptible-long-poll campaign (--store-longpoll-abort): every
+    # injection must LAND on the parked rank (landed marker for the same
+    # episode) within the abort-propagation budget plus 2x the poll quantum
+    # — and no rank may ever exit ret=None (the restart completes instead
+    # of silently swallowing the raise inside an uninterruptible recv)
+    lp_report: dict = {}
+    lp_ok = True
+    if args.store_longpoll_abort:
+        import re as re_mod
+
+        def _marks(kind):
+            return {
+                int(ep): float(t)
+                for ep, t in re_mod.findall(
+                    rf"soaklp\[\d+\] {kind} ep=(\d+) t=([0-9.]+)", out)
+            }
+
+        parks, injects, landings = (_marks("park"), _marks("inject"),
+                                    _marks("landed"))
+        land_ms = sorted(
+            (landings[ep] - injects[ep]) * 1000.0
+            for ep in landings if ep in injects
+        )
+        # budget: the injector's raise propagates through its wrapper's
+        # abort broadcast and the victim's monitor thread before the async
+        # raise is even ISSUED; only then does the poll-quantum contract
+        # (2x TPURX_STORE_POLL_S) apply to the landing itself
+        bound_s = (args.longpoll_bound_s if args.longpoll_bound_s is not None
+                   else 4.0 + 2 * lp_poll_s)
+        # the last episode may be cut off mid-park by the soak deadline
+        complete = [ep for ep in injects if ep in landings]
+        lp_ok = bool(
+            len(injects) >= 1
+            and len(complete) >= max(1, len(injects) - 1)
+            and land_ms
+            and max(land_ms) <= bound_s * 1000.0
+            and "ret=None" not in out
+            and "result=None" not in out
+        )
+        lp_report = {
+            "store_longpoll_abort": True,
+            "lp_episodes_injected": len(injects),
+            "lp_episodes_landed": len(landings),
+            "lp_land_ms": [round(x, 1) for x in land_ms],
+            "lp_land_ms_median": (round(land_ms[len(land_ms) // 2], 1)
+                                  if land_ms else None),
+            "lp_bound_ms": bound_s * 1000.0,
+            "lp_ret_none": out.count("ret=None") + out.count("result=None"),
+            "lp_ok": lp_ok,
+        }
+        monotone = True  # no progress file in this campaign
+        final = len(landings)
     ckpt_report: dict = {}
     ckpt_ok = True
     if args.corrupt_blob:
@@ -1353,7 +1508,9 @@ def main() -> None:
         # not the progress file — those checks don't apply
         monotone = True
         final = max((r[1] for r in restores), default=0)
-    if args.ramp_degrade:
+    if args.store_longpoll_abort:
+        ok = bool(lp_ok)
+    elif args.ramp_degrade:
         ok = bool(evac_ok)
     elif args.corrupt_blob:
         ok = bool(ckpt_ok and peer_ok and cycles >= 1)
@@ -1390,6 +1547,7 @@ def main() -> None:
                 **coll_report,
                 **peer_report,
                 **evac_report,
+                **lp_report,
                 **ckpt_report,
                 "ok": ok,
             }

@@ -28,6 +28,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <strings.h>
 #include <sys/epoll.h>
 #include <sys/file.h>
 #include <sys/socket.h>
@@ -66,7 +67,8 @@ enum Op : uint8_t {
   OP_APPEND_CHECK = 16,
   OP_ADD_SET = 17,
   OP_WAIT_GE = 18,
-  OP__LAST = 18,
+  OP_MUX = 19,
+  OP__LAST = 19,
 };
 // END GENERATED OP TABLE
 
@@ -89,6 +91,7 @@ struct Waiter {
   uint8_t op;                       // OP_GET, OP_WAIT, or OP_WAIT_GE
   std::string get_key;              // for OP_GET / OP_WAIT_GE
   long long threshold = 0;          // for OP_WAIT_GE
+  std::string corr;                 // MUX correlation id ("" = plain op)
   uint64_t id;
 };
 
@@ -97,6 +100,9 @@ struct Conn {
   std::string in;                   // read buffer
   std::string out;                  // pending writes
   std::unordered_set<uint64_t> waiting_ids;
+  // correlation id of the MUX envelope currently being handled; reply()
+  // prepends it so subscription replies carry their id (out-of-order safe)
+  std::string cur_corr;
   bool closed = false;
 };
 
@@ -115,6 +121,10 @@ struct Store {
 
 Store g_store;
 int g_epfd = -1;
+// TPURX_STORE_TEST_BROWNOUT: accept connections and read requests but never
+// answer — the fault class where a shard looks alive at the TCP layer while
+// its serving loop is wedged.  Clients must escape via per-op deadlines.
+bool g_brownout = false;
 
 // ---- journal ---------------------------------------------------------------
 // Same on-disk format as the Python server (store/server.py: final-state
@@ -335,7 +345,16 @@ void arm_write(Conn* c) {
 }
 
 void reply(Conn* c, uint8_t status, const std::vector<std::string>& args) {
-  encode_response(&c->out, status, args);
+  if (g_brownout) return;  // test mode: read everything, answer nothing
+  if (!c->cur_corr.empty()) {
+    std::vector<std::string> wrapped;
+    wrapped.reserve(args.size() + 1);
+    wrapped.push_back(c->cur_corr);
+    wrapped.insert(wrapped.end(), args.begin(), args.end());
+    encode_response(&c->out, status, wrapped);
+  } else {
+    encode_response(&c->out, status, args);
+  }
   arm_write(c);
 }
 
@@ -378,6 +397,17 @@ void complete_waiter(uint64_t id, bool timed_out) {
   }
   if (!w.conn || w.conn->closed) return;
   w.conn->waiting_ids.erase(id);
+  // restore the waiter's envelope: a parked MUX long-poll may complete from
+  // inside another request's notify (possibly on the same connection), so
+  // the corr in force at park time — not the current one — must frame it
+  struct CorrScope {
+    Conn* c;
+    std::string saved;
+    CorrScope(Conn* conn, const std::string& corr) : c(conn), saved(conn->cur_corr) {
+      c->cur_corr = corr;
+    }
+    ~CorrScope() { c->cur_corr = saved; }
+  } scope(w.conn, w.corr);
   if (timed_out) {
     reply(w.conn, ST_TIMEOUT, {});
   } else if (w.op == OP_GET) {
@@ -438,6 +468,7 @@ void park_waiter(Conn* c, uint8_t op, std::vector<std::string> missing,
   w.op = op;
   w.get_key = get_key;
   w.threshold = threshold;
+  w.corr = c->cur_corr;
   w.id = id;
   g_store.key_waiters[w.keys.front()].push_back(id);
   g_store.deadlines.emplace(w.deadline, id);
@@ -664,6 +695,25 @@ void handle_request(Conn* c, uint8_t op, std::vector<std::string> args) {
       park_waiter(c, OP_WAIT_GE, {args[0]}, args[0], timeout_ms, threshold);
       return;
     }
+    case OP_MUX: {
+      // correlated envelope: args[0]=corr id (ASCII decimal), args[1]=one
+      // inner opcode byte, args[2:] the inner args.  The inner op runs with
+      // cur_corr set, so its reply — immediate or from a parked waiter —
+      // carries the corr id as its first arg and may be answered out of
+      // order relative to other requests on this connection.
+      if (args.size() < 2 || args[1].size() != 1)
+        return reply(c, ST_ERROR, {"MUX wants corr,op,args..."});
+      uint8_t inner = static_cast<uint8_t>(args[1][0]);
+      std::string saved = c->cur_corr;
+      c->cur_corr = args[0];
+      if (inner < OP_SET || inner > OP__LAST || inner == OP_MUX)
+        reply(c, ST_ERROR, {"bad inner op"});
+      else
+        handle_request(c, inner,
+                       std::vector<std::string>(args.begin() + 2, args.end()));
+      c->cur_corr = saved;
+      return;
+    }
     default:
       return reply(c, ST_ERROR, {"unknown op"});
   }
@@ -734,6 +784,11 @@ int main(int argc, char** argv) {
       strip_prefixes.push_back(argv[++i]);
   }
   signal(SIGPIPE, SIG_IGN);
+  const char* bo = getenv("TPURX_STORE_TEST_BROWNOUT");
+  if (bo && *bo && strcmp(bo, "0") != 0 && strcasecmp(bo, "false") != 0) {
+    g_brownout = true;
+    fprintf(stderr, "TEST MODE: brownout — accepting but never replying\n");
+  }
   if (journal_path && !journal_open(journal_path, strip_prefixes)) return 1;
 
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);

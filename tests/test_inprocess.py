@@ -237,9 +237,14 @@ def test_late_fault_after_completion_exits_not_restarts(store_server):
     assert procs[0].returncode == 0
     assert "ret=done-early@0" in outs[0]
     assert procs[1].returncode == 0, outs[1][-800:]
-    # the faulted rank exited via the completion gate, not a restart cycle
+    # the faulted rank exited via the completion gate, not a restart cycle.
+    # The gate returns the JOB_COMPLETED sentinel (printed as
+    # "ret=job-completed"); "ret=None" no longer exists as an outcome — it
+    # used to be ambiguous with the layered-restart flake's lost-result
+    # signature, where an async raise couldn't land inside a parked store op.
     assert "job completed" in outs[1], outs[1][-800:]
-    assert "ret=None" in outs[1]
+    assert "ret=job-completed" in outs[1], outs[1][-800:]
+    assert "ret=None" not in outs[1], outs[1][-800:]
 
 
 def test_spare_rank_activated_on_failure(store_server):

@@ -190,6 +190,37 @@ def test_soak_smoke_ramp_degrade_evacuates_before_hard_fault():
         assert warm == "True" and peer_b > 0 and disk_b == 0, report
 
 
+def test_soak_smoke_store_longpoll_abort_lands():
+    """The interruptible-long-poll campaign: every restart episode parks
+    one rank deep in a server-held store wait() and injects a sibling
+    fault; the async abort must LAND on the parked rank within the
+    propagation budget + 2x poll quantum (the historical flake parked the
+    raise behind one ~30s uninterruptible recv) and no rank may ever exit
+    ret=None."""
+    proc = subprocess.run(
+        [
+            sys.executable, str(REPO / "benchmarks" / "soak_launcher.py"),
+            "--seconds", "12", "--store-longpoll-abort",
+            # loaded 1-core CI host: abort propagation (not the store
+            # slicing) eats scheduler latency; the quantum contract itself
+            # is asserted tightly by tests/test_store_interrupt.py
+            "--longpoll-bound-s", "10.0",
+        ],
+        cwd=str(REPO), capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert last, proc.stdout[-2000:] + proc.stderr[-2000:]
+    report = json.loads(last[-1])
+    assert report["ok"], report
+    assert report["lp_ok"], report
+    assert report["lp_episodes_injected"] >= 1, report
+    # every completed episode's abort landed on the parked rank
+    assert report["lp_episodes_landed"] >= 1, report
+    assert report["lp_ret_none"] == 0, report
+    assert report["lp_land_ms_median"] is not None, report
+
+
 def test_fault_schedule_generation_is_deterministic():
     """Same seed -> byte-identical injection timeline (the property the
     adaptive-vs-fixed A/B rests on); different seed -> different draws;

@@ -38,9 +38,9 @@ class CountingStoreClient(StoreClient):
         super().__init__(*args, **kwargs)
         self.ops = []
 
-    def _roundtrip(self, op, args, io_timeout):
+    def _roundtrip(self, op, args, io_timeout, **kwargs):
         self.ops.append(Op(op))
-        return super()._roundtrip(op, args, io_timeout)
+        return super()._roundtrip(op, args, io_timeout, **kwargs)
 
     def mutations(self):
         return [op for op in self.ops if op in _MUTATIONS]

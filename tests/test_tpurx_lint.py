@@ -163,6 +163,56 @@ class TestDeadlineDiscipline:
                           rule="TPURX005")
         assert len(fs) == 1
 
+    def test_fires_on_raw_socket_recv_without_bound(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(sock, conn):
+                a = sock.recv(4096)
+                b = conn.recv_into(bytearray(16))
+                return a, b
+        """, rule="TPURX005")
+        assert len(fs) == 2
+        assert all("socket wait blocks async raises" in f.message for f in fs)
+
+    def test_passes_recv_with_deadline_intent_in_scope(self, tmp_path):
+        # intent, not value: a finite settimeout / poll gate anywhere in the
+        # enclosing function (or a timeout= kw on a recv wrapper) bounds it
+        assert not lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(sock, conn, exchange, t):
+                sock.settimeout(t)
+                a = sock.recv(4096)
+                if conn.poll(0.25):
+                    b = conn.recv(16)
+                c = exchange.recv(1, 2, timeout=t)
+                return a, b, c
+        """, rule="TPURX005")
+
+    def test_recv_sanctioned_in_store_io_core(self, tmp_path):
+        # store/client.py and store/mux.py ARE the interruptible I/O core:
+        # their recv loops are quantum-sliced by construction
+        assert not lint_snippet(tmp_path, "tpu_resiliency/store/client.py", """
+            def f(sock):
+                return sock.recv(4096)
+        """, rule="TPURX005")
+
+    def test_recv_bufsize_is_not_a_timeout(self, tmp_path):
+        # the positional arg of recv is a byte count; it must not satisfy
+        # the bound check the way a positional timeout does for wait()
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            def f(sock):
+                return sock.recv(65536)
+        """, rule="TPURX005")
+        assert len(fs) == 1
+
+    def test_create_connection_needs_timeout(self, tmp_path):
+        fs = lint_snippet(tmp_path, "tpu_resiliency/mod.py", """
+            import socket
+            def f():
+                a = socket.create_connection(("h", 1))
+                b = socket.create_connection(("h", 1), timeout=2.0)
+                return a, b
+        """, rule="TPURX005")
+        assert len(fs) == 1
+
 
 class TestAbortPathSafety:
     def test_fires_in_abort_stage_and_signal_handler(self, tmp_path):

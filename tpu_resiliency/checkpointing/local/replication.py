@@ -167,7 +167,12 @@ class PeerExchange:
             conn.close()
 
     @staticmethod
-    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    def _recv_exact(
+        conn: socket.socket, n: int, timeout: float = 60.0
+    ) -> Optional[bytes]:
+        # self-bounding: the helper owns its deadline so no caller can park
+        # it in an uninterruptible C-level recv
+        conn.settimeout(timeout)
         buf = b""
         while len(buf) < n:
             chunk = conn.recv(min(1 << 20, n - len(buf)))

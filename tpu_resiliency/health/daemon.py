@@ -97,6 +97,7 @@ class NodeHealthDaemonCheck(HealthCheck):
                 not self.required, f"bad health daemon endpoint {target!r}"
             )
         try:
+            sock.settimeout(self.timeout)  # probe reply bound, explicit here
             sock.sendall(json.dumps({"query": "node_health"}).encode() + b"\n")
             buf = b""
             while b"\n" not in buf and len(buf) < 1 << 16:

@@ -58,11 +58,12 @@ from .rank_assignment import (
 from .quorum_tripwire import QuorumTripwire, quorum_restart_requester
 from .sibling_monitor import SiblingMonitor
 from .state import FrozenState, Mode, State
-from .wrap import CallWrapper, Wrapper
+from .wrap import JOB_COMPLETED, CallWrapper, Wrapper
 
 __all__ = [
     "Wrapper",
     "CallWrapper",
+    "JOB_COMPLETED",
     "State",
     "FrozenState",
     "Mode",

@@ -53,6 +53,28 @@ from .store_ops import InprocStore
 
 log = get_logger("inproc.wrap")
 
+
+class _JobCompleted:
+    """Singleton return value for a rank whose JOB finished elsewhere: a
+    peer completed fn in the same iteration this rank was restarting (or
+    parked as a reserve), so there is no per-rank result to return.  It is
+    falsy, like the historical ``None`` return — but distinguishable from
+    a wrapped fn that legitimately returned ``None``, which made the
+    ``ret=None`` worker output ambiguous between "completed via the
+    any_completed gate" and "restart machinery lost the result" (the
+    layered-restart flake's signature).  ``repr`` is what workers print."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:
+        return "job-completed"
+
+    def __bool__(self) -> bool:
+        return False
+
+
+JOB_COMPLETED = _JobCompleted()
+
 _RESTARTS = counter(
     "tpurx_inprocess_restarts_total", "In-process restart cycles entered"
 )
@@ -472,7 +494,7 @@ class CallWrapper:
                     else:
                         ret = self._reserve_wait(iteration)
                         if ret == "completed":
-                            ret = None
+                            ret = JOB_COMPLETED
                             completed = True
                         # else: unreachable — _reserve_wait only exits via
                         # RankShouldRestart or completion
@@ -627,7 +649,7 @@ class CallWrapper:
                     " exiting", state.initial_rank, iteration,
                 )
                 ep.close()
-                return None
+                return JOB_COMPLETED
             # finalize + health check + survivor barrier = regrouping the
             # job around the fault: the episode's rendezvous phase
             ep.phase("rendezvous")
@@ -670,7 +692,7 @@ class CallWrapper:
                     " %s barrier; exiting", state.initial_rank, iteration,
                 )
                 ep.close()
-                return None
+                return JOB_COMPLETED
             phase_t0 = _observe_phase("iteration_barrier", phase_t0)
             # survivors regrouped: restoring this rank's place in the job
             ep.phase("restore")

@@ -16,6 +16,7 @@ from .client import (
     FailoverStoreClient,
     StoreFactory,
     PrefixStore,
+    StoreBrownout,
     StoreClient,
     StoreError,
     StoreTimeout,
@@ -41,6 +42,7 @@ __all__ = [
     "FailoverStoreClient",
     "PrefixStore",
     "StoreTimeout",
+    "StoreBrownout",
     "StoreError",
     "StoreServer",
     "serve_forever",

@@ -8,6 +8,19 @@ multi ops.  Values are ``bytes``; helpers convert ints/strings.
 Thread-safety: a client holds one socket guarded by a lock; ``clone()``
 returns an independent connection for use from another thread (monitor
 threads keep their own clone so a blocked GET can't starve heartbeats).
+
+Interruptible I/O core: no code path in this module sits in a single
+C-level socket wait longer than the poll quantum (``TPURX_STORE_POLL_S``,
+default 0.5 s).  Every connect/send/recv is a Python-level loop of
+quantum-bounded slices, so a pending async raise (in-process restart),
+monitor abort, or shutdown lands *between* slices instead of parking
+behind an uninterruptible ``recv``.  An async raise that lands mid-frame
+drops the socket before propagating — re-entry never sees a half-read
+frame.  A server that accepts our bytes but never starts answering (a
+"brownout": live TCP listener, wedged serving loop) is detected by
+per-op first-byte deadline accounting and surfaces as
+:class:`StoreBrownout` — a ``StoreError``, so the sharded client's
+``store_shard_failover`` episode trips instead of the caller hanging.
 """
 
 from __future__ import annotations
@@ -91,6 +104,52 @@ class StoreTimeout(StoreError, TimeoutError):
     pass
 
 
+class StoreBrownout(StoreError):
+    """The server accepted our connection (and our request bytes) but never
+    started answering within the per-op deadline — a live TCP listener in
+    front of a wedged serving loop.  Deliberately NOT a :class:`StoreTimeout`:
+    the sharded client passes ``StoreTimeout`` through to the caller (a
+    legitimately-expired wait budget) but retries ``StoreError`` under its
+    ``store_shard_failover`` episode, which is exactly where a browned-out
+    shard must land."""
+
+
+class _IODeadline(Exception):
+    """Internal: a sliced socket loop ran out of its deadline.  Never
+    escapes ``_roundtrip_inner``; mapped there to StoreTimeout/StoreBrownout
+    depending on whether any response bytes had arrived."""
+
+
+def _poll_quantum() -> float:
+    """Upper bound on any single C-level socket wait (seconds)."""
+    try:
+        q = float(env.STORE_POLL_S.get())
+    except (TypeError, ValueError):
+        q = 0.5
+    return max(0.02, q)
+
+
+def _interruptible_sleep(seconds: float) -> None:
+    """``time.sleep`` chunked at the poll quantum — ``time.sleep(30)`` is
+    itself one uninterruptible C-level wait, so retry backoffs must slice
+    exactly like socket waits do."""
+    deadline = time.monotonic() + seconds
+    q = _poll_quantum()
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(q, remaining))
+
+
+def _brownout_grace() -> float:
+    """How long after the expected server-park time we wait for the FIRST
+    response byte before declaring the shard browned out.  Generous relative
+    to the quantum so a loaded single-core CI host's scheduling jitter never
+    reads as a brownout."""
+    return max(20.0 * _poll_quantum(), 2.0)
+
+
 class StoreFactory:
     """Picklable ``() -> StoreClient`` factory.
 
@@ -133,10 +192,17 @@ class StoreClient:
     # -- connection --------------------------------------------------------
 
     def _connect(self, connect_timeout: float) -> None:
-        r = Retrier("store_connect", CONNECT_POLICY, deadline=connect_timeout)
+        # Per-attempt connect wait is ONE poll quantum (the retrier supplies
+        # the overall budget), and backoff sleeps are quantum-chunked — an
+        # async raise lands between attempts even while the endpoint is a
+        # SYN black hole.
+        r = Retrier("store_connect", CONNECT_POLICY, deadline=connect_timeout,
+                    sleep=_interruptible_sleep)
         while True:
             try:
-                sock = socket.create_connection((self.host, self.port), timeout=5.0)
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=_poll_quantum()
+                )
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = sock
                 return
@@ -161,32 +227,72 @@ class StoreClient:
                     self._sock = None
 
     # -- request plumbing --------------------------------------------------
+    # Every socket wait below is a quantum-bounded slice inside a Python
+    # loop (the "interruptible I/O core"); tpurx-lint's unbounded-socket
+    # rule sanctions only this module and store/mux.py to touch recv/send
+    # directly.
 
-    def _read_exact(self, n: int) -> bytes:
+    def _read_exact(self, n: int, deadline: float) -> bytes:
         assert self._sock is not None
         buf = b""
+        q = _poll_quantum()
         while len(buf) < n:
-            chunk = self._sock.recv(n - len(buf))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _IODeadline(f"no reply within {n - len(buf)}B budget")
+            self._sock.settimeout(min(q, remaining))
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except socket.timeout:
+                continue  # slice expired: run bytecode, let raises land
             if not chunk:
                 raise ConnectionError("store connection closed")
             buf += chunk
         return buf
 
+    def _send_all(self, data: bytes, deadline: float) -> None:
+        assert self._sock is not None
+        q = _poll_quantum()
+        view = memoryview(data)
+        while view:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise _IODeadline("server not draining our request bytes")
+            self._sock.settimeout(min(q, remaining))
+            try:
+                sent = self._sock.send(view)
+            except socket.timeout:
+                continue
+            view = view[sent:]
+
     def _roundtrip(
-        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
+        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float],
+        park_s: float = 0.0,
     ) -> tuple[Status, List[bytes]]:
         ops_total, op_latency = _op_metrics(op)
         flight.record(EV_OP_ISSUE, op.name)
         t0 = time.monotonic_ns()
         try:
-            return self._roundtrip_inner(op, args, io_timeout)
+            return self._roundtrip_inner(op, args, io_timeout, park_s)
         finally:
             op_latency.observe(time.monotonic_ns() - t0)
             ops_total.inc()
 
     def _roundtrip_inner(
-        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float]
+        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float],
+        park_s: float = 0.0,
     ) -> tuple[Status, List[bytes]]:
+        """One request/response exchange.
+
+        ``park_s`` is how long the server may LEGITIMATELY hold the request
+        before its first response byte (the wire timeout of a long-poll
+        slice; 0 for immediate ops).  The first-byte deadline is
+        ``park_s + brownout grace``: a server that hasn't started answering
+        by then is browned out — live listener, wedged loop — and the op
+        fails over instead of waiting out ``io_timeout``.
+        """
+        if io_timeout is None:
+            io_timeout = self.timeout
         with self._lock:
             if self._sock is None:
                 self._connect(10.0)
@@ -194,25 +300,61 @@ class StoreClient:
             for a in args:
                 payload.append(_U32.pack(len(a)))
                 payload.append(a)
+            wire = b"".join(payload)
             retrier = None  # lazily built: the happy path allocates nothing
             while True:
                 sent = False
+                brownout = False
                 try:
-                    self._sock.settimeout(io_timeout)
-                    self._sock.sendall(b"".join(payload))
-                    sent = True
-                    status = Status(self._read_exact(1)[0])
-                    (nargs,) = _U32.unpack(self._read_exact(4))
+                    now = time.monotonic()
+                    attempt_deadline = now + io_timeout
+                    first_byte_deadline = min(
+                        now + park_s + _brownout_grace(), attempt_deadline
+                    )
+                    try:
+                        # A partial send is never applied (the server needs
+                        # the whole frame to parse), so `sent` flips only
+                        # after the last byte leaves.
+                        self._send_all(wire, first_byte_deadline)
+                        sent = True
+                        status_b = self._read_exact(1, first_byte_deadline)
+                    except _IODeadline as exc:
+                        # Zero response bytes by the first-byte deadline:
+                        # the shard is browned out.  NOTE the server may
+                        # still have APPLIED the op (read but unanswered),
+                        # so the non-idempotent resend guard below applies.
+                        brownout = True
+                        raise StoreBrownout(
+                            f"store op {op.name}: no reply from "
+                            f"{self.host}:{self.port} within "
+                            f"{first_byte_deadline - now:.1f}s "
+                            f"(brownout?): {exc}"
+                        ) from exc
+                    status = Status(status_b[0])
+                    (nargs,) = _U32.unpack(
+                        self._read_exact(4, attempt_deadline))
                     out = []
                     for _ in range(nargs):
-                        (ln,) = _U32.unpack(self._read_exact(4))
-                        out.append(self._read_exact(ln) if ln else b"")
+                        (ln,) = _U32.unpack(
+                            self._read_exact(4, attempt_deadline))
+                        out.append(
+                            self._read_exact(ln, attempt_deadline)
+                            if ln else b"")
                     return status, out
-                except socket.timeout as exc:
-                    # Desync risk after a mid-frame timeout: drop the socket.
+                except _IODeadline as exc:
+                    # Mid-frame stall AFTER the response started arriving:
+                    # classic timeout semantics (drop — the stream is
+                    # desynced — and let sliced callers re-park).
                     self._drop_socket()
                     raise StoreTimeout(f"store op {op.name} timed out") from exc
-                except (ConnectionError, BrokenPipeError, OSError) as exc:
+                except socket.timeout as exc:
+                    # Defensive: slices consume their own timeouts above, so
+                    # this should be unreachable — but a half-read frame must
+                    # never survive.
+                    self._drop_socket()
+                    raise StoreTimeout(f"store op {op.name} timed out") from exc
+                except (StoreBrownout, ConnectionError, BrokenPipeError,
+                        OSError) as exc:
                     self._drop_socket()
                     # A non-idempotent op may already have been applied once
                     # the request bytes left — never resend those.
@@ -229,17 +371,37 @@ class StoreClient:
                             ROUNDTRIP_POLICY.with_(
                                 max_attempts=self._retries + 1
                             ),
+                            sleep=_interruptible_sleep,
                         )
                     try:
                         retrier.backoff(exc)
                     except RetryExhausted as give_up:
+                        if brownout:
+                            raise StoreBrownout(
+                                f"store op {op.name} failed: {exc}"
+                            ) from give_up
                         raise StoreError(
                             f"store op {op.name} failed: {exc}"
                         ) from give_up
                     flight.record(
                         EV_OP_RETRY, op.name, type(exc).__name__
                     )
+                    if brownout:
+                        # A browned-out endpoint still ACCEPTS connections,
+                        # so a plain reconnect would re-enter the same black
+                        # hole; the failover client advances to a sibling.
+                        self._on_brownout()
+                    # FailoverStoreClient overrides _connect to walk sibling
+                    # endpoints here — a browned-out primary is retried
+                    # against the next endpoint, not the same black hole.
                     self._connect(10.0)
+                except BaseException:
+                    # An async raise (in-process restart, shutdown) landed
+                    # between slices mid-frame: the stream position is
+                    # unknowable, so drop the socket before propagating —
+                    # re-entry reconnects instead of parsing garbage.
+                    self._drop_socket()
+                    raise
 
     def _drop_socket(self) -> None:
         if self._sock is not None:
@@ -248,6 +410,14 @@ class StoreClient:
             except OSError:
                 pass
             self._sock = None
+
+    def _on_brownout(self) -> None:
+        """Hook: the endpoint was detected browned out (live listener, no
+        replies by the first-byte deadline).  The base single-endpoint
+        client has nowhere else to go; :class:`FailoverStoreClient`
+        overrides this to advance to a sibling, because reconnecting to a
+        brownout would SUCCEED — the listener is up — and the retry would
+        wait out the grace against the same wedged server again."""
 
     @staticmethod
     def _k(key) -> bytes:
@@ -275,12 +445,15 @@ class StoreClient:
             raise StoreError(f"set({key}) -> {status.name}")
 
     # Blocking ops are SLICED client-side: a single server-parked request
-    # would block the caller in one C-level recv for the whole wait, during
-    # which the main thread executes no bytecode — the progress watchdog's
-    # pending-call stamps freeze and the monitor reads a legitimately
-    # waiting rank as a hang.  GET/WAIT are idempotent reads, so re-parking
-    # every slice is safe; each loop iteration runs bytecode and keeps the
-    # liveness stamps flowing.
+    # would otherwise occupy the caller for the whole wait with no bytecode
+    # running — the progress watchdog's pending-call stamps freeze and the
+    # monitor reads a legitimately waiting rank as a hang.  GET/WAIT are
+    # idempotent reads, so re-parking every slice is safe; each loop
+    # iteration runs bytecode and keeps the liveness stamps flowing.
+    # Underneath, the recv for each slice is itself chopped into
+    # TPURX_STORE_POLL_S quanta by the interruptible I/O core, so async
+    # raises land within one quantum even mid-slice (this used to be the
+    # layered-restart flake: a ~30s C-level recv no raise could interrupt).
     BLOCKING_SLICE_S = 2.0
 
     def get(self, key, timeout: Optional[float] = None) -> bytes:
@@ -293,7 +466,7 @@ class StoreClient:
             try:
                 status, out = self._roundtrip(
                     Op.GET, [self._k(key), itob(int(slice_t * 1000))],
-                    io_timeout=slice_t + 10.0,
+                    io_timeout=slice_t + 10.0, park_s=slice_t,
                 )
             except StoreTimeout:
                 # socket-level stall on ONE slice (server event-loop pause,
@@ -360,7 +533,7 @@ class StoreClient:
             args = [itob(int(slice_t * 1000))] + wire_keys
             try:
                 status, _ = self._roundtrip(
-                    Op.WAIT, args, io_timeout=slice_t + 10.0
+                    Op.WAIT, args, io_timeout=slice_t + 10.0, park_s=slice_t
                 )
             except StoreTimeout:
                 if remaining <= self.BLOCKING_SLICE_S:
@@ -476,7 +649,7 @@ class StoreClient:
             try:
                 status, out = self._roundtrip(
                     Op.WAIT_GE, wire + [itob(int(slice_t * 1000))],
-                    io_timeout=slice_t + 10.0,
+                    io_timeout=slice_t + 10.0, park_s=slice_t,
                 )
             except StoreTimeout:
                 if remaining <= self.BLOCKING_SLICE_S:
@@ -618,6 +791,13 @@ class FailoverStoreClient(StoreClient):
             [f"{h}:{p}" for h, p in self.endpoints], timeout=self.timeout
         )
 
+    def _on_brownout(self) -> None:
+        # brownout-specific failover: the wedged listener accepts happily,
+        # so endpoint rotation must happen HERE, not in _connect's
+        # unreachable-endpoint walk
+        flight.record(EV_FAILOVER, f"{self.host}:{self.port} brownout")
+        self._endpoint_idx = (self._endpoint_idx + 1) % len(self.endpoints)
+
     def _connect(self, connect_timeout: float) -> None:
         last_exc: Optional[Exception] = None
         endpoints = getattr(self, "endpoints", None)
@@ -658,4 +838,8 @@ def store_from_env(timeout: float = _DEFAULT_TIMEOUT) -> StoreClient:
         )
     host = env.STORE_ADDR.get()
     port = env.STORE_PORT.get()
+    if env.STORE_MUX.get():
+        from .mux import MuxStoreClient  # local: avoids a cycle
+
+        return MuxStoreClient(host, port, timeout=timeout)
     return StoreClient(host, port, timeout=timeout)

@@ -1,0 +1,463 @@
+"""Multiplexed store client: one persistent socket per shard per process.
+
+The classic :class:`~tpu_resiliency.store.client.StoreClient` gives every
+thread its own connection (``clone()``), which at 10k simulated ranks means
+10k sockets per shard and a connect storm on every restart.  This module
+multiplexes instead: all threads in a process share ONE socket per
+``(host, port)``, every request rides an :data:`~.protocol.Op.MUX` envelope
+carrying a correlation id, and a single receiver thread dispatches responses
+— which the server may emit OUT OF ORDER — back to the waiting callers.
+Long-polls (GET/WAIT/WAIT_GE) become server-held subscriptions: they park on
+the server without head-of-line blocking the connection, so a barrier WAIT
+and a heartbeat SET share the wire without a second socket.
+
+The same interruptible-I/O contract as the base client applies: no C-level
+wait (send, recv, event wait, backoff sleep) exceeds the
+``TPURX_STORE_POLL_S`` quantum, so pending async raises land between slices.
+Per-op deadline accounting detects brownouts — a shard that accepted our
+frame but never answers — and surfaces
+:class:`~tpu_resiliency.store.client.StoreBrownout` after force-closing the
+shared socket (the receiver reconnects and resends the idempotent backlog;
+non-idempotent in-flight ops fail loudly rather than risk double-apply).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..telemetry import flight
+from ..utils.retry import CONNECT_POLICY, Retrier, RetryExhausted
+from .client import (
+    _DEFAULT_TIMEOUT,
+    _IDEMPOTENT_OPS,
+    EV_OP_RETRY,
+    StoreBrownout,
+    StoreClient,
+    StoreError,
+    StoreTimeout,
+    _brownout_grace,
+    _interruptible_sleep,
+    _poll_quantum,
+)
+from .protocol import Op, Status, itob
+
+_U32 = struct.Struct("<I")
+
+
+class _Pending:
+    """One in-flight correlated request."""
+
+    __slots__ = ("corr", "op", "frame", "event", "status", "args", "error",
+                 "sent")
+
+    def __init__(self, corr: bytes, op: Op, frame: bytes):
+        self.corr = corr
+        self.op = op
+        self.frame = frame          # full MUX envelope, kept for resend
+        self.event = threading.Event()
+        self.status: Optional[Status] = None
+        self.args: Optional[List[bytes]] = None
+        self.error: Optional[StoreError] = None
+        self.sent = False           # full frame left the socket at least once
+
+    def fail(self, error: StoreError) -> None:
+        self.error = error
+        self.event.set()
+
+
+class _MuxConnection:
+    """Shared per-(host, port) socket + receiver thread + pending table."""
+
+    def __init__(self, host: str, port: int, connect_timeout: float):
+        self.host = host
+        self.port = port
+        self.refs = 0
+        self.closed = False
+        self._send_lock = threading.Lock()   # whole frames only
+        self._state = threading.Lock()       # pendings / corr / sock swap
+        self._pendings: Dict[bytes, _Pending] = {}
+        self._corr = 0
+        self._sock: Optional[socket.socket] = None
+        self._connect(connect_timeout)
+        self._rx = threading.Thread(
+            target=self._recv_loop,
+            name=f"tpurx-store-mux-{host}:{port}",
+            daemon=True,
+        )
+        self._rx.start()
+
+    # -- socket lifecycle --------------------------------------------------
+
+    def _connect(self, connect_timeout: float) -> None:
+        r = Retrier("store_mux_connect", CONNECT_POLICY,
+                    deadline=connect_timeout, sleep=_interruptible_sleep)
+        while True:
+            try:
+                s = socket.create_connection(
+                    (self.host, self.port), timeout=_poll_quantum()
+                )
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with self._state:
+                    self._sock = s
+                return
+            except OSError as exc:
+                try:
+                    r.backoff(exc)
+                except RetryExhausted as give_up:
+                    raise StoreError(
+                        f"mux: could not connect to {self.host}:{self.port}: "
+                        f"{give_up.last_exc}"
+                    ) from give_up
+
+    def force_close(self) -> None:
+        """Kill the socket (brownout escape).  The receiver notices, fails
+        the non-resendable in-flight ops, reconnects, and resends the
+        idempotent backlog."""
+        with self._state:
+            s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._state:
+            self.closed = True
+            s, self._sock = self._sock, None
+            pendings = list(self._pendings.values())
+            self._pendings.clear()
+        for p in pendings:
+            p.fail(StoreError("mux connection closed"))
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    # -- submit / await ----------------------------------------------------
+
+    def submit(self, op: Op, args: Sequence[bytes]) -> _Pending:
+        with self._state:
+            if self.closed:
+                raise StoreError("mux connection closed")
+            self._corr += 1
+            corr = str(self._corr).encode()
+        inner = [corr, bytes([int(op)])] + [bytes(a) for a in args]
+        frame = [bytes([int(Op.MUX)]), _U32.pack(len(inner))]
+        for a in inner:
+            frame.append(_U32.pack(len(a)))
+            frame.append(a)
+        p = _Pending(corr, op, b"".join(frame))
+        with self._state:
+            self._pendings[corr] = p
+        self._send(p)
+        return p
+
+    def _send(self, p: _Pending) -> None:
+        """Best-effort frame write.  On failure the socket is dropped and
+        the receiver's reconnect path takes over resending — a partial
+        frame would desync EVERY caller's stream, so any send error is a
+        connection death, never a per-op retry."""
+        q = _poll_quantum()
+        deadline = time.monotonic() + _brownout_grace()
+        try:
+            with self._send_lock:
+                sock = self._sock
+                if sock is None:
+                    return  # reconnect in progress; resent on success
+                view = memoryview(p.frame)
+                while view:
+                    if time.monotonic() >= deadline:
+                        raise ConnectionError(
+                            "mux: server not draining request bytes")
+                    sock.settimeout(q)
+                    try:
+                        n = sock.send(view)
+                    except socket.timeout:
+                        continue
+                    view = view[n:]
+                p.sent = True
+        except (ConnectionError, BrokenPipeError, OSError):
+            self.force_close()
+
+    def result(
+        self, p: _Pending, park_s: float = 0.0,
+        cap_s: Optional[float] = None,
+    ) -> Tuple[Status, List[bytes]]:
+        """Await ``p``'s reply.  The per-op deadline is ``park_s`` (how long
+        the server may legitimately hold the request) plus the brownout
+        grace, capped by the caller's own I/O budget ``cap_s``; expiry
+        force-closes the shared socket and raises :class:`StoreBrownout`."""
+        budget = park_s + _brownout_grace()
+        if cap_s is not None:
+            budget = min(budget, cap_s)
+        deadline = time.monotonic() + budget
+        q = _poll_quantum()
+        while not p.event.is_set():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                with self._state:
+                    self._pendings.pop(p.corr, None)
+                self.force_close()
+                raise StoreBrownout(
+                    f"store op {p.op.name}: no reply from "
+                    f"{self.host}:{self.port} within {budget:.1f}s "
+                    f"(brownout?)"
+                )
+            # quantum-sliced so async raises land between waits
+            p.event.wait(min(q, remaining))
+        if p.error is not None:
+            raise p.error
+        assert p.status is not None and p.args is not None
+        return p.status, p.args
+
+    def abandon(self, p: _Pending) -> None:
+        """Caller gave up on ``p`` (async raise mid-wait): forget it so a
+        late reply is dropped instead of leaking a table entry."""
+        with self._state:
+            self._pendings.pop(p.corr, None)
+
+    # -- receiver ----------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        buf = b""
+        while True:
+            with self._state:
+                if self.closed:
+                    return
+                sock = self._sock
+            if sock is None:
+                if not self._reconnect():
+                    return
+                buf = b""
+                continue
+            try:
+                sock.settimeout(_poll_quantum())
+                try:
+                    data = sock.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                if not data:
+                    raise ConnectionError("store closed mux connection")
+            except (ConnectionError, BrokenPipeError, OSError):
+                self._on_disconnect()
+                buf = b""
+                continue
+            buf += data
+            buf = self._dispatch(buf)
+
+    def _dispatch(self, buf: bytes) -> bytes:
+        """Peel complete response frames off ``buf``; route by correlation
+        id (first arg).  Returns the unconsumed tail."""
+        while True:
+            if len(buf) < 5:
+                return buf
+            status = buf[0]
+            (nargs,) = _U32.unpack_from(buf, 1)
+            off = 5
+            args: List[bytes] = []
+            complete = True
+            for _ in range(nargs):
+                if len(buf) < off + 4:
+                    complete = False
+                    break
+                (ln,) = _U32.unpack_from(buf, off)
+                off += 4
+                if len(buf) < off + ln:
+                    complete = False
+                    break
+                args.append(bytes(buf[off:off + ln]))
+                off += ln
+            if not complete:
+                return buf
+            buf = buf[off:]
+            if not args:
+                continue  # not a correlated frame; nothing to route
+            corr = args[0]
+            with self._state:
+                p = self._pendings.pop(corr, None)
+            if p is None:
+                continue  # abandoned / post-brownout stray: drop
+            p.status = Status(status)
+            p.args = args[1:]
+            p.event.set()
+
+    def _on_disconnect(self) -> None:
+        """Socket died under in-flight ops: fail what cannot be resent
+        (non-idempotent frames that fully left — the server may have applied
+        them), keep the rest for resend after reconnect."""
+        self.force_close()
+        with self._state:
+            doomed = [
+                p for p in self._pendings.values()
+                if p.sent and p.op not in _IDEMPOTENT_OPS
+            ]
+            for p in doomed:
+                del self._pendings[p.corr]
+        for p in doomed:
+            p.fail(StoreError(
+                f"store op {p.op.name} connection lost after send; "
+                f"not retrying non-idempotent op"
+            ))
+
+    def _reconnect(self) -> bool:
+        """Receiver-side reconnect.  Returns False only when closed.  On
+        success the surviving (idempotent or never-sent) backlog is resent
+        under the same correlation ids."""
+        try:
+            self._connect(CONNECT_POLICY.deadline)
+        except StoreError as exc:
+            with self._state:
+                if self.closed:
+                    return False
+                pendings = list(self._pendings.values())
+                self._pendings.clear()
+            for p in pendings:
+                p.fail(StoreError(f"mux reconnect failed: {exc}"))
+            # stay alive: a later submit + the next loop pass retry
+            _interruptible_sleep(1.0)
+            return not self.closed
+        with self._state:
+            if self.closed:
+                return False
+            backlog = list(self._pendings.values())
+        for p in backlog:
+            flight.record(EV_OP_RETRY, p.op.name, "mux_resend")
+            self._send(p)
+        return True
+
+
+# process-wide connection registry: clone() shares, refcounts reap
+_REGISTRY: Dict[Tuple[str, int], _MuxConnection] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _acquire(host: str, port: int, connect_timeout: float) -> _MuxConnection:
+    with _REGISTRY_LOCK:
+        conn = _REGISTRY.get((host, port))
+        if conn is None or conn.closed:
+            conn = _MuxConnection(host, port, connect_timeout)
+            _REGISTRY[(host, port)] = conn
+        conn.refs += 1
+        return conn
+
+
+def _release(conn: _MuxConnection) -> None:
+    with _REGISTRY_LOCK:
+        conn.refs -= 1
+        if conn.refs <= 0:
+            _REGISTRY.pop((conn.host, conn.port), None)
+            conn.close()
+
+
+class MuxStoreClient(StoreClient):
+    """Drop-in :class:`StoreClient` over the shared multiplexed connection.
+
+    ``clone()`` is a cheap refcounted handle onto the SAME socket — monitor
+    threads, checkpoint drains and the main thread all share one connection
+    per shard without head-of-line blocking (long-polls are server-held).
+    Enabled fleet-wide via ``TPURX_STORE_MUX``.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = _DEFAULT_TIMEOUT,
+        connect_timeout: float = 60.0,
+        retries: int = 3,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._retries = retries
+        self._lock = threading.RLock()
+        self._sock = None  # the shared socket lives in _conn
+        self._conn = _acquire(host, port, connect_timeout)
+        self._released = False
+
+    def clone(self) -> "MuxStoreClient":
+        return MuxStoreClient(self.host, self.port, timeout=self.timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        _release(self._conn)
+
+    # -- plumbing overrides ------------------------------------------------
+
+    def submit_roundtrip(self, op: Op, args: Sequence[bytes]) -> _Pending:
+        """Pipelining hook: fire a request without waiting.  The sharded
+        client batches cross-shard fan-out (multi_get/wait/check) by
+        submitting to every shard before collecting any reply."""
+        return self._conn.submit(op, args)
+
+    def result_roundtrip(
+        self, p: _Pending, park_s: float = 0.0,
+        cap_s: Optional[float] = None,
+    ) -> Tuple[Status, List[bytes]]:
+        try:
+            return self._conn.result(p, park_s, cap_s)
+        except BaseException:
+            self._conn.abandon(p)
+            raise
+
+    def _roundtrip_inner(
+        self, op: Op, args: Sequence[bytes], io_timeout: Optional[float],
+        park_s: float = 0.0,
+    ) -> Tuple[Status, List[bytes]]:
+        return self.result_roundtrip(
+            self.submit_roundtrip(op, args), park_s, cap_s=io_timeout
+        )
+
+    # -- long-polls: one server-held subscription, no re-park chatter ------
+    # The base client re-parks every BLOCKING_SLICE_S to keep liveness
+    # stamps flowing; here the caller's quantum-sliced event wait runs
+    # bytecode every TPURX_STORE_POLL_S already, so a single subscription
+    # for the full budget is both interruptible AND watchdog-visible.
+
+    def get(self, key, timeout: Optional[float] = None) -> bytes:
+        t = self.timeout if timeout is None else timeout
+        status, out = self._roundtrip(
+            Op.GET, [self._k(key), itob(int(t * 1000))],
+            io_timeout=t + 10.0, park_s=t,
+        )
+        if status == Status.OK:
+            return out[0]
+        if status == Status.TIMEOUT:
+            raise StoreTimeout(f"get({key}) timed out after {t}s")
+        raise StoreError(f"get({key}) -> {status.name}")
+
+    def wait(self, keys: Sequence, timeout: Optional[float] = None) -> None:
+        t = self.timeout if timeout is None else timeout
+        args = [itob(int(t * 1000))] + [self._k(k) for k in keys]
+        status, _ = self._roundtrip(
+            Op.WAIT, args, io_timeout=t + 10.0, park_s=t
+        )
+        if status == Status.OK:
+            return
+        if status == Status.TIMEOUT:
+            raise StoreTimeout(f"wait({list(keys)}) timed out after {t}s")
+        raise StoreError(f"wait -> {status.name}")
+
+    def wait_ge(self, key, threshold: int,
+                timeout: Optional[float] = None) -> int:
+        t = self.timeout if timeout is None else timeout
+        status, out = self._roundtrip(
+            Op.WAIT_GE, [self._k(key), itob(threshold), itob(int(t * 1000))],
+            io_timeout=t + 10.0, park_s=t,
+        )
+        if status == Status.OK:
+            return int(out[0])
+        if status == Status.TIMEOUT:
+            raise StoreTimeout(
+                f"wait_ge({key}, {threshold}) timed out after {t}s"
+            )
+        raise StoreError(f"wait_ge({key}) -> {status.name}")

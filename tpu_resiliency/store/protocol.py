@@ -65,6 +65,16 @@ class Op(IntEnum):
                         # counts as 0).  The event-driven "wait for the next
                         # arrival" primitive that replaces per-count marker
                         # keys.  -> current value (or TIMEOUT status)
+    MUX = 19            # correlated envelope: args[0] is an ASCII-decimal
+                        # correlation id, args[1] a 1-byte inner opcode,
+                        # args[2:] the inner op's args.  The response is a
+                        # normal response frame whose FIRST arg is the
+                        # correlation id (status = the inner op's status),
+                        # and the server may answer MUX requests OUT OF
+                        # ORDER — long-polls (GET/WAIT/WAIT_GE) become
+                        # server-held subscriptions that never head-of-line
+                        # block the connection's other traffic.  MUX inside
+                        # MUX is an error.
 
 
 # Spliced by the server into ADD_SET's set_value (first occurrence only):

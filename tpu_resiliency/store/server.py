@@ -77,6 +77,13 @@ class StoreServer:
         # land here and are flushed to the fresh journal after the swap
         self._compact_buffer: Optional[List[bytes]] = None
         self.replayed_keys = 0
+        # TEST-ONLY brownout mode (TPURX_STORE_TEST_BROWNOUT): accept
+        # connections and read requests but never answer — the fault class
+        # where a server looks alive at the TCP layer while its serving
+        # loop is wedged.  Clients must escape via per-op deadlines.
+        self.test_brownout = bool(env.STORE_TEST_BROWNOUT.get())
+        # live MUX subscription tasks per connection (cancelled on close)
+        self._conn_tasks: Dict[asyncio.StreamWriter, Set[asyncio.Task]] = {}
 
     # -- journal -----------------------------------------------------------
     # Record formats (final-state records; replay order reconstructs _data):
@@ -357,6 +364,12 @@ class StoreServer:
                 except asyncio.TimeoutError:
                     self._waiters.get(key, set()).discard(ev)
                     return Status.TIMEOUT
+                except asyncio.CancelledError:
+                    # subscription cancelled (connection closed mid-park):
+                    # un-park the event so never-set keys don't accumulate
+                    # dead waiters
+                    self._waiters.get(key, set()).discard(ev)
+                    raise
         return Status.OK
 
     async def _handle_request(self, op: Op, args: List[bytes]) -> bytes:
@@ -483,6 +496,9 @@ class StoreServer:
                 except asyncio.TimeoutError:
                     self._waiters.get(key, set()).discard(ev)
                     return encode_response(Status.TIMEOUT)
+                except asyncio.CancelledError:
+                    self._waiters.get(key, set()).discard(ev)
+                    raise
         return encode_response(Status.ERROR, b"unknown op")
 
     # -- connection handling ----------------------------------------------
@@ -490,9 +506,44 @@ class StoreServer:
     async def _read_exact(self, reader: asyncio.StreamReader, n: int) -> bytes:
         return await reader.readexactly(n)
 
+    @staticmethod
+    def _with_corr(resp: bytes, corr: bytes) -> bytes:
+        """Splice a MUX correlation id in as the response's FIRST arg
+        without re-encoding the payload args."""
+        (nargs,) = _U32.unpack_from(resp, 1)
+        return (
+            resp[0:1] + _U32.pack(nargs + 1)
+            + _U32.pack(len(corr)) + corr + resp[5:]
+        )
+
+    async def _mux_dispatch(
+        self, writer: asyncio.StreamWriter, corr: bytes,
+        inner: Op, args: List[bytes],
+    ) -> None:
+        """One MUX request as its own task: a long-poll (GET/WAIT/WAIT_GE)
+        becomes a server-held subscription that never head-of-line blocks
+        the connection — replies go out in completion order, each framed
+        with its correlation id.  A whole-frame ``writer.write`` with no
+        await in between keeps concurrent replies from interleaving."""
+        try:
+            resp = await self._handle_request(inner, args)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - report to client
+            log.exception("store mux op %s failed", inner)
+            resp = encode_response(Status.ERROR, str(exc).encode())
+        if self.test_brownout:
+            return
+        try:
+            writer.write(self._with_corr(resp, corr))
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            pass  # subscriber went away; the connection reaper cleans up
+
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        tasks = self._conn_tasks.setdefault(writer, set())
         try:
             while True:
                 header = await reader.read(1)
@@ -519,16 +570,48 @@ class StoreServer:
                     args.append(await self._read_exact(reader, ln) if ln else b"")
                 if nargs == -1:
                     break
+                if op == Op.MUX:
+                    # correlated envelope: args[0]=corr id, args[1]=one
+                    # inner opcode byte, args[2:]=inner args; handled
+                    # concurrently so this loop goes straight back to
+                    # reading the next pipelined request
+                    bad = len(args) < 2 or len(args[1]) != 1
+                    inner = None
+                    if not bad:
+                        try:
+                            inner = Op(args[1][0])
+                        except ValueError:
+                            bad = True
+                    if bad or inner == Op.MUX:
+                        if not self.test_brownout:
+                            corr = args[0] if args else b""
+                            writer.write(self._with_corr(
+                                encode_response(Status.ERROR, b"bad inner op"),
+                                corr,
+                            ))
+                            await writer.drain()
+                        continue
+                    t = asyncio.ensure_future(
+                        self._mux_dispatch(writer, args[0], inner, args[2:])
+                    )
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
+                    continue
                 try:
                     resp = await self._handle_request(op, args)
                 except Exception as exc:  # noqa: BLE001 - report to client
                     log.exception("store op %s failed", op)
                     resp = encode_response(Status.ERROR, str(exc).encode())
+                if self.test_brownout:
+                    continue
                 writer.write(resp)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError, BrokenPipeError):
             pass
         finally:
+            # server-held subscriptions die with their connection
+            for t in list(self._conn_tasks.pop(writer, ())):
+                t.cancel()
             writer.close()
             try:
                 await writer.wait_closed()

@@ -60,9 +60,25 @@ from .client import (
     StoreClient,
     StoreError,
     StoreTimeout,
+    _interruptible_sleep,
+    _poll_quantum,
 )
+from .protocol import Op, Status, itob
 
 log = get_logger("store.sharding")
+
+
+def _shard_client(host, port, timeout, connect_timeout=60.0) -> StoreClient:
+    """Per-shard client constructor: the multiplexed client when
+    ``TPURX_STORE_MUX`` is set (one shared socket per shard per process),
+    the classic one-socket-per-clone client otherwise."""
+    if env.STORE_MUX.get():
+        from .mux import MuxStoreClient  # local: avoids a cycle
+
+        return MuxStoreClient(host, port, timeout=timeout,
+                              connect_timeout=connect_timeout)
+    return StoreClient(host, port, timeout=timeout,
+                       connect_timeout=connect_timeout)
 
 SHARD_MAP_KEY = "store/shard_map"
 
@@ -287,7 +303,7 @@ class ShardedStoreClient:
             env.STORE_AFFINITY.get() if affinity is None else affinity
         )
         self._clients: List[Optional[StoreClient]] = [
-            StoreClient(h, p, timeout=timeout, connect_timeout=connect_timeout)
+            _shard_client(h, p, timeout, connect_timeout)
             for h, p in self.endpoints
         ]
         self._shard_ops = [
@@ -325,9 +341,8 @@ class ShardedStoreClient:
         c = self._clients[idx]
         if c is None:
             host, port = self.endpoints[idx]
-            c = StoreClient(
-                host, port, timeout=self.timeout,
-                connect_timeout=self._connect_timeout,
+            c = _shard_client(
+                host, port, self.timeout, self._connect_timeout
             )
             self._clients[idx] = c
         return c
@@ -423,7 +438,8 @@ class ShardedStoreClient:
             except StoreError as exc:
                 if retrier is None:
                     retrier = Retrier(
-                        "store_shard_failover", self._failover_policy
+                        "store_shard_failover", self._failover_policy,
+                        sleep=_interruptible_sleep,
                     )
                     _SHARD_FAILOVERS.labels(str(idx)).inc()
                 host, port = self.endpoints[idx]
@@ -447,6 +463,29 @@ class ShardedStoreClient:
         for pos, key in enumerate(keys):
             groups.setdefault(self._shard_idx(key), []).append((pos, key))
         return groups
+
+    def _mux_batch(self, calls, park_s: float = 0.0):
+        """Batched cross-shard fan-out over multiplexed clients.
+
+        ``calls`` is ``[(idx, op, wire_args), ...]``; when EVERY involved
+        shard client exposes the pipelining hooks, all requests are
+        submitted before any reply is collected — one RTT for the whole
+        round instead of one per shard.  Returns ``[(status, out), ...]``
+        in call order, or ``None`` when any client is non-mux (caller takes
+        its sequential/threaded path).  Shard failures surface as
+        StoreError/StoreBrownout for the caller's fallback to handle.
+        """
+        clients = []
+        for idx, _op, _args in calls:
+            c = self._client(idx)
+            if not hasattr(c, "submit_roundtrip"):
+                return None
+            clients.append(c)
+        pends = [
+            (c, c.submit_roundtrip(op, args))
+            for c, (_idx, op, args) in zip(clients, calls)
+        ]
+        return [c.result_roundtrip(p, park_s) for c, p in pends]
 
     # -- public API (mirrors StoreClient) ----------------------------------
 
@@ -532,7 +571,8 @@ class ShardedStoreClient:
             except StoreError as exc:
                 if retrier is None:
                     retrier = Retrier(
-                        "store_cas_failover", self._failover_policy
+                        "store_cas_failover", self._failover_policy,
+                        sleep=_interruptible_sleep,
                     )
                     _SHARD_FAILOVERS.labels(str(idx)).inc()
                 try:
@@ -563,16 +603,55 @@ class ShardedStoreClient:
         deadline = time.monotonic() + t
         groups = list(self._by_shard(keys).items())
 
+        if len(groups) > 1:
+            # Mux fast path: one server-held WAIT subscription per shard,
+            # all submitted before any reply is collected — no thread per
+            # shard, and the fence latency is the max of the shard fences.
+            calls = [
+                (idx, Op.WAIT,
+                 [itob(int(t * 1000))] + [StoreClient._k(k)
+                                          for _p, k in group])
+                for idx, group in groups
+            ]
+            try:
+                results = self._mux_batch(calls, park_s=t)
+            except StoreError:
+                results = None  # shard mid-death: threaded failover below
+            if results is not None:
+                if all(st == Status.OK for st, _ in results):
+                    return
+                raise StoreTimeout(f"wait({list(keys)}) timed out after {t}s")
+
+        # Set when the CALLER abandons the fan-out (async raise landing in
+        # the sliced join below).  Workers check it between park slices and
+        # exit quietly instead of riding out the full wait budget — an
+        # abandoned worker otherwise keeps holding its shard client's lock
+        # and, once close() breaks its socket, thrashes store_shard_failover
+        # episodes against a client nobody is using anymore.
+        abandoned = threading.Event()
+
         def wait_shard(idx: int, group_keys: List) -> None:
             def attempt(c: StoreClient, _keys=group_keys) -> None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise StoreTimeout(
-                        f"wait({list(keys)}) timed out after {t}s"
-                    )
-                c.wait(_keys, timeout=remaining)
+                while not abandoned.is_set():
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise StoreTimeout(
+                            f"wait({list(keys)}) timed out after {t}s"
+                        )
+                    try:
+                        # one slice per call so the abandon flag is seen
+                        # within a bounded park, not after `remaining`
+                        c.wait(_keys, timeout=min(
+                            remaining, StoreClient.BLOCKING_SLICE_S))
+                        return
+                    except StoreTimeout:
+                        if deadline - time.monotonic() <= 0:
+                            raise StoreTimeout(
+                                f"wait({list(keys)}) timed out after {t}s"
+                            )
 
-            self._routed(idx, attempt)
+            if not abandoned.is_set():
+                self._routed(idx, attempt)
 
         if len(groups) == 1:  # common case: no thread overhead
             idx, group = groups[0]
@@ -599,14 +678,26 @@ class ShardedStoreClient:
         # budget, but a thread alive past BOTH is wedged — raise rather
         # than park forever
         join_deadline = deadline + self._failover_policy.deadline + 5.0
-        for th in threads:
-            th.join(timeout=max(0.0, join_deadline - time.monotonic()))
-            if th.is_alive():
-                raise StoreTimeout(
-                    f"wait({list(keys)}): {th.name} still blocked "
-                    f"{self._failover_policy.deadline + 5.0:.0f}s past the "
-                    f"{t}s deadline"
-                )
+        try:
+            for th in threads:
+                # sliced join: one th.join(65.0) is a single C-level wait an
+                # async raise (restart/abort) could never land in — park at
+                # most one poll quantum per call so interrupts land between
+                # slices
+                while th.is_alive():
+                    remaining = join_deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    th.join(timeout=min(_poll_quantum(), remaining))
+                if th.is_alive():
+                    raise StoreTimeout(
+                        f"wait({list(keys)}): {th.name} still blocked "
+                        f"{self._failover_policy.deadline + 5.0:.0f}s past "
+                        f"the {t}s deadline"
+                    )
+        except BaseException:
+            abandoned.set()  # workers exit at their next slice boundary
+            raise
         # surface a hard shard error over a plain timeout: the timeout may
         # BE the dead shard, and the error names it
         for exc in errors:
@@ -617,9 +708,23 @@ class ShardedStoreClient:
                 raise exc
 
     def check(self, keys: Sequence) -> bool:
+        groups = list(self._by_shard(keys).items())
+        if len(groups) > 1:
+            calls = [
+                (idx, Op.CHECK, [StoreClient._k(k) for _p, k in g])
+                for idx, g in groups
+            ]
+            try:
+                results = self._mux_batch(calls)
+            except StoreError:
+                results = None
+            if results is not None and all(
+                st == Status.OK for st, _ in results
+            ):
+                return all(out[0] == b"1" for _st, out in results)
         return all(
             self._routed(idx, lambda c, _k=[k for _p, k in g]: c.check(_k))
-            for idx, g in self._by_shard(keys).items()
+            for idx, g in groups
         )
 
     def delete(self, key) -> bool:
@@ -638,13 +743,49 @@ class ShardedStoreClient:
         return out
 
     def multi_set(self, items: dict) -> None:
-        for idx, group in self._by_shard(list(items)).items():
+        groups = list(self._by_shard(list(items)).items())
+        if len(groups) > 1:
+            calls = []
+            for idx, group in groups:
+                wire: List[bytes] = []
+                for _pos, k in group:
+                    wire += [StoreClient._k(k), StoreClient._v(items[k])]
+                calls.append((idx, Op.MULTI_SET, wire))
+            try:
+                results = self._mux_batch(calls)
+            except StoreError:
+                results = None  # shard mid-death: failover path below
+            if results is not None:
+                if all(st == Status.OK for st, _ in results):
+                    return
+                raise StoreError("multi_set -> shard error")
+        for idx, group in groups:
             sub = {k: items[k] for _pos, k in group}
             self._routed(idx, lambda c, _s=sub: c.multi_set(_s))
 
     def multi_get(self, keys: Sequence) -> List[Optional[bytes]]:
         out: List[Optional[bytes]] = [None] * len(keys)
-        for idx, group in self._by_shard(keys).items():
+        groups = list(self._by_shard(keys).items())
+        if len(groups) > 1:
+            calls = [
+                (idx, Op.MULTI_TRY_GET,
+                 [StoreClient._k(k) for _p, k in group])
+                for idx, group in groups
+            ]
+            try:
+                results = self._mux_batch(calls)
+            except StoreError:
+                results = None
+            if results is not None and all(
+                st == Status.OK for st, _ in results
+            ):
+                for (idx, group), (_st, vals) in zip(groups, results):
+                    for i, (pos, _key) in enumerate(group):
+                        out[pos] = (
+                            vals[2 * i + 1] if vals[2 * i] == b"1" else None
+                        )
+                return out
+        for idx, group in groups:
             vals = self._routed(
                 idx, lambda c, _k=[k for _p, k in group]: c.multi_get(_k)
             )

@@ -245,10 +245,30 @@ TREE_PAYLOAD_CAP = Knob(
     "over-cap payloads are trimmed (stride-sampled with a '_trimmed' "
     "marker) at every level when the caller opts into a trim function. "
     "0 = unbounded.", group="store")
+STORE_POLL_S = Knob(
+    "TPURX_STORE_POLL_S", float, 0.5,
+    "Poll quantum of the store client's interruptible I/O core: no socket "
+    "connect/send/recv sits in one C-level wait longer than this — every "
+    "blocking op is a Python-level retry loop, so pending async raises "
+    "(in-process restarts), monitor aborts and shutdown land between "
+    "slices.", group="store")
+STORE_MUX = Knob(
+    "TPURX_STORE_MUX", bool, False,
+    "Use the multiplexed store client: one persistent socket per shard "
+    "shared by every thread in the process, correlation-id framing so "
+    "long-polls become server-held subscriptions (no head-of-line "
+    "blocking), pipelined one-RTT ops and batched cross-shard fan-out.",
+    group="store")
 STORE_TEST_COMPACT_CRASH = Knob(
     "TPURX_STORE_TEST_COMPACT_CRASH", int, None,
     "TEST-ONLY fault hook: crash the store journal compactor after N "
     "appends.", group="store")
+STORE_TEST_BROWNOUT = Knob(
+    "TPURX_STORE_TEST_BROWNOUT", bool, False,
+    "TEST-ONLY fault mode: the store server accepts connections and reads "
+    "requests but never answers (a wedged serving loop behind a live TCP "
+    "listener); clients must escape via per-op deadlines and trip "
+    "failover.", group="store")
 JAX_COORDINATOR = Knob(
     "TPURX_JAX_COORDINATOR", str, None,
     "host:port for jax.distributed.initialize; default derives "

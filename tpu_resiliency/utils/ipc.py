@@ -31,18 +31,27 @@ def send_msg(sock: socket.socket, payload: Dict[str, Any]) -> None:
     sock.sendall(_U32.pack(len(raw)) + raw)
 
 
-def recv_msg(sock: socket.socket) -> Optional[Dict[str, Any]]:
-    header = _recv_exact(sock, 4)
+def recv_msg(
+    sock: socket.socket, timeout: Optional[float] = None
+) -> Optional[Dict[str, Any]]:
+    header = _recv_exact(sock, 4, timeout)
     if header is None:
         return None
     (ln,) = _U32.unpack(header)
-    raw = _recv_exact(sock, ln)
+    raw = _recv_exact(sock, ln, timeout)
     if raw is None:
         return None
     return json.loads(raw.decode())
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(
+    sock: socket.socket, n: int, timeout: Optional[float] = None
+) -> Optional[bytes]:
+    # the deadline lives HERE, not only in the caller's socket setup: a
+    # caller that forgot settimeout must not park in an uninterruptible
+    # C-level recv (None = keep the socket's existing bound)
+    if timeout is not None:
+        sock.settimeout(timeout)
     buf = b""
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
@@ -99,7 +108,7 @@ class IpcConnector:
             try:
                 conn.settimeout(5.0)
                 while True:
-                    msg = recv_msg(conn)
+                    msg = recv_msg(conn, timeout=5.0)
                     if msg is None:
                         break
                     self.messages.append(msg)

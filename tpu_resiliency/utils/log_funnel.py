@@ -97,7 +97,10 @@ class RootLogServer:
             conn.close()
 
     @staticmethod
-    def _recv_exact(conn, n) -> Optional[bytes]:
+    def _recv_exact(conn, n, timeout: float = 30.0) -> Optional[bytes]:
+        # self-bounding: the helper owns its deadline so no caller can park
+        # it in an uninterruptible C-level recv
+        conn.settimeout(timeout)
         buf = b""
         while len(buf) < n:
             chunk = conn.recv(n - len(buf))

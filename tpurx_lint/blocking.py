@@ -22,11 +22,69 @@ _SUBPROCESS_FUNCS = {
     "subprocess.call",
 }
 
+# raw byte-wait receivers: a C-level wait no async raise can interrupt
+_RECV_ATTRS = {"recv", "recv_into", "recvfrom", "recvfrom_into", "recvmsg"}
+
+# The sanctioned interruptible I/O core: the ONLY modules allowed to touch
+# raw socket recv/send waits directly.  Every wait there is sliced at the
+# TPURX_STORE_POLL_S quantum inside a Python-level loop, which is the whole
+# point — everyone else must either bound the socket (settimeout/poll in
+# the same function) or go through the store client.
+SANCTIONED_SOCKET_CORE = (
+    "tpu_resiliency/store/client.py",
+    "tpu_resiliency/store/mux.py",
+)
+
 
 def _receiver_hints_queue(func: ast.Attribute) -> bool:
     chain = attr_chain(func.value).lower()
     last = chain.rsplit(".", 1)[-1]
     return "queue" in last or last == "q" or last.endswith("_q")
+
+
+def _receiver_hints_socket(func: ast.Attribute) -> bool:
+    chain = attr_chain(func.value).lower()
+    last = chain.rsplit(".", 1)[-1]
+    return "sock" in last or "conn" in last
+
+
+def _enclosing_function(pf, node):
+    cur = pf.parent(node)
+    while cur is not None and not isinstance(
+        cur, (ast.FunctionDef, ast.AsyncFunctionDef)
+    ):
+        cur = pf.parent(cur)
+    return cur
+
+
+def _function_bounds_socket(pf, node) -> bool:
+    """True when the enclosing function shows deadline intent for its
+    socket/pipe reads: a finite ``settimeout(...)``, a finite ``poll(...)``
+    gate (the multiprocessing.Connection idiom), or a ``.poll`` handed to
+    ``run_in_executor`` with a timeout operand.  Intent, not value — the
+    rule enforces that someone chose a bound, not what the bound is."""
+    fn = _enclosing_function(pf, node)
+    if fn is None:
+        return False
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        if isinstance(sub.func, ast.Attribute):
+            if (sub.func.attr == "settimeout" and sub.args
+                    and not is_none_constant(sub.args[0])):
+                return True
+            if sub.func.attr == "poll":
+                kw = keyword(sub, "timeout")
+                if (sub.args and not is_none_constant(sub.args[0])) or (
+                    kw is not None and not is_none_constant(kw)
+                ):
+                    return True
+            if sub.func.attr == "run_in_executor" and any(
+                isinstance(a, ast.Attribute) and a.attr == "poll"
+                for a in sub.args
+            ):
+                return True
+    return False
 
 
 def _inside_asyncio_wait_for(pf, node) -> bool:
@@ -94,6 +152,23 @@ def unbounded_blocking_calls(pf, scope_node=None):
                 continue
             if attr == "settimeout" and node.args and is_none_constant(node.args[0]):
                 yield node, "settimeout(None) makes the socket blocking-forever"
+                continue
+            if attr in _RECV_ATTRS and _receiver_hints_socket(func):
+                if pf.rel in SANCTIONED_SOCKET_CORE:
+                    continue  # the quantum-sliced I/O core itself
+                # positional args to recv-family calls are byte counts,
+                # never timeouts — only a timeout= keyword bounds them
+                if has_finite_timeout(node, positional_ok=False):
+                    continue  # exchange.recv(..., timeout=t) style wrappers
+                if _function_bounds_socket(pf, node):
+                    continue
+                yield node, (
+                    f"raw .{attr}() with no deadline in scope (no finite "
+                    f"settimeout/poll in the enclosing function): an "
+                    f"unbounded C-level socket wait blocks async raises — "
+                    f"bound it or route through the store client's "
+                    f"interruptible I/O core"
+                )
                 continue
             if (attr == "get" and not node.args
                     and keyword(node, "timeout") is None
